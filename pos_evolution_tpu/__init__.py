@@ -1,0 +1,18 @@
+"""pos_evolution_tpu — a TPU-native executable consensus framework.
+
+A brand-new implementation of the capability surface of
+``ethereum/pos-evolution`` (the Gasper consensus spec monograph and its
+research successors): SSZ containers, beacon state transition, committee
+shuffling, HLMD-GHOST fork choice, slashing, weak subjectivity, the
+adversarial network simulator, and the protocol variants (proposer boost,
+equivocation discounting, view-merge, Goldfish, RLMD-GHOST, SSF).
+
+Architecture (see SURVEY.md §7): a spec-faithful *object level* keeps the
+reference function signatures intact, while all validator-set hot loops run
+on a dense *array level* dispatched through a pluggable ``ExecutionBackend``
+(pure NumPy reference, or JAX/XLA/Pallas on TPU).
+"""
+
+__version__ = "0.1.0"
+
+from pos_evolution_tpu.config import Config, mainnet_config, minimal_config, cfg, use_config

@@ -1,0 +1,123 @@
+"""SHA-256: scalar (hashlib) and batched vectorized (NumPy) implementations.
+
+This is native component N2 of the build (SURVEY.md §2.7): SHA-256 is the hot
+primitive behind the swap-or-not shuffle (2 hashes x rounds x position-blocks,
+pos-evolution.md:522-530), seed derivation (:486), and all SSZ merkleization
+(:423, :9). The batched NumPy path processes N independent equal-length
+messages as uint32 lane arithmetic — the same formulation the JAX/Pallas
+kernel in ``ops/sha256.py`` uses on TPU.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+__all__ = ["hash_eth2", "sha256", "sha256_batch", "sha256_pairs"]
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+# The spec's `hash` function is SHA-256 (pos-evolution.md:9, :486).
+hash_eth2 = sha256
+
+
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_H0 = np.array(
+    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x: np.ndarray, n: int) -> np.ndarray:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _compress(state: np.ndarray, blocks: np.ndarray) -> np.ndarray:
+    """One SHA-256 compression round over a batch.
+
+    state: (N, 8) uint32; blocks: (N, 16) uint32 big-endian words.
+    """
+    w = np.empty(blocks.shape[:-1] + (64,), dtype=np.uint32)
+    w[..., :16] = blocks
+    for t in range(16, 64):
+        s0 = _rotr(w[..., t - 15], 7) ^ _rotr(w[..., t - 15], 18) ^ (w[..., t - 15] >> np.uint32(3))
+        s1 = _rotr(w[..., t - 2], 17) ^ _rotr(w[..., t - 2], 19) ^ (w[..., t - 2] >> np.uint32(10))
+        w[..., t] = w[..., t - 16] + s0 + w[..., t - 7] + s1
+
+    a, b, c, d, e, f, g, h = (state[..., i].copy() for i in range(8))
+    for t in range(64):
+        big_s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + big_s1 + ch + _K[t] + w[..., t]
+        big_s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = big_s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+
+    out = np.stack([a, b, c, d, e, f, g, h], axis=-1)
+    return (state + out).astype(np.uint32)
+
+
+def _pad_messages(msgs: np.ndarray) -> np.ndarray:
+    """Apply SHA-256 padding to a batch of equal-length messages.
+
+    msgs: (N, L) uint8 -> (N, n_blocks*16) uint32 big-endian words.
+    """
+    n, length = msgs.shape
+    bit_len = length * 8
+    # message + 0x80 + zeros + 8-byte length, to a multiple of 64
+    total = ((length + 1 + 8 + 63) // 64) * 64
+    padded = np.zeros((n, total), dtype=np.uint8)
+    padded[:, :length] = msgs
+    padded[:, length] = 0x80
+    padded[:, -8:] = np.frombuffer(bit_len.to_bytes(8, "big"), dtype=np.uint8)
+    return padded.reshape(n, -1, 4).view(">u4")[..., 0].astype(np.uint32).reshape(n, -1)
+
+
+def sha256_batch(msgs: np.ndarray) -> np.ndarray:
+    """SHA-256 of N equal-length messages at once.
+
+    msgs: (N, L) uint8 array. Returns (N, 32) uint8 digests.
+    """
+    msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
+    if msgs.ndim != 2:
+        raise ValueError("sha256_batch expects a (N, L) uint8 array")
+    n = msgs.shape[0]
+    if n == 0:
+        return np.empty((0, 32), dtype=np.uint8)
+    words = _pad_messages(msgs)  # (N, n_blocks*16)
+    state = np.broadcast_to(_H0, (n, 8)).copy()
+    for blk in range(words.shape[1] // 16):
+        state = _compress(state, words[:, blk * 16:(blk + 1) * 16])
+    # big-endian state words -> bytes
+    return state.astype(">u4").view(np.uint8).reshape(n, 32)
+
+
+def sha256_pairs(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    """Hash N 64-byte concatenations: sha256(left[i] || right[i]).
+
+    left, right: (N, 32) uint8. Returns (N, 32) uint8. This is the merkle
+    tree combiner used by ``ssz.merkle.merkleize``.
+    """
+    return sha256_batch(np.concatenate([left, right], axis=1))

@@ -1,0 +1,118 @@
+"""SSZ merkleization: chunking, padded binary merkle trees, branch proofs.
+
+Implements the merkleization half of the SSZ standard referenced at
+pos-evolution.md:9 — ``merkleize(chunks, limit)``, length mix-in for lists,
+and ``is_valid_merkle_branch`` (pos-evolution.md:141-147). All tree levels
+are hashed with the batched NumPy SHA-256 (ssz/hash.py), so merkleizing a
+1M-leaf balances array is ~20 batched compression sweeps, not 2M Python
+hashlib calls — the "<32 MB rehashed per epoch" bound of pos-evolution.md:114.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pos_evolution_tpu.ssz.hash import sha256, sha256_batch, sha256_pairs
+
+__all__ = [
+    "ZERO_HASHES",
+    "merkleize",
+    "merkleize_chunks",
+    "mix_in_length",
+    "is_valid_merkle_branch",
+    "merkle_tree_branch",
+    "next_pow_of_two",
+]
+
+MAX_DEPTH = 64
+
+
+def _compute_zero_hashes() -> np.ndarray:
+    z = np.zeros((MAX_DEPTH + 1, 32), dtype=np.uint8)
+    for i in range(MAX_DEPTH):
+        z[i + 1] = np.frombuffer(sha256(z[i].tobytes() * 2), dtype=np.uint8)
+    return z
+
+
+# ZERO_HASHES[d] = root of an all-zero subtree of depth d.
+ZERO_HASHES = _compute_zero_hashes()
+
+
+def next_pow_of_two(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def _depth_for(limit: int) -> int:
+    return (next_pow_of_two(limit) - 1).bit_length() if limit > 1 else 0
+
+
+def merkleize_chunks(chunks: np.ndarray, limit: int | None = None) -> bytes:
+    """Merkleize (N, 32) uint8 chunk array, virtually padded to ``limit``.
+
+    ``limit=None`` pads to the next power of two of N (SSZ vector rule).
+    Returns the 32-byte root.
+    """
+    chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+    if chunks.ndim == 1:
+        chunks = chunks.reshape(-1, 32)
+    count = chunks.shape[0]
+    if limit is None:
+        limit = max(count, 1)
+    if count > limit:
+        raise ValueError(f"{count} chunks exceed limit {limit}")
+    depth = _depth_for(limit)
+    if count == 0:
+        return ZERO_HASHES[depth].tobytes()
+    layer = chunks
+    for level in range(depth):
+        if layer.shape[0] % 2 == 1:
+            layer = np.concatenate([layer, ZERO_HASHES[level][None, :]], axis=0)
+        layer = sha256_pairs(layer[0::2], layer[1::2])
+    return layer[0].tobytes()
+
+
+def merkleize(chunks, limit: int | None = None) -> bytes:
+    """Accepts a list of 32-byte chunks or an (N, 32) array."""
+    if isinstance(chunks, np.ndarray):
+        return merkleize_chunks(chunks, limit)
+    if len(chunks) == 0:
+        return merkleize_chunks(np.empty((0, 32), dtype=np.uint8), limit)
+    arr = np.frombuffer(b"".join(bytes(c) for c in chunks), dtype=np.uint8).reshape(-1, 32)
+    return merkleize_chunks(arr, limit)
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return sha256(root + length.to_bytes(32, "little"))
+
+
+def is_valid_merkle_branch(leaf: bytes, branch, depth: int, index: int, root: bytes) -> bool:
+    """Verify a merkle inclusion proof (pos-evolution.md:141-147 contract)."""
+    value = bytes(leaf)
+    for i in range(depth):
+        sibling = bytes(branch[i])
+        if (index >> i) & 1:
+            value = sha256(sibling + value)
+        else:
+            value = sha256(value + sibling)
+    return value == bytes(root)
+
+
+def merkle_tree_branch(leaves: np.ndarray, index: int, depth: int) -> list[bytes]:
+    """Build the merkle proof for ``leaves[index]`` in a depth-``depth`` tree.
+
+    Used by the deposit-tree test fixtures (pos-evolution.md:105-107).
+    """
+    layer = np.ascontiguousarray(leaves, dtype=np.uint8).reshape(-1, 32)
+    branch: list[bytes] = []
+    idx = index
+    for level in range(depth):
+        sib = idx ^ 1
+        if sib < layer.shape[0]:
+            branch.append(layer[sib].tobytes())
+        else:
+            branch.append(ZERO_HASHES[level].tobytes())
+        if layer.shape[0] % 2 == 1:
+            layer = np.concatenate([layer, ZERO_HASHES[level][None, :]], axis=0)
+        layer = sha256_pairs(layer[0::2], layer[1::2])
+        idx //= 2
+    return branch

@@ -1,0 +1,26 @@
+"""Test configuration.
+
+Force JAX onto CPU with 8 virtual devices BEFORE jax is imported anywhere,
+so all mesh/collective code paths (SURVEY.md §4.4c) execute in CI without
+TPU hardware.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+from pos_evolution_tpu.config import minimal_config, use_config  # noqa: E402
+
+
+@pytest.fixture
+def minimal_cfg():
+    with use_config(minimal_config()) as c:
+        yield c

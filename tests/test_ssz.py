@@ -1,0 +1,198 @@
+"""SSZ unit tests: batched sha256, merkleization, containers, proofs.
+
+Cross-checked against independent hashlib-based computations (the golden
+-vector strategy of SURVEY.md §4.5).
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu import ssz
+from pos_evolution_tpu.ssz import (
+    Bitlist, Bitvector, Bytes32, Container, List, Vector,
+    boolean, deserialize, hash_tree_root, serialize, uint8, uint64,
+)
+from pos_evolution_tpu.ssz.hash import sha256_batch
+from pos_evolution_tpu.ssz.merkle import (
+    ZERO_HASHES, is_valid_merkle_branch, merkle_tree_branch, merkleize_chunks,
+)
+
+
+def h(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+class TestSha256Batch:
+    def test_matches_hashlib_various_lengths(self):
+        rng = np.random.default_rng(0)
+        for length in [0, 1, 31, 32, 37, 55, 56, 63, 64, 65, 100, 128, 200]:
+            msgs = rng.integers(0, 256, size=(5, length), dtype=np.uint8)
+            got = sha256_batch(msgs)
+            for i in range(5):
+                assert got[i].tobytes() == h(msgs[i].tobytes()), f"len={length}"
+
+    def test_large_batch(self):
+        msgs = np.arange(64 * 1000, dtype=np.uint64).astype(np.uint8).reshape(1000, 64)
+        got = sha256_batch(msgs)
+        assert got[123].tobytes() == h(msgs[123].tobytes())
+
+    def test_empty_batch(self):
+        assert sha256_batch(np.empty((0, 32), dtype=np.uint8)).shape == (0, 32)
+
+
+class TestMerkleize:
+    def test_zero_hashes(self):
+        assert ZERO_HASHES[0].tobytes() == b"\x00" * 32
+        assert ZERO_HASHES[1].tobytes() == h(b"\x00" * 64)
+
+    def test_single_chunk(self):
+        c = np.frombuffer(b"\x01" * 32, dtype=np.uint8).reshape(1, 32)
+        assert merkleize_chunks(c) == b"\x01" * 32
+
+    def test_two_chunks(self):
+        a, b = b"\xaa" * 32, b"\xbb" * 32
+        chunks = np.frombuffer(a + b, dtype=np.uint8).reshape(2, 32)
+        assert merkleize_chunks(chunks) == h(a + b)
+
+    def test_three_chunks_pads_to_four(self):
+        a, b, c = b"\x01" * 32, b"\x02" * 32, b"\x03" * 32
+        chunks = np.frombuffer(a + b + c, dtype=np.uint8).reshape(3, 32)
+        expect = h(h(a + b) + h(c + b"\x00" * 32))
+        assert merkleize_chunks(chunks) == expect
+
+    def test_limit_padding(self):
+        a = b"\x05" * 32
+        chunks = np.frombuffer(a, dtype=np.uint8).reshape(1, 32)
+        # depth-2 tree: root = H(H(a || 0), zero_hashes[1])
+        expect = h(h(a + b"\x00" * 32) + ZERO_HASHES[1].tobytes())
+        assert merkleize_chunks(chunks, limit=4) == expect
+
+    def test_empty_with_limit(self):
+        empty = np.empty((0, 32), dtype=np.uint8)
+        assert merkleize_chunks(empty, limit=8) == ZERO_HASHES[3].tobytes()
+
+
+class TestBasicTypes:
+    def test_uint64_htr(self):
+        assert hash_tree_root(5, uint64) == (5).to_bytes(8, "little") + b"\x00" * 24
+
+    def test_uint64_roundtrip(self):
+        assert deserialize(serialize(12345, uint64), uint64) == 12345
+
+    def test_boolean(self):
+        assert hash_tree_root(True, boolean) == b"\x01" + b"\x00" * 31
+        assert serialize(False, boolean) == b"\x00"
+
+    def test_bytes32(self):
+        v = bytes(range(32))
+        assert hash_tree_root(v, Bytes32) == v
+        assert deserialize(serialize(v, Bytes32), Bytes32) == v
+
+
+class TestCollections:
+    def test_vector_uint64_htr(self):
+        vec = Vector(uint64, 4)
+        vals = [1, 2, 3, 4]
+        packed = b"".join(int(x).to_bytes(8, "little") for x in vals)
+        assert hash_tree_root(vals, vec) == packed.ljust(32, b"\x00")
+
+    def test_vector_uint64_two_chunks(self):
+        vec = Vector(uint64, 8)
+        vals = list(range(8))
+        packed = b"".join(int(x).to_bytes(8, "little") for x in vals)
+        assert hash_tree_root(vals, vec) == h(packed[:32] + packed[32:])
+
+    def test_list_uint64_htr_mixes_length(self):
+        lst = List(uint64, 8)
+        vals = np.array([7, 9], dtype=np.uint64)
+        packed = (int(7).to_bytes(8, "little") + int(9).to_bytes(8, "little")).ljust(32, b"\x00")
+        # limit 8 uint64s = 2 chunks -> depth 1
+        inner = h(packed + b"\x00" * 32)
+        expect = h(inner + (2).to_bytes(32, "little"))
+        assert hash_tree_root(vals, lst) == expect
+
+    def test_list_roundtrip_numpy(self):
+        lst = List(uint64, 100)
+        vals = np.arange(10, dtype=np.uint64)
+        out = deserialize(serialize(vals, lst), lst)
+        assert np.array_equal(out, vals)
+
+    def test_bitvector(self):
+        bv = Bitvector(10)
+        bits = np.array([1, 0, 1, 1, 0, 0, 0, 0, 1, 1], dtype=bool)
+        assert serialize(bits, bv) == bytes([0b00001101, 0b00000011])
+        assert np.array_equal(deserialize(serialize(bits, bv), bv), bits)
+
+    def test_bitlist_roundtrip_and_htr(self):
+        bl = Bitlist(16)
+        bits = np.array([1, 1, 0, 1], dtype=bool)
+        assert np.array_equal(deserialize(serialize(bits, bl), bl), bits)
+        packed = bytes([0b00001011]).ljust(32, b"\x00")
+        expect = h(packed + (4).to_bytes(32, "little"))
+        assert hash_tree_root(bits, bl) == expect
+
+    def test_bitlist_empty(self):
+        bl = Bitlist(16)
+        assert serialize(np.zeros(0, dtype=bool), bl) == b"\x01"
+        assert deserialize(b"\x01", bl).size == 0
+
+
+class Point(Container):
+    x: uint64
+    y: uint64
+
+
+class Nested(Container):
+    p: Point
+    tag: Bytes32
+    items: List(uint64, 4)
+
+
+class TestContainers:
+    def test_point_htr(self):
+        p = Point(x=3, y=4)
+        cx = (3).to_bytes(8, "little").ljust(32, b"\x00")
+        cy = (4).to_bytes(8, "little").ljust(32, b"\x00")
+        assert p.hash_tree_root() == h(cx + cy)
+
+    def test_defaults(self):
+        p = Point()
+        assert p.x == 0 and p.y == 0
+
+    def test_equality_and_copy(self):
+        p = Nested(p=Point(x=1, y=2), tag=b"\x07" * 32, items=np.array([5], dtype=np.uint64))
+        q = p.copy()
+        assert p == q
+        q.p.x = 9
+        assert p.p.x == 1  # deep copy
+
+    def test_serialize_roundtrip_variable(self):
+        n = Nested(p=Point(x=1, y=2), tag=b"\x07" * 32,
+                   items=np.array([5, 6, 7], dtype=np.uint64))
+        out = deserialize(serialize(n), Nested)
+        assert out == n
+
+    def test_fixed_container_roundtrip(self):
+        p = Point(x=123, y=2**60)
+        assert deserialize(serialize(p), Point) == p
+
+
+class TestMerkleBranch:
+    @pytest.mark.parametrize("index", [0, 1, 5, 7])
+    def test_branch_verifies(self, index):
+        rng = np.random.default_rng(1)
+        leaves = rng.integers(0, 256, size=(8, 32), dtype=np.uint8)
+        depth = 3
+        root = merkleize_chunks(leaves, limit=8)
+        branch = merkle_tree_branch(leaves, index, depth)
+        assert is_valid_merkle_branch(leaves[index].tobytes(), branch, depth, index, root)
+        # wrong leaf fails
+        assert not is_valid_merkle_branch(b"\x42" * 32, branch, depth, index, root)
+
+    def test_branch_beyond_leaf_count(self):
+        leaves = np.ones((3, 32), dtype=np.uint8)
+        root = merkleize_chunks(leaves, limit=8)
+        branch = merkle_tree_branch(leaves, 2, 3)
+        assert is_valid_merkle_branch(leaves[2].tobytes(), branch, 3, 2, root)
