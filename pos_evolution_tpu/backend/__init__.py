@@ -1,0 +1,47 @@
+"""ExecutionBackend dispatch (layer LB of SURVEY.md §1).
+
+Every validator-set hot loop — the swap-or-not shuffle over the registry
+(north-star config #2), epoch sweeps (#4), fork-choice weight accumulation
+(#1), attestation aggregation (#3) — is callable on a ``numpy`` backend
+(pure NumPy reference oracle) or a ``jax`` backend (XLA/Pallas on TPU) with
+identical signatures. Spec-level functions keep their reference signatures
+and dispatch through ``get_backend()``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_local = threading.local()
+
+_BACKENDS = {}
+
+
+def register_backend(name: str, module) -> None:
+    _BACKENDS[name] = module
+
+
+def get_backend():
+    b = getattr(_local, "backend", None)
+    if b is None:
+        b = _load("numpy")
+        _local.backend = b
+    return b
+
+
+def set_backend(name: str):
+    _local.backend = _load(name)
+    return _local.backend
+
+
+def _load(name: str):
+    if name not in _BACKENDS:
+        if name == "numpy":
+            from pos_evolution_tpu.backend import numpy_backend
+            _BACKENDS[name] = numpy_backend
+        elif name == "jax":
+            from pos_evolution_tpu.backend import jax_backend
+            _BACKENDS[name] = jax_backend
+        else:
+            raise ValueError(f"unknown ExecutionBackend {name!r}")
+    return _BACKENDS[name]

@@ -1,0 +1,71 @@
+"""Pure-NumPy ExecutionBackend: the reference oracle for the JAX/TPU backend.
+
+Implements the hot kernels of SURVEY.md §7 step 3 with vectorized NumPy.
+Differential tests assert bit-identical outputs against the ``jax`` backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pos_evolution_tpu.ssz.hash import hash_eth2, sha256_batch
+
+name = "numpy"
+
+
+def shuffle_permutation(seed: bytes, n: int, rounds: int) -> np.ndarray:
+    """Vectorized swap-or-not shuffle of all ``n`` indices at once.
+
+    Returns ``p`` with ``p[i] == compute_shuffled_index(i, n, seed)``
+    (pos-evolution.md:513-535). Instead of the reference's per-index loop
+    (O(rounds) hashes *per validator*), each round hashes the pivot plus
+    ceil(n/256) position blocks once and applies the flip decision to every
+    index in parallel — O(rounds * n/256) hashes for the whole registry.
+    """
+    if n == 0:
+        return np.zeros(0, dtype=np.uint64)
+    idx = np.arange(n, dtype=np.int64)
+    n_blocks = (n + 255) // 256
+    # Per-round position-block hash inputs: seed(32) | round(1) | block(4)
+    msgs = np.zeros((n_blocks, 37), dtype=np.uint8)
+    msgs[:, :32] = np.frombuffer(seed, dtype=np.uint8)
+    blocks_le = np.arange(n_blocks, dtype="<u4").view(np.uint8).reshape(n_blocks, 4)
+    msgs[:, 33:37] = blocks_le
+    for r in range(rounds):
+        pivot = int.from_bytes(hash_eth2(seed + bytes([r]))[:8], "little") % n
+        flip = (pivot - idx) % n
+        pos = np.maximum(idx, flip)
+        msgs[:, 32] = r
+        digests = sha256_batch(msgs)  # (n_blocks, 32)
+        byte = digests[pos >> 8, (pos & 0xFF) >> 3]
+        bit = (byte >> (pos & 0x07).astype(np.uint8)) & 1
+        idx = np.where(bit.astype(bool), flip, idx)
+    return idx.astype(np.uint64)
+
+
+def committee_weight_sums(effective_balance: np.ndarray, masks: np.ndarray) -> np.ndarray:
+    """Sum effective balances under each of a batch of boolean masks."""
+    return masks.astype(np.uint64) @ effective_balance
+
+
+def segment_sum(values: np.ndarray, segment_ids: np.ndarray, num_segments: int) -> np.ndarray:
+    """Reference segmented reduction (fork-choice weights, SURVEY.md §2.8)."""
+    out = np.zeros(num_segments, dtype=values.dtype)
+    np.add.at(out, segment_ids, values)
+    return out
+
+
+def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
+    """Accumulate each node's weight into all ancestors.
+
+    ``parent[i] < i`` for every non-root node (blocks arrive in topological
+    order), so one reverse sweep suffices — the array-level form of
+    ``get_latest_attesting_balance`` over every branch at once
+    (pos-evolution.md:1102-1116).
+    """
+    w = node_weight.astype(np.int64).copy()
+    for i in range(len(w) - 1, 0, -1):
+        p = parent[i]
+        if p >= 0:
+            w[p] += w[i]
+    return w

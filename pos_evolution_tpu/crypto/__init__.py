@@ -1,0 +1,3 @@
+"""Crypto primitives (L0): BLS signature interface + backends."""
+
+from pos_evolution_tpu.crypto.bls import FakeBLS, bls, get_bls_backend, set_bls_backend

@@ -1,0 +1,1 @@
+"""Spec layer: containers, helpers, state transition, fork choice."""

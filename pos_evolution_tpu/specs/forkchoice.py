@@ -1,0 +1,363 @@
+"""Fork choice (L4): the HLMD-GHOST store and handlers.
+
+Implements the fork-choice spoiler of the reference
+(pos-evolution.md:884-1126): ``Store`` (:889-901), ``get_forkchoice_store``
+(:1077-1095), ``on_tick`` (:934-955, bouncing-attack promotion),
+``on_attestation`` (:963-979 and the ``is_from_block`` variant :1423-1428),
+``on_block`` (:986-1036, proposer boost :1020-1024),
+``should_update_justified_checkpoint`` (:1046-1062), ``get_head``
+(:1102-1116), ``update_latest_messages`` with equivocation discounting
+(:1435-1441), and ``on_attester_slashing`` (:1447-1461).
+
+Handler atomicity (pos-evolution.md:1041: invalid handler calls must not
+modify the store) is guaranteed structurally: every handler performs all
+validation before its first store mutation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from pos_evolution_tpu.config import GENESIS_EPOCH, cfg
+from pos_evolution_tpu.specs.containers import (
+    Attestation,
+    AttesterSlashing,
+    BeaconBlock,
+    BeaconState,
+    Checkpoint,
+    LatestMessage,
+    SignedBeaconBlock,
+)
+from pos_evolution_tpu.specs.helpers import (
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+    get_current_epoch,
+    get_indexed_attestation,
+    get_total_active_balance,
+    is_slashable_attestation_data,
+    is_valid_indexed_attestation,
+)
+from pos_evolution_tpu.specs.transition import process_slots, state_transition
+from pos_evolution_tpu.ssz import hash_tree_root
+
+
+@dataclass
+class Store:
+    """A validator's view G (pos-evolution.md:889-901)."""
+
+    time: int
+    genesis_time: int
+    justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    best_justified_checkpoint: Checkpoint
+    proposer_boost_root: bytes = b"\x00" * 32
+    equivocating_indices: set = field(default_factory=set)
+    blocks: dict = field(default_factory=dict)            # Root -> BeaconBlock
+    block_states: dict = field(default_factory=dict)      # Root -> BeaconState
+    checkpoint_states: dict = field(default_factory=dict)  # (epoch, root) -> BeaconState
+    latest_messages: dict = field(default_factory=dict)   # ValidatorIndex -> LatestMessage
+
+
+def get_forkchoice_store(anchor_state: BeaconState, anchor_block: BeaconBlock) -> Store:
+    """Init from a trusted anchor (pos-evolution.md:1077-1095); the anchor is
+    genesis or a weak-subjectivity checkpoint (:1221)."""
+    assert bytes(anchor_block.state_root) == hash_tree_root(anchor_state), \
+        "anchor block/state mismatch"
+    anchor_root = hash_tree_root(anchor_block)
+    anchor_epoch = get_current_epoch(anchor_state)
+    justified = Checkpoint(epoch=anchor_epoch, root=anchor_root)
+    finalized = Checkpoint(epoch=anchor_epoch, root=anchor_root)
+    return Store(
+        time=int(anchor_state.genesis_time) + cfg().seconds_per_slot * int(anchor_state.slot),
+        genesis_time=int(anchor_state.genesis_time),
+        justified_checkpoint=justified,
+        finalized_checkpoint=finalized,
+        best_justified_checkpoint=justified,
+        blocks={anchor_root: anchor_block.copy()},
+        block_states={anchor_root: anchor_state.copy()},
+        checkpoint_states={justified.as_key(): anchor_state.copy()},
+    )
+
+
+# --- time helpers -------------------------------------------------------------
+
+def get_slots_since_genesis(store: Store) -> int:
+    return (store.time - store.genesis_time) // cfg().seconds_per_slot
+
+
+def get_current_slot(store: Store) -> int:
+    return get_slots_since_genesis(store)
+
+
+def compute_slots_since_epoch_start(slot: int) -> int:
+    return slot - compute_start_slot_at_epoch(compute_epoch_at_slot(slot))
+
+
+# --- tree walks ---------------------------------------------------------------
+
+def get_ancestor(store: Store, root: bytes, slot: int) -> bytes:
+    """Walk parents until ``slot`` (pos-evolution.md:953, 1005, 1058)."""
+    root = bytes(root)
+    block = store.blocks[root]
+    while int(block.slot) > slot:
+        root = bytes(block.parent_root)
+        block = store.blocks[root]
+    return root
+
+
+def get_checkpoint_block(store: Store, root: bytes, epoch: int) -> bytes:
+    return get_ancestor(store, root, compute_start_slot_at_epoch(epoch))
+
+
+# --- weights ------------------------------------------------------------------
+
+def get_proposer_boost(store: Store) -> int:
+    """W/4 of one slot's committee weight (pos-evolution.md:1355)."""
+    justified_state = store.checkpoint_states[store.justified_checkpoint.as_key()]
+    committee_weight = get_total_active_balance(justified_state) // cfg().slots_per_epoch
+    return committee_weight // cfg().proposer_score_boost_quotient
+
+
+def get_latest_attesting_balance(store: Store, root: bytes) -> int:
+    """Σ effective balance whose latest message is in ``root``'s subtree,
+    skipping equivocators, plus proposer boost (pos-evolution.md:322, 916,
+    1116, 1438)."""
+    root = bytes(root)
+    state = store.checkpoint_states[store.justified_checkpoint.as_key()]
+    block_slot = int(store.blocks[root].slot)
+    reg = state.validators
+    current_epoch = compute_epoch_at_slot(get_current_slot(store))
+    attestation_score = 0
+    for i, message in store.latest_messages.items():
+        if i in store.equivocating_indices:
+            continue
+        if i >= len(reg):
+            continue
+        active = reg.activation_epoch[i] <= current_epoch < reg.exit_epoch[i]
+        if not active or reg.slashed[i]:
+            continue
+        if message.root not in store.blocks:
+            continue
+        if get_ancestor(store, message.root, block_slot) == root:
+            attestation_score += int(reg.effective_balance[i])
+
+    boost_score = 0
+    if store.proposer_boost_root != b"\x00" * 32:
+        if get_ancestor(store, store.proposer_boost_root, block_slot) == root:
+            boost_score = get_proposer_boost(store)
+    return attestation_score + boost_score
+
+
+# --- viable-branch filtering (pos-evolution.md:874-880, 1104-1106) ------------
+
+def _leaf_is_viable(store: Store, root: bytes) -> bool:
+    head_state = store.block_states[root]
+    correct_justified = (
+        int(store.justified_checkpoint.epoch) == GENESIS_EPOCH
+        or head_state.current_justified_checkpoint == store.justified_checkpoint)
+    correct_finalized = (
+        int(store.finalized_checkpoint.epoch) == GENESIS_EPOCH
+        or head_state.finalized_checkpoint == store.finalized_checkpoint)
+    return correct_justified and correct_finalized
+
+
+def get_filtered_block_tree(store: Store) -> dict:
+    """Subtree rooted at the justified checkpoint, pruned to branches whose
+    leaves carry the store's justified/finalized view."""
+    base = bytes(store.justified_checkpoint.root)
+    children: dict[bytes, list[bytes]] = {}
+    for root, block in store.blocks.items():
+        children.setdefault(bytes(block.parent_root), []).append(root)
+
+    blocks: dict[bytes, BeaconBlock] = {}
+
+    def visit(root: bytes) -> bool:
+        kids = children.get(root, [])
+        if kids:
+            keep = False
+            for k in kids:
+                if visit(k):
+                    keep = True
+            if keep:
+                blocks[root] = store.blocks[root]
+            return keep
+        if _leaf_is_viable(store, root):
+            blocks[root] = store.blocks[root]
+            return True
+        return False
+
+    visit(base)
+    return blocks
+
+
+def get_head(store: Store) -> bytes:
+    """HLMD-GHOST greedy descent (pos-evolution.md:1102-1116)."""
+    blocks = get_filtered_block_tree(store)
+    head = bytes(store.justified_checkpoint.root)
+    children_of: dict[bytes, list[bytes]] = {}
+    for root, block in blocks.items():
+        children_of.setdefault(bytes(block.parent_root), []).append(root)
+    while True:
+        children = children_of.get(head, [])
+        if not children:
+            return head
+        # max by (weight, root): lexicographic tie-break on the root
+        head = max(children,
+                   key=lambda r: (get_latest_attesting_balance(store, r), r))
+
+
+# --- handlers -----------------------------------------------------------------
+
+def on_tick(store: Store, time: int) -> None:
+    """pos-evolution.md:934-955."""
+    previous_slot = get_current_slot(store)
+    store.time = int(time)
+    current_slot = get_current_slot(store)
+
+    if current_slot > previous_slot:
+        store.proposer_boost_root = b"\x00" * 32
+
+    if not (current_slot > previous_slot
+            and compute_slots_since_epoch_start(current_slot) == 0):
+        return
+
+    # Epoch boundary: promote best_justified (bouncing-attack defense :1043).
+    if int(store.best_justified_checkpoint.epoch) > int(store.justified_checkpoint.epoch):
+        finalized_slot = compute_start_slot_at_epoch(int(store.finalized_checkpoint.epoch))
+        ancestor = get_ancestor(store, store.best_justified_checkpoint.root, finalized_slot)
+        if ancestor == bytes(store.finalized_checkpoint.root):
+            store.justified_checkpoint = store.best_justified_checkpoint
+
+
+def validate_on_attestation(store: Store, attestation: Attestation,
+                            is_from_block: bool) -> None:
+    """pos-evolution.md:970 contract."""
+    target = attestation.data.target
+    current_epoch = compute_epoch_at_slot(get_current_slot(store))
+    previous_epoch = current_epoch - 1 if current_epoch > GENESIS_EPOCH else GENESIS_EPOCH
+    assert int(target.epoch) in (current_epoch, previous_epoch), "target epoch not recent"
+    assert int(target.epoch) == compute_epoch_at_slot(int(attestation.data.slot))
+    assert bytes(target.root) in store.blocks, "unknown target block"
+    beacon_block_root = bytes(attestation.data.beacon_block_root)
+    assert beacon_block_root in store.blocks, "unknown head block"
+    assert int(store.blocks[beacon_block_root].slot) <= int(attestation.data.slot), \
+        "attestation head from the future"
+    target_slot = compute_start_slot_at_epoch(int(target.epoch))
+    assert bytes(target.root) == get_ancestor(store, beacon_block_root, target_slot), \
+        "LMD vote inconsistent with FFG target"
+    if not is_from_block:
+        assert get_current_slot(store) >= int(attestation.data.slot) + 1, \
+            "attestation from current slot"
+
+
+def compute_target_checkpoint_state(store: Store, target: Checkpoint) -> BeaconState:
+    base_state = store.block_states[bytes(target.root)].copy()
+    target_slot = compute_start_slot_at_epoch(int(target.epoch))
+    if int(base_state.slot) < target_slot:
+        process_slots(base_state, target_slot)
+    return base_state
+
+
+def update_latest_messages(store: Store, attesting_indices, attestation: Attestation) -> None:
+    """LMD table update skipping equivocators (pos-evolution.md:1435-1441)."""
+    target = attestation.data.target
+    beacon_block_root = bytes(attestation.data.beacon_block_root)
+    for i in attesting_indices:
+        i = int(i)
+        if i in store.equivocating_indices:
+            continue
+        prev = store.latest_messages.get(i)
+        if prev is None or int(target.epoch) > prev.epoch:
+            store.latest_messages[i] = LatestMessage(epoch=int(target.epoch),
+                                                     root=beacon_block_root)
+
+
+def on_attestation(store: Store, attestation: Attestation,
+                   is_from_block: bool = False) -> None:
+    """pos-evolution.md:963-979 / :1423-1428."""
+    validate_on_attestation(store, attestation, is_from_block)
+    target_key = attestation.data.target.as_key()
+    if target_key in store.checkpoint_states:
+        target_state = store.checkpoint_states[target_key]
+        commit_checkpoint_state = None
+    else:
+        target_state = compute_target_checkpoint_state(store, attestation.data.target)
+        commit_checkpoint_state = target_state
+
+    indexed_attestation = get_indexed_attestation(target_state, attestation)
+    assert is_valid_indexed_attestation(target_state, indexed_attestation), \
+        "invalid indexed attestation"
+
+    # Validation done — commit mutations (atomicity contract :1041).
+    if commit_checkpoint_state is not None:
+        store.checkpoint_states[target_key] = commit_checkpoint_state
+    update_latest_messages(store, indexed_attestation.attesting_indices, attestation)
+
+
+def should_update_justified_checkpoint(store: Store,
+                                       new_justified_checkpoint: Checkpoint) -> bool:
+    """Bouncing-attack mitigation (pos-evolution.md:1046-1062)."""
+    if compute_slots_since_epoch_start(get_current_slot(store)) \
+            < cfg().safe_slots_to_update_justified:
+        return True
+    justified_slot = compute_start_slot_at_epoch(int(store.justified_checkpoint.epoch))
+    if get_ancestor(store, new_justified_checkpoint.root, justified_slot) \
+            != bytes(store.justified_checkpoint.root):
+        return False
+    return True
+
+
+def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
+    """pos-evolution.md:986-1036."""
+    c = cfg()
+    block = signed_block.message
+    parent_root = bytes(block.parent_root)
+    assert parent_root in store.block_states, "unknown parent"
+    pre_state = store.block_states[parent_root]
+    assert get_current_slot(store) >= int(block.slot), "block from the future"
+
+    finalized_slot = compute_start_slot_at_epoch(int(store.finalized_checkpoint.epoch))
+    assert int(block.slot) > finalized_slot, "block at or before finalized slot"
+    assert get_ancestor(store, parent_root, finalized_slot) \
+        == bytes(store.finalized_checkpoint.root), "not a descendant of finalized"
+
+    # Full state transition on a copy (pos-evolution.md:1009).
+    state = pre_state.copy()
+    state_transition(state, signed_block, True)
+
+    block_root = hash_tree_root(block)
+    store.blocks[block_root] = block
+    store.block_states[block_root] = state
+
+    # Proposer boost if timely: first 1/3 of the slot (pos-evolution.md:1020-1024).
+    time_into_slot = (store.time - store.genesis_time) % c.seconds_per_slot
+    is_before_attesting_interval = time_into_slot < c.seconds_per_slot // c.intervals_per_slot
+    if get_current_slot(store) == int(block.slot) and is_before_attesting_interval:
+        store.proposer_boost_root = block_root
+
+    # Justified / finalized checkpoint updates (pos-evolution.md:1026-1036).
+    if int(state.current_justified_checkpoint.epoch) > int(store.justified_checkpoint.epoch):
+        if int(state.current_justified_checkpoint.epoch) \
+                > int(store.best_justified_checkpoint.epoch):
+            store.best_justified_checkpoint = state.current_justified_checkpoint
+        if should_update_justified_checkpoint(store, state.current_justified_checkpoint):
+            store.justified_checkpoint = state.current_justified_checkpoint
+
+    if int(state.finalized_checkpoint.epoch) > int(store.finalized_checkpoint.epoch):
+        store.finalized_checkpoint = state.finalized_checkpoint
+        store.justified_checkpoint = state.current_justified_checkpoint
+
+
+def on_attester_slashing(store: Store, attester_slashing: AttesterSlashing) -> None:
+    """Equivocation evidence feeds the discounting set (pos-evolution.md:1447-1461)."""
+    a1, a2 = attester_slashing.attestation_1, attester_slashing.attestation_2
+    assert is_slashable_attestation_data(a1.data, a2.data), "not slashable"
+    state = store.block_states[bytes(store.justified_checkpoint.root)]
+    assert is_valid_indexed_attestation(state, a1)
+    assert is_valid_indexed_attestation(state, a2)
+    indices = set(int(i) for i in np.asarray(a1.attesting_indices)) \
+        & set(int(i) for i in np.asarray(a2.attesting_indices))
+    for index in indices:
+        store.equivocating_indices.add(index)

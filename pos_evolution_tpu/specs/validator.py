@@ -1,0 +1,166 @@
+"""Validator duties (L5): proposing and attesting (pos-evolution.md:597,
+681-683, 762-764).
+
+Proposers build a ``BeaconBlock`` on the head output of their fork choice;
+attesters cast a combined LMD-GHOST head vote + FFG source/target vote
+(pos-evolution.md:683). These builders are used by the round-based
+simulation driver (L6) and by the transition/fork-choice tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pos_evolution_tpu.config import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_RANDAO,
+    cfg,
+)
+from pos_evolution_tpu.crypto.bls import bls
+from pos_evolution_tpu.specs.containers import (
+    Attestation,
+    AttestationData,
+    BeaconBlock,
+    BeaconBlockBody,
+    BeaconState,
+    Checkpoint,
+    SignedBeaconBlock,
+)
+from pos_evolution_tpu.specs.genesis import validator_secret_key
+from pos_evolution_tpu.specs.helpers import (
+    compute_epoch_at_slot,
+    compute_signing_root,
+    compute_start_slot_at_epoch,
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_block_root,
+    get_block_root_at_slot,
+    get_committee_count_per_slot,
+    get_current_epoch,
+    get_domain,
+)
+from pos_evolution_tpu.specs.transition import (
+    process_block,
+    process_slots,
+    verify_block_signature,
+)
+from pos_evolution_tpu.ssz import hash_tree_root
+from pos_evolution_tpu.ssz.core import uint64
+from pos_evolution_tpu.config import DOMAIN_BEACON_PROPOSER
+
+
+def advance_state_to_slot(state: BeaconState, slot: int) -> BeaconState:
+    """Copy of ``state`` advanced through empty slots to ``slot``."""
+    out = state.copy()
+    if int(out.slot) < slot:
+        process_slots(out, slot)
+    return out
+
+
+def sign_block(state: BeaconState, block: BeaconBlock) -> SignedBeaconBlock:
+    sk = validator_secret_key(int(block.proposer_index))
+    signing_root = compute_signing_root(block, get_domain(state, DOMAIN_BEACON_PROPOSER))
+    return SignedBeaconBlock(message=block, signature=bls.Sign(sk, signing_root))
+
+
+def build_block(parent_state: BeaconState, slot: int, attestations=(),
+                attester_slashings=(), deposits=(), voluntary_exits=(),
+                graffiti: bytes = b"\x00" * 32) -> SignedBeaconBlock:
+    """Produce a valid signed block for ``slot`` on top of ``parent_state``.
+
+    Follows the proposer duty of pos-evolution.md:597: run the state forward,
+    pick the proposer, reveal RANDAO, pack operations, then fill in the
+    post-state root (pos-evolution.md:423 check).
+    """
+    state = advance_state_to_slot(parent_state, slot)
+    proposer_index = get_beacon_proposer_index(state)
+    epoch = get_current_epoch(state)
+
+    sk = validator_secret_key(proposer_index)
+    randao_reveal = bls.Sign(
+        sk, compute_signing_root(epoch, get_domain(state, DOMAIN_RANDAO), uint64))
+
+    body = BeaconBlockBody(
+        randao_reveal=randao_reveal,
+        eth1_data=state.eth1_data.copy(),
+        graffiti=graffiti,
+        attestations=list(attestations),
+        attester_slashings=list(attester_slashings),
+        deposits=list(deposits),
+        voluntary_exits=list(voluntary_exits),
+    )
+    block = BeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=hash_tree_root(state.latest_block_header),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    # Compute the post-state root by applying the block to the advanced state.
+    post = state.copy()
+    process_block(post, block)
+    block.state_root = hash_tree_root(post)
+    return sign_block(state, block)
+
+
+def make_attestation_data(state: BeaconState, slot: int, index: int,
+                          head_root: bytes) -> AttestationData:
+    """Combined GHOST + FFG vote (pos-evolution.md:681-683, 689-696).
+
+    ``state`` must be (a copy of) the head state advanced to ``slot``.
+    """
+    epoch = compute_epoch_at_slot(slot)
+    start_slot = compute_start_slot_at_epoch(epoch)
+    if start_slot == int(state.slot):
+        epoch_boundary_root = bytes(head_root)
+    else:
+        epoch_boundary_root = get_block_root_at_slot(state, start_slot)
+    return AttestationData(
+        slot=slot,
+        index=index,
+        beacon_block_root=bytes(head_root),
+        source=state.current_justified_checkpoint.copy(),
+        target=Checkpoint(epoch=epoch, root=epoch_boundary_root),
+    )
+
+
+def sign_attestation_data(state: BeaconState, data: AttestationData,
+                          validator_index: int) -> bytes:
+    domain = get_domain(state, DOMAIN_BEACON_ATTESTER, int(data.target.epoch))
+    signing_root = compute_signing_root(data, domain)
+    return bls.Sign(validator_secret_key(validator_index), signing_root)
+
+
+def make_committee_attestation(state: BeaconState, slot: int, index: int,
+                               head_root: bytes,
+                               participants: np.ndarray | None = None) -> Attestation:
+    """Aggregate attestation by (a subset of) committee ``index`` at ``slot``."""
+    committee = get_beacon_committee(state, slot, index)
+    data = make_attestation_data(state, slot, index, head_root)
+    bits = np.zeros(committee.shape[0], dtype=bool)
+    sigs = []
+    participant_set = set(int(v) for v in participants) if participants is not None else None
+    for pos, vidx in enumerate(committee):
+        vidx = int(vidx)
+        if participant_set is not None and vidx not in participant_set:
+            continue
+        bits[pos] = True
+        sigs.append(sign_attestation_data(state, data, vidx))
+    if not sigs:
+        raise ValueError("no participants in committee")
+    return Attestation(aggregation_bits=bits, data=data, signature=bls.Aggregate(sigs))
+
+
+def attest_all_committees(state: BeaconState, slot: int, head_root: bytes,
+                          participants: np.ndarray | None = None) -> list[Attestation]:
+    """One aggregate per committee of ``slot`` (full or masked participation)."""
+    epoch = compute_epoch_at_slot(slot)
+    count = get_committee_count_per_slot(state, epoch)
+    out = []
+    for index in range(count):
+        try:
+            out.append(make_committee_attestation(state, slot, index, head_root,
+                                                  participants))
+        except ValueError:
+            continue
+    return out

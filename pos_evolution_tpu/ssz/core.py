@@ -418,7 +418,16 @@ class ContainerMeta(type):
         fields: dict[str, Sedes] = {}
         for base in reversed(bases):
             fields.update(getattr(base, "_fields", {}))
+        module_globals = vars(__import__("sys").modules.get(ns.get("__module__", ""), None)) \
+            if ns.get("__module__") in __import__("sys").modules else {}
         for fname, sedes in ns.get("__annotations__", {}).items():
+            if isinstance(sedes, str):
+                # `from __future__ import annotations` stringifies annotations;
+                # resolve sedes expressions in the defining module's namespace.
+                try:
+                    sedes = eval(sedes, module_globals, dict(ns))  # noqa: S307
+                except Exception:
+                    continue
             if isinstance(sedes, (Sedes, ContainerMeta)):
                 fields[fname] = sedes
         cls._fields = fields

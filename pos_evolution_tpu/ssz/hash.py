@@ -95,10 +95,17 @@ def _pad_messages(msgs: np.ndarray) -> np.ndarray:
     return padded.reshape(n, -1, 4).view(">u4")[..., 0].astype(np.uint32).reshape(n, -1)
 
 
+# Below this batch size the fixed Python overhead of the lane kernel
+# (~300 numpy dispatches) loses to a C hashlib loop.
+_LANE_THRESHOLD = 1024
+
+
 def sha256_batch(msgs: np.ndarray) -> np.ndarray:
     """SHA-256 of N equal-length messages at once.
 
-    msgs: (N, L) uint8 array. Returns (N, 32) uint8 digests.
+    msgs: (N, L) uint8 array. Returns (N, 32) uint8 digests. Small batches
+    go through hashlib (C, ~1us each); large batches use the vectorized
+    uint32-lane kernel (the same formulation as the TPU kernel).
     """
     msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
     if msgs.ndim != 2:
@@ -106,6 +113,14 @@ def sha256_batch(msgs: np.ndarray) -> np.ndarray:
     n = msgs.shape[0]
     if n == 0:
         return np.empty((0, 32), dtype=np.uint8)
+    if n < _LANE_THRESHOLD:
+        out = np.empty((n, 32), dtype=np.uint8)
+        raw = msgs.tobytes()
+        length = msgs.shape[1]
+        for i in range(n):
+            out[i] = np.frombuffer(
+                hashlib.sha256(raw[i * length:(i + 1) * length]).digest(), dtype=np.uint8)
+        return out
     words = _pad_messages(msgs)  # (N, n_blocks*16)
     state = np.broadcast_to(_H0, (n, 8)).copy()
     for blk in range(words.shape[1] // 16):

@@ -1,0 +1,251 @@
+"""Fork-choice tests (L4): store handlers, HLMD-GHOST head, boost,
+equivocation discounting, handler atomicity (SURVEY.md §4.2).
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import cfg
+from pos_evolution_tpu.specs import forkchoice as fc
+from pos_evolution_tpu.specs.containers import AttesterSlashing
+from pos_evolution_tpu.specs.genesis import make_genesis
+from pos_evolution_tpu.specs.helpers import get_indexed_attestation
+from pos_evolution_tpu.specs.validator import (
+    advance_state_to_slot,
+    attest_all_committees,
+    build_block,
+    make_committee_attestation,
+)
+from pos_evolution_tpu.ssz import hash_tree_root
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+def tick_to_slot(store, slot, offset=0):
+    fc.on_tick(store, store.genesis_time + slot * cfg().seconds_per_slot + offset)
+
+
+def new_store(n_validators=64):
+    state, anchor = make_genesis(n_validators)
+    store = fc.get_forkchoice_store(state, anchor)
+    return store, state, hash_tree_root(anchor)
+
+
+class TestStoreInit:
+    def test_init(self):
+        store, state, anchor_root = new_store(16)
+        assert anchor_root in store.blocks
+        assert anchor_root in store.block_states
+        assert int(store.justified_checkpoint.epoch) == 0
+        assert fc.get_head(store) == anchor_root
+
+    def test_anchor_mismatch_rejected(self):
+        state, anchor = make_genesis(16)
+        anchor.state_root = b"\x01" * 32
+        with pytest.raises(AssertionError):
+            fc.get_forkchoice_store(state, anchor)
+
+
+class TestOnBlock:
+    def test_chain_head_follows_blocks(self):
+        store, state, anchor_root = new_store(32)
+        parent_state = state
+        for slot in range(1, 4):
+            tick_to_slot(store, slot)
+            sb = build_block(parent_state, slot)
+            fc.on_block(store, sb)
+            parent_state = store.block_states[hash_tree_root(sb.message)]
+            assert fc.get_head(store) == hash_tree_root(sb.message)
+
+    def test_future_block_rejected(self):
+        store, state, _ = new_store(32)
+        sb = build_block(state, 2)
+        tick_to_slot(store, 1)
+        with pytest.raises(AssertionError):
+            fc.on_block(store, sb)
+
+    def test_unknown_parent_rejected(self):
+        store, state, _ = new_store(32)
+        sb = build_block(state, 1)
+        sb.message.parent_root = b"\x55" * 32
+        tick_to_slot(store, 1)
+        with pytest.raises(AssertionError):
+            fc.on_block(store, sb)
+
+    def test_atomicity_on_invalid_block(self):
+        """pos-evolution.md:1041: failed handlers must not modify the store."""
+        store, state, _ = new_store(32)
+        tick_to_slot(store, 1)
+        sb = build_block(state, 1)
+        sb.signature = b"\x13" * 96  # breaks verify_block_signature mid-handler
+        blocks_before = dict(store.blocks)
+        lm_before = dict(store.latest_messages)
+        jc_before = copy.deepcopy(store.justified_checkpoint)
+        with pytest.raises(AssertionError):
+            fc.on_block(store, sb)
+        assert store.blocks == blocks_before
+        assert store.latest_messages == lm_before
+        assert store.justified_checkpoint == jc_before
+
+
+class TestForksAndWeights:
+    def _two_children(self, store, state):
+        """Two competing blocks at slot 1; returns (root_a, root_b, states)."""
+        tick_to_slot(store, 1)
+        sb_a = build_block(state, 1, graffiti=b"\x0a" * 32)
+        sb_b = build_block(state, 1, graffiti=b"\x0b" * 32)
+        fc.on_block(store, sb_a)
+        fc.on_block(store, sb_b)
+        ra, rb = hash_tree_root(sb_a.message), hash_tree_root(sb_b.message)
+        return ra, rb, store.block_states[ra], store.block_states[rb]
+
+    def test_lexicographic_tiebreak_without_votes(self):
+        store, state, _ = new_store(32)
+        # avoid proposer boost deciding the tie: deliver after the interval
+        tick_to_slot(store, 1, offset=cfg().seconds_per_slot // cfg().intervals_per_slot)
+        sb_a = build_block(state, 1, graffiti=b"\x0a" * 32)
+        sb_b = build_block(state, 1, graffiti=b"\x0b" * 32)
+        fc.on_block(store, sb_a)
+        fc.on_block(store, sb_b)
+        ra, rb = hash_tree_root(sb_a.message), hash_tree_root(sb_b.message)
+        assert fc.get_head(store) == max(ra, rb)
+
+    def test_votes_decide_head(self):
+        store, state, _ = new_store(64)
+        tick_to_slot(store, 1, offset=cfg().seconds_per_slot)  # no boost
+        sb_a = build_block(state, 1, graffiti=b"\x0a" * 32)
+        sb_b = build_block(state, 1, graffiti=b"\x0b" * 32)
+        fc.on_block(store, sb_a)
+        fc.on_block(store, sb_b)
+        ra, rb = hash_tree_root(sb_a.message), hash_tree_root(sb_b.message)
+        loser, winner = sorted([ra, rb])
+        # attest to the lexicographically-smaller block; votes must beat tie-break
+        win_state = store.block_states[winner if winner == ra else ra]
+        state_a = store.block_states[ra]
+        att = make_committee_attestation(state_a if loser == ra else store.block_states[rb],
+                                         1, 0, loser)
+        tick_to_slot(store, 2)
+        fc.on_attestation(store, att)
+        assert fc.get_head(store) == loser
+
+    def test_proposer_boost_sways_head(self):
+        """Timely block gets W/4 committee weight (pos-evolution.md:1355)."""
+        store, state, _ = new_store(64)
+        tick_to_slot(store, 1, offset=cfg().seconds_per_slot)
+        sb_a = build_block(state, 1, graffiti=b"\x0a" * 32)
+        fc.on_block(store, sb_a)
+        ra = hash_tree_root(sb_a.message)
+        # competing block at slot 2 arrives timely -> gets boost
+        tick_to_slot(store, 2, offset=0)
+        sb_c = build_block(state, 2, graffiti=b"\x0c" * 32)
+        fc.on_block(store, sb_c)
+        rc = hash_tree_root(sb_c.message)
+        assert store.proposer_boost_root == rc
+        assert fc.get_head(store) == rc
+        # boost resets on the next slot; without votes, tie-break decides
+        tick_to_slot(store, 3)
+        assert store.proposer_boost_root == b"\x00" * 32
+        assert fc.get_head(store) == max(ra, rc)
+
+
+class TestOnAttestation:
+    def test_latest_messages_updated(self):
+        store, state, _ = new_store(32)
+        tick_to_slot(store, 1)
+        sb = build_block(state, 1)
+        fc.on_block(store, sb)
+        root = hash_tree_root(sb.message)
+        post = store.block_states[root]
+        att = make_committee_attestation(post, 1, 0, root)
+        tick_to_slot(store, 2)
+        fc.on_attestation(store, att)
+        idx = get_indexed_attestation(post, att)
+        for i in np.asarray(idx.attesting_indices):
+            assert store.latest_messages[int(i)].root == root
+
+    def test_same_slot_attestation_rejected_off_wire(self):
+        store, state, _ = new_store(32)
+        tick_to_slot(store, 1)
+        sb = build_block(state, 1)
+        fc.on_block(store, sb)
+        root = hash_tree_root(sb.message)
+        att = make_committee_attestation(store.block_states[root], 1, 0, root)
+        with pytest.raises(AssertionError):
+            fc.on_attestation(store, att)  # current slot, not from block
+        fc.on_attestation(store, att, is_from_block=True)  # allowed from block
+
+    def test_bad_signature_rejected(self):
+        store, state, _ = new_store(32)
+        tick_to_slot(store, 1)
+        sb = build_block(state, 1)
+        fc.on_block(store, sb)
+        root = hash_tree_root(sb.message)
+        att = make_committee_attestation(store.block_states[root], 1, 0, root)
+        att.signature = b"\x77" * 96
+        tick_to_slot(store, 2)
+        lm_before = dict(store.latest_messages)
+        with pytest.raises(AssertionError):
+            fc.on_attestation(store, att)
+        assert store.latest_messages == lm_before
+
+
+class TestEquivocationDiscounting:
+    def test_slashing_removes_weight(self):
+        """pos-evolution.md:1435-1461: equivocators lose fork-choice weight."""
+        store, state, _ = new_store(64)
+        tick_to_slot(store, 1, offset=cfg().seconds_per_slot)
+        sb_a = build_block(state, 1, graffiti=b"\x0a" * 32)
+        sb_b = build_block(state, 1, graffiti=b"\x0b" * 32)
+        fc.on_block(store, sb_a)
+        fc.on_block(store, sb_b)
+        ra, rb = hash_tree_root(sb_a.message), hash_tree_root(sb_b.message)
+        loser, winner = sorted([ra, rb])
+        state_of = {ra: store.block_states[ra], rb: store.block_states[rb]}
+
+        # Committee 0 votes for the smaller-root block -> it becomes head.
+        att1 = make_committee_attestation(state_of[loser], 1, 0, loser)
+        tick_to_slot(store, 2)
+        fc.on_attestation(store, att1)
+        assert fc.get_head(store) == loser
+
+        # Same committee equivocates: also votes for the other fork.
+        att2 = make_committee_attestation(state_of[winner], 1, 0, winner)
+        idx1 = get_indexed_attestation(state_of[loser], att1)
+        idx2 = get_indexed_attestation(state_of[winner], att2)
+        slashing = AttesterSlashing(attestation_1=idx1, attestation_2=idx2)
+        fc.on_attester_slashing(store, slashing)
+        assert store.equivocating_indices == set(
+            int(i) for i in np.asarray(idx1.attesting_indices))
+        # Their weight is discounted -> tie-break decides again.
+        assert fc.get_head(store) == winner
+
+    def test_equivocators_never_rejoin_lmd(self):
+        store, state, _ = new_store(64)
+        tick_to_slot(store, 1)
+        sb = build_block(state, 1)
+        fc.on_block(store, sb)
+        root = hash_tree_root(sb.message)
+        post = store.block_states[root]
+        store.equivocating_indices.add(5)
+        att = make_committee_attestation(post, 1, 0, root)
+        tick_to_slot(store, 2)
+        fc.on_attestation(store, att)
+        assert 5 not in store.latest_messages
+
+
+class TestOnTick:
+    def test_best_justified_promoted_at_epoch_boundary(self):
+        store, state, _ = new_store(32)
+        c = cfg()
+        from pos_evolution_tpu.specs.containers import Checkpoint
+        # fabricate a better justified checkpoint on the finalized chain
+        anchor_root = fc.get_head(store)
+        store.best_justified_checkpoint = Checkpoint(epoch=1, root=anchor_root)
+        # mid-epoch tick: no promotion
+        tick_to_slot(store, c.slots_per_epoch - 1)
+        assert int(store.justified_checkpoint.epoch) == 0
+        # epoch boundary: promoted
+        tick_to_slot(store, c.slots_per_epoch)
+        assert int(store.justified_checkpoint.epoch) == 1

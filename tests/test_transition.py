@@ -1,0 +1,249 @@
+"""State-transition tests (L2): executable-spec unit tests per SURVEY.md §4.1.
+
+Covers: genesis sanity, empty/attesting block transitions, the honest chain
+reaching justification + finalization (the SURVEY.md §7 step-2 exit
+criterion), the 4-case finalization rule, hysteresis, deposits, slashings,
+and the slashable-attestation truth table.
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config, use_config
+from pos_evolution_tpu.specs.containers import (
+    AttestationData, BeaconState, Checkpoint,
+)
+from pos_evolution_tpu.specs.deposits import build_deposit_data, build_deposit_tree
+from pos_evolution_tpu.specs.genesis import make_genesis, validator_secret_key
+from pos_evolution_tpu.specs.helpers import (
+    get_current_epoch,
+    is_slashable_attestation_data,
+)
+from pos_evolution_tpu.specs.epoch import (
+    process_effective_balance_updates,
+    weigh_justification_and_finalization,
+)
+from pos_evolution_tpu.specs.transition import process_deposit, state_transition
+from pos_evolution_tpu.specs.validator import attest_all_committees, build_block
+from pos_evolution_tpu.ssz import hash_tree_root
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """Run a 6-epoch honest chain once; several tests inspect it."""
+    with use_config(minimal_config()) as c:
+        state, anchor = make_genesis(64)
+        genesis_root = hash_tree_root(state)
+        atts = []
+        snapshots = {}
+        for slot in range(1, 6 * c.slots_per_epoch + 1):
+            sb = build_block(state, slot, attestations=atts)
+            state_transition(state, sb, True)
+            atts = attest_all_committees(state, slot, hash_tree_root(sb.message))
+            if slot % c.slots_per_epoch == 0:
+                snapshots[slot // c.slots_per_epoch] = (
+                    int(state.current_justified_checkpoint.epoch),
+                    int(state.finalized_checkpoint.epoch),
+                )
+        return {"state": state, "snapshots": snapshots, "genesis_root": genesis_root}
+
+
+class TestGenesis:
+    def test_genesis_active_set(self):
+        state, anchor = make_genesis(64)
+        assert len(state.validators) == 64
+        assert (state.validators.activation_epoch == 0).all()
+        assert bytes(anchor.state_root) == hash_tree_root(state)
+
+    def test_genesis_root_deterministic(self):
+        s1, _ = make_genesis(32)
+        s2, _ = make_genesis(32)
+        assert hash_tree_root(s1) == hash_tree_root(s2)
+
+
+class TestChainProgress:
+    def test_empty_block_applies(self):
+        state, _ = make_genesis(64)
+        sb = build_block(state, 1)
+        state_transition(state, sb, True)
+        assert int(state.slot) == 1
+
+    def test_chain_justifies_and_finalizes(self, chain):
+        snaps = chain["snapshots"]
+        # First possible justification is epoch 2; after that it should track
+        # current-1, and finalization should trail justification by one.
+        assert snaps[3][0] >= 2, f"no justification by epoch 3: {snaps}"
+        assert snaps[4][1] >= 2, f"no finalization by epoch 4: {snaps}"
+        assert snaps[6] == (5, 4), f"steady-state j/f wrong: {snaps[6]}"
+
+    def test_wrong_state_root_rejected(self):
+        state, _ = make_genesis(64)
+        sb = build_block(state, 1)
+        sb.message.state_root = b"\x42" * 32
+        with pytest.raises(AssertionError):
+            state_transition(state.copy(), sb, True)
+
+    def test_bad_signature_rejected(self):
+        state, _ = make_genesis(64)
+        sb = build_block(state, 1)
+        sb.signature = b"\x99" * 96
+        with pytest.raises(AssertionError):
+            state_transition(state.copy(), sb, True)
+
+
+def _stub_state_for_weigh(epoch: int, bits) -> BeaconState:
+    """Minimal state to drive weigh_justification_and_finalization."""
+    state, _ = make_genesis(16)
+    # epoch processing runs at the last slot of the epoch (pos-evolution.md:415)
+    state.slot = (epoch + 1) * minimal_config().slots_per_epoch - 1
+    rng = np.random.default_rng(epoch)
+    state.block_roots = rng.integers(0, 255, size=state.block_roots.shape).astype(np.uint8)
+    state.justification_bits = np.array(bits, dtype=bool)
+    return state
+
+
+class TestFinalizationCases:
+    """The 4-case 2-finalization rule (pos-evolution.md:842-851)."""
+
+    def test_case_rule_234_with_4th_source(self):
+        # bits are shifted inside weigh_...: pre [1,1,1,0] -> post [_,1,1,1]
+        state = _stub_state_for_weigh(10, [1, 1, 1, 0])
+        old_prev = Checkpoint(epoch=7, root=b"\x07" * 32)
+        state.previous_justified_checkpoint = old_prev
+        state.current_justified_checkpoint = Checkpoint(epoch=8, root=b"\x08" * 32)
+        # no new justification this epoch (balances below 2/3)
+        weigh_justification_and_finalization(state, 90, 10, 10)
+        assert state.finalized_checkpoint == old_prev
+
+    def test_case_rule_12_current_source(self):
+        state = _stub_state_for_weigh(10, [1, 1, 0, 0])
+        cur = Checkpoint(epoch=9, root=b"\x09" * 32)
+        state.previous_justified_checkpoint = Checkpoint(epoch=8, root=b"\x08" * 32)
+        state.current_justified_checkpoint = cur
+        # current epoch justifies: bits[0] set
+        weigh_justification_and_finalization(state, 90, 10, 90)
+        assert state.finalized_checkpoint == cur
+
+    def test_no_finalization_on_gap(self):
+        state = _stub_state_for_weigh(10, [0, 0, 0, 0])
+        state.previous_justified_checkpoint = Checkpoint(epoch=3, root=b"\x03" * 32)
+        state.current_justified_checkpoint = Checkpoint(epoch=4, root=b"\x04" * 32)
+        pre_final = state.finalized_checkpoint.copy()
+        weigh_justification_and_finalization(state, 90, 10, 10)
+        assert state.finalized_checkpoint == pre_final
+
+    def test_justification_threshold_is_two_thirds(self):
+        state = _stub_state_for_weigh(10, [0, 0, 0, 0])
+        pre = state.current_justified_checkpoint.copy()
+        # exactly below 2/3: 59/90 < 2/3
+        weigh_justification_and_finalization(state, 90, 59, 59)
+        assert state.current_justified_checkpoint == pre
+        # exactly 2/3: 60*3 >= 90*2 justifies previous epoch
+        state2 = _stub_state_for_weigh(10, [0, 0, 0, 0])
+        weigh_justification_and_finalization(state2, 90, 60, 0)
+        assert int(state2.current_justified_checkpoint.epoch) == 9
+        assert state2.justification_bits[1]
+
+
+class TestHysteresis:
+    """pos-evolution.md:114-133: ±0.25/+1.25 ETH thresholds."""
+
+    def test_small_dip_does_not_update(self):
+        state, _ = make_genesis(8)
+        gwei = 10**9
+        state.balances[0] = 32 * gwei - gwei // 4  # dip 0.25, not below threshold
+        process_effective_balance_updates(state)
+        assert int(state.validators.effective_balance[0]) == 32 * gwei
+
+    def test_big_dip_updates_down(self):
+        state, _ = make_genesis(8)
+        gwei = 10**9
+        state.balances[0] = 31 * gwei  # 32 - 1.0 < 32 - 0.25 threshold
+        process_effective_balance_updates(state)
+        assert int(state.validators.effective_balance[0]) == 31 * gwei
+
+    def test_upward_requires_crossing(self):
+        state, _ = make_genesis(8)
+        gwei = 10**9
+        state.validators.effective_balance[0] = 30 * gwei
+        state.balances[0] = 31 * gwei  # +1.0 ETH, below the +1.25 threshold
+        process_effective_balance_updates(state)
+        assert int(state.validators.effective_balance[0]) == 30 * gwei
+        state.balances[0] = 31 * gwei + gwei // 2  # +1.5 crosses
+        process_effective_balance_updates(state)
+        assert int(state.validators.effective_balance[0]) == 31 * gwei
+
+
+class TestDeposits:
+    def test_new_validator_deposit(self):
+        state, _ = make_genesis(8)
+        gwei = 10**9
+        data = build_deposit_data(sk=1000, withdrawal_credentials=b"\x00" * 32,
+                                  amount=32 * gwei)
+        root, deposits = build_deposit_tree([data])
+        state.eth1_data.deposit_root = root
+        state.eth1_data.deposit_count = 9
+        state.eth1_deposit_index = 0
+        # tree index 0 == state.eth1_deposit_index
+        process_deposit(state, deposits[0])
+        assert len(state.validators) == 9
+        assert int(state.balances[-1]) == 32 * gwei
+        assert state.validators[8].activation_epoch == 2**64 - 1  # not yet active
+
+    def test_topup_existing_validator(self):
+        state, _ = make_genesis(8)
+        gwei = 10**9
+        data = build_deposit_data(sk=validator_secret_key(3),
+                                  withdrawal_credentials=b"\x00" * 32,
+                                  amount=1 * gwei)
+        root, deposits = build_deposit_tree([data])
+        state.eth1_data.deposit_root = root
+        state.eth1_deposit_index = 0
+        before = int(state.balances[3])
+        process_deposit(state, deposits[0])
+        assert len(state.validators) == 8
+        assert int(state.balances[3]) == before + gwei
+
+    def test_invalid_proof_rejected(self):
+        state, _ = make_genesis(8)
+        data = build_deposit_data(sk=1000, withdrawal_credentials=b"\x00" * 32,
+                                  amount=32 * 10**9)
+        root, deposits = build_deposit_tree([data])
+        state.eth1_data.deposit_root = b"\xaa" * 32
+        state.eth1_deposit_index = 0
+        with pytest.raises(AssertionError):
+            process_deposit(state, deposits[0])
+
+
+class TestSlashableAttestationData:
+    """Truth table for pos-evolution.md:1134-1143."""
+
+    def _data(self, source_epoch, target_epoch, tag=0):
+        return AttestationData(
+            slot=0, index=tag,
+            beacon_block_root=bytes([tag]) * 32,
+            source=Checkpoint(epoch=source_epoch, root=b"\x01" * 32),
+            target=Checkpoint(epoch=target_epoch, root=bytes([tag + 1]) * 32),
+        )
+
+    def test_double_vote(self):
+        d1 = self._data(2, 5, tag=0)
+        d2 = self._data(2, 5, tag=7)
+        assert is_slashable_attestation_data(d1, d2)
+
+    def test_surround_vote(self):
+        outer = self._data(1, 6)
+        inner = self._data(2, 5, tag=3)
+        assert is_slashable_attestation_data(outer, inner)
+        assert not is_slashable_attestation_data(inner, outer)
+
+    def test_identical_not_slashable(self):
+        d = self._data(2, 5)
+        assert not is_slashable_attestation_data(d, d.copy())
+
+    def test_disjoint_not_slashable(self):
+        d1 = self._data(2, 3)
+        d2 = self._data(3, 4, tag=5)
+        assert not is_slashable_attestation_data(d1, d2)
