@@ -82,8 +82,10 @@ class Config:
 
     # --- fork choice (pos-evolution.md:1021-1024, 1054, 1355) ---
     safe_slots_to_update_justified: int = 8
-    # Boost = committee-weight-per-slot // quotient (W/4, pos-evolution.md:1355).
-    proposer_score_boost_quotient: int = 4
+    # Boost as a percentage of one slot's committee weight. The reference
+    # mainline uses W/4 (pos-evolution.md:1355); its attack analyses use
+    # 0.7W and 0.8W (:1385, :1525), so this is a percent knob.
+    proposer_score_boost_percent: int = 25
 
     # --- rewards ---
     base_reward_factor: int = 64

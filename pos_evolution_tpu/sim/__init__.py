@@ -1,0 +1,4 @@
+"""Network & adversary simulation layer (L6)."""
+
+from pos_evolution_tpu.sim.driver import Simulation, ViewGroup
+from pos_evolution_tpu.sim.schedule import Schedule, honest_schedule, partition_schedule

@@ -1,0 +1,232 @@
+"""Round-based simulation driver (L6): the validator duty loop of
+SURVEY.md §3.4 over per-view-group fork-choice stores.
+
+Each slot (3Δ rounds, pos-evolution.md:193, 1536):
+  round 0 (propose):   the slot's proposer runs get_head on its view and
+                       broadcasts a block (pos-evolution.md:597)
+  round 1 (attest):    committee members attest to their view's head
+                       (head vote + FFG vote, pos-evolution.md:681-683)
+  round 2 (aggregate): aggregation is implicit in the per-committee
+                       aggregates (pos-evolution.md:474-475, 1536)
+
+Validators whose messages arrive identically share one ``Store`` (a "view
+group") — the adversary's delivery strategy (the ``Schedule``) induces the
+partition, so honest runs cost one store and attack runs cost a handful.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from pos_evolution_tpu.config import cfg
+from pos_evolution_tpu.specs import forkchoice as fc
+from pos_evolution_tpu.specs.genesis import make_genesis
+from pos_evolution_tpu.specs.helpers import (
+    compute_epoch_at_slot,
+    get_beacon_proposer_index,
+    get_committee_count_per_slot,
+)
+from pos_evolution_tpu.specs.validator import (
+    advance_state_to_slot,
+    build_block,
+    make_committee_attestation,
+)
+from pos_evolution_tpu.sim.schedule import Schedule, honest_schedule
+from pos_evolution_tpu.ssz import hash_tree_root
+
+
+@dataclass(order=True)
+class _QueuedMessage:
+    time: float
+    seq: int
+    kind: str = field(compare=False)     # "block" | "attestation" | "slashing"
+    payload: object = field(compare=False)
+
+
+class ViewGroup:
+    """One equivalence class of validator views: a Store + a message queue
+    + an attestation pool for proposals made from this view."""
+
+    def __init__(self, group_id: int, store: fc.Store, members: np.ndarray):
+        self.id = group_id
+        self.store = store
+        self.members = members
+        self.queue: list[_QueuedMessage] = []
+        self.pool: dict[bytes, object] = {}  # attestation root -> Attestation
+        self._seq = 0
+
+    def enqueue(self, time: float, kind: str, payload) -> None:
+        heapq.heappush(self.queue, _QueuedMessage(time, self._seq, kind, payload))
+        self._seq += 1
+
+    def deliver_due(self, now: float) -> None:
+        while self.queue and self.queue[0].time <= now:
+            msg = heapq.heappop(self.queue)
+            try:
+                if msg.kind == "block":
+                    fc.on_block(self.store, msg.payload)
+                    # process the block's own attestations for fork choice
+                    for att in msg.payload.message.body.attestations:
+                        try:
+                            fc.on_attestation(self.store, att, is_from_block=True)
+                        except AssertionError:
+                            pass
+                elif msg.kind == "attestation":
+                    fc.on_attestation(self.store, msg.payload)
+                    self.pool[hash_tree_root(msg.payload)] = msg.payload
+                elif msg.kind == "slashing":
+                    fc.on_attester_slashing(self.store, msg.payload)
+            except AssertionError:
+                # Invalid-at-this-time messages are dropped (the reference
+                # permits re-queueing, pos-evolution.md:967-968; the driver
+                # keeps the simple policy).
+                continue
+
+
+class Simulation:
+    """Round-based multi-validator simulation over a Schedule."""
+
+    def __init__(self, n_validators: int, schedule: Schedule | None = None,
+                 genesis_time: int = 0):
+        self.cfg = cfg()
+        self.schedule = schedule or honest_schedule(n_validators)
+        state, anchor = make_genesis(n_validators, genesis_time)
+        self.genesis_state = state
+        self.anchor_root = hash_tree_root(anchor)
+        self.groups = [
+            ViewGroup(g, fc.get_forkchoice_store(state, anchor),
+                      self.schedule.members(g))
+            for g in range(self.schedule.n_groups)
+        ]
+        self.slot = 0
+        self.metrics: list[dict] = []
+
+    # -- time helpers --
+    def slot_start(self, slot: int) -> int:
+        return slot * self.cfg.seconds_per_slot
+
+    @property
+    def delta(self) -> int:
+        return self.cfg.seconds_per_slot // self.cfg.intervals_per_slot
+
+    def _tick_all(self, time: float) -> None:
+        for g in self.groups:
+            fc.on_tick(g.store, int(time))
+            g.deliver_due(time)
+
+    # -- duties --
+    def _head_state(self, group: ViewGroup, slot: int):
+        head = fc.get_head(group.store)
+        return head, advance_state_to_slot(group.store.block_states[head], slot)
+
+    def _propose(self, slot: int) -> None:
+        t0 = self.slot_start(slot)
+        proposed: set[int] = set()
+        for group in self.groups:
+            head, head_state = self._head_state(group, slot)
+            proposer = get_beacon_proposer_index(head_state)
+            if proposer in proposed:
+                continue
+            if proposer not in set(int(v) for v in group.members):
+                continue
+            if int(proposer) in self.schedule.corrupted:
+                continue  # Byzantine proposers act via attack scripts
+            round_index = slot * self.cfg.intervals_per_slot
+            if not self.schedule.awake(round_index, int(proposer)):
+                continue
+            proposed.add(proposer)
+            atts = self._pack_attestations(group, slot)
+            sb = build_block(group.store.block_states[head], slot, attestations=atts)
+            for dst in self.groups:
+                delay = self.schedule.block_delay(int(proposer), slot, dst.id)
+                if delay is None:
+                    continue
+                dst.enqueue(t0 + delay, "block", sb)
+
+    def _pack_attestations(self, group: ViewGroup, slot: int) -> list:
+        c = self.cfg
+        out = []
+        head = fc.get_head(group.store)
+        head_state = group.store.block_states[head]
+        for att in group.pool.values():
+            a_slot = int(att.data.slot)
+            if not (a_slot + c.min_attestation_inclusion_delay <= slot
+                    <= a_slot + c.slots_per_epoch):
+                continue
+            out.append(att)
+            if len(out) >= c.max_attestations:
+                break
+        return out
+
+    def _attest(self, slot: int) -> None:
+        t_next = self.slot_start(slot + 1)
+        for group in self.groups:
+            head, head_state = self._head_state(group, slot)
+            honest = set(int(v) for v in self.schedule.honest_members(group.id))
+            if not honest:
+                continue
+            round_index = slot * self.cfg.intervals_per_slot + 1
+            awake = set(v for v in honest if self.schedule.awake(round_index, v))
+            if not awake:
+                continue
+            count = get_committee_count_per_slot(head_state, compute_epoch_at_slot(slot))
+            for index in range(count):
+                try:
+                    att = make_committee_attestation(
+                        head_state, slot, index, head,
+                        participants=np.array(sorted(awake), dtype=np.int64))
+                except ValueError:
+                    continue  # no awake member in this committee
+                for dst in self.groups:
+                    delay = self.schedule.attestation_delay(group.id, slot, dst.id)
+                    if delay is None:
+                        continue
+                    dst.enqueue(t_next + delay, "attestation", att)
+
+    # -- main loop --
+    def run_slot(self) -> None:
+        slot = self.slot
+        t0 = self.slot_start(slot)
+        self._tick_all(t0)
+        if slot > 0:
+            self._propose(slot)
+            self._tick_all(t0 + 1)  # timely blocks land within the boost window
+            self._tick_all(t0 + self.delta)
+            self._attest(slot)
+            self._tick_all(t0 + 2 * self.delta)
+        self._record_metrics(slot)
+        self.slot += 1
+
+    def run_until_slot(self, slot: int) -> None:
+        while self.slot <= slot:
+            self.run_slot()
+
+    def run_epochs(self, n_epochs: int) -> None:
+        self.run_until_slot(n_epochs * self.cfg.slots_per_epoch)
+
+    # -- observability (SURVEY.md §5: structured per-slot log) --
+    def _record_metrics(self, slot: int) -> None:
+        g0 = self.groups[0].store
+        head = fc.get_head(g0)
+        self.metrics.append({
+            "slot": slot,
+            "head": head.hex()[:8],
+            "head_slot": int(g0.blocks[head].slot),
+            "justified_epoch": int(g0.justified_checkpoint.epoch),
+            "finalized_epoch": int(g0.finalized_checkpoint.epoch),
+            "n_blocks": len(g0.blocks),
+            "equivocators": len(g0.equivocating_indices),
+        })
+
+    # -- accessors --
+    def store(self, group: int = 0) -> fc.Store:
+        return self.groups[group].store
+
+    def finalized_epoch(self, group: int = 0) -> int:
+        return int(self.groups[group].store.finalized_checkpoint.epoch)
+
+    def justified_epoch(self, group: int = 0) -> int:
+        return int(self.groups[group].store.justified_checkpoint.epoch)

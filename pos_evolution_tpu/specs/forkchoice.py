@@ -114,10 +114,11 @@ def get_checkpoint_block(store: Store, root: bytes, epoch: int) -> bytes:
 # --- weights ------------------------------------------------------------------
 
 def get_proposer_boost(store: Store) -> int:
-    """W/4 of one slot's committee weight (pos-evolution.md:1355)."""
+    """Boost fraction of one slot's committee weight W (pos-evolution.md:1355:
+    W/4 mainline; the attack analyses use 0.7W/0.8W)."""
     justified_state = store.checkpoint_states[store.justified_checkpoint.as_key()]
     committee_weight = get_total_active_balance(justified_state) // cfg().slots_per_epoch
-    return committee_weight // cfg().proposer_score_boost_quotient
+    return committee_weight * cfg().proposer_score_boost_percent // 100
 
 
 def get_latest_attesting_balance(store: Store, root: bytes) -> int:

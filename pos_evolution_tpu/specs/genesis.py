@@ -72,10 +72,11 @@ def make_genesis_state(n_validators: int, genesis_time: int = 0) -> BeaconState:
     state.genesis_validators_root = state.validators.__ssz_root__()
 
     # Seed the sync committees from the genesis registry (pos-evolution.md:542).
-    from pos_evolution_tpu.specs.helpers import get_next_sync_committee
-    committee = get_next_sync_committee(state)
-    state.current_sync_committee = committee
-    state.next_sync_committee = get_next_sync_committee(state)
+    if n_validators > 0:
+        from pos_evolution_tpu.specs.helpers import get_next_sync_committee
+        committee = get_next_sync_committee(state)
+        state.current_sync_committee = committee
+        state.next_sync_committee = get_next_sync_committee(state)
     return state
 
 

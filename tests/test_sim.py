@@ -1,0 +1,65 @@
+"""Simulation-driver tests (L6): honest runs, sleepy validators, partitions."""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config, use_config
+from pos_evolution_tpu.sim import Schedule, Simulation
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+class TestHonestRun:
+    def test_finalizes(self):
+        sim = Simulation(64)
+        sim.run_epochs(5)
+        assert sim.finalized_epoch() >= 3
+        assert sim.justified_epoch() >= 4
+
+    def test_one_block_per_slot(self):
+        sim = Simulation(64)
+        sim.run_epochs(2)
+        # anchor + one block per slot 1..16
+        assert sim.metrics[-1]["n_blocks"] == 2 * 8 + 1
+
+    def test_metrics_recorded(self):
+        sim = Simulation(32)
+        sim.run_until_slot(4)
+        assert [m["slot"] for m in sim.metrics] == [0, 1, 2, 3, 4]
+        assert all("head" in m and "finalized_epoch" in m for m in sim.metrics)
+
+
+class TestSleepyValidators:
+    def test_minority_asleep_still_finalizes(self):
+        """Dynamic availability: < 1/3 asleep must not stop finality
+        (pos-evolution.md:1184-1190 with beta_1 = 33%)."""
+        asleep = set(range(12))  # 12/64 < 1/3 offline
+
+        sched = Schedule(n_validators=64,
+                         awake=lambda r, v: v not in asleep)
+        sim = Simulation(64, schedule=sched)
+        sim.run_epochs(5)
+        assert sim.finalized_epoch() >= 2
+
+    def test_supermajority_asleep_halts_finality(self):
+        """> 1/3 asleep: the finalized chain must stall (plausible liveness
+        needs > 2/3 honest-and-awake, pos-evolution.md:243)."""
+        asleep = set(range(28))  # 28/64 > 1/3 offline
+        sched = Schedule(n_validators=64,
+                         awake=lambda r, v: v not in asleep)
+        sim = Simulation(64, schedule=sched)
+        sim.run_epochs(4)
+        assert sim.finalized_epoch() == 0
+
+    def test_wakeup_recovers_finality(self):
+        """Sleepy validators waking after 'GAT' lets finality catch up
+        (pos-evolution.md:199, 1186)."""
+        c = minimal_config()
+        gat_round = 2 * c.slots_per_epoch * c.intervals_per_slot
+        asleep = set(range(28))
+        sched = Schedule(
+            n_validators=64,
+            awake=lambda r, v: (v not in asleep) or r >= gat_round)
+        sim = Simulation(64, schedule=sched)
+        sim.run_epochs(6)
+        assert sim.finalized_epoch() >= 3

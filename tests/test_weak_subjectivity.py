@@ -1,0 +1,91 @@
+"""Weak subjectivity + long-range attack tests (pos-evolution.md:1198-1317)."""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import cfg, mainnet_config, minimal_config, use_config
+from pos_evolution_tpu.specs import forkchoice as fc
+from pos_evolution_tpu.specs.genesis import make_genesis
+from pos_evolution_tpu.specs.weak_subjectivity import (
+    compute_weak_subjectivity_period,
+    get_latest_weak_subjectivity_checkpoint_epoch,
+    is_within_weak_subjectivity_period,
+)
+from pos_evolution_tpu.specs.containers import Checkpoint
+from pos_evolution_tpu.specs.validator import build_block
+from pos_evolution_tpu.sim import Simulation
+from pos_evolution_tpu.ssz import hash_tree_root
+
+
+class TestWeakSubjectivityPeriod:
+    def test_mainnet_scale_magnitude(self):
+        """pos-evolution.md:1307-1313: ~3,277 epochs of churn margin at
+        262,144 validators with safety decay 10% (the reference's table; on
+        top of MIN_VALIDATOR_WITHDRAWABILITY_DELAY)."""
+        with use_config(mainnet_config()):
+            state, _ = make_genesis(0)
+            n = 262144
+            from pos_evolution_tpu.specs.containers import ValidatorRegistry
+            reg = ValidatorRegistry(n)
+            reg.effective_balance[:] = cfg().max_effective_balance
+            reg.activation_epoch[:] = 0
+            state.validators = reg
+            state.balances = np.full(n, cfg().max_effective_balance, dtype=np.uint64)
+            ws = compute_weak_subjectivity_period(state)
+            churn_margin = ws - cfg().min_validator_withdrawability_delay
+            assert 3200 <= churn_margin <= 3350, churn_margin
+
+    def test_monotonic_in_validator_count(self):
+        with use_config(mainnet_config()):
+            periods = []
+            from pos_evolution_tpu.specs.containers import ValidatorRegistry
+            for n in (8192, 65536, 262144):
+                state, _ = make_genesis(0)
+                reg = ValidatorRegistry(n)
+                reg.effective_balance[:] = cfg().max_effective_balance
+                reg.activation_epoch[:] = 0
+                state.validators = reg
+                state.balances = np.full(n, cfg().max_effective_balance,
+                                         dtype=np.uint64)
+                periods.append(compute_weak_subjectivity_period(state))
+            assert periods == sorted(periods)
+
+
+@pytest.mark.usefixtures("minimal_cfg")
+class TestLongRangeAttack:
+    def test_conflicting_history_rejected_after_finality(self):
+        """pos-evolution.md:1216-1217: blocks conflicting with the finalized
+        (weak-subjectivity) checkpoint are rejected outright."""
+        sim = Simulation(64)
+        sim.run_epochs(5)
+        store = sim.store()
+        assert sim.finalized_epoch() >= 3
+
+        # Long-range attacker: re-proposes an alternative block at slot 1
+        # from genesis using (still-valid) old keys.
+        attacker_block = build_block(sim.genesis_state, 1, graffiti=b"\x66" * 32)
+        with pytest.raises(AssertionError):
+            fc.on_block(store, attacker_block)
+
+    def test_checkpoint_sync_gate(self):
+        """is_within_weak_subjectivity_period accepts a fresh checkpoint and
+        rejects a stale one (pos-evolution.md:1293-1302)."""
+        sim = Simulation(64)
+        sim.run_epochs(2)
+        store = sim.store()
+        ws_state = sim.genesis_state.copy()
+        # the gate checks header.state_root == checkpoint.root (:1295)
+        ws_state.latest_block_header.state_root = b"\xcc" * 32
+        ws_checkpoint = Checkpoint(epoch=0, root=b"\xcc" * 32)
+        assert is_within_weak_subjectivity_period(store, ws_state, ws_checkpoint)
+        # push the clock far beyond the WS period
+        store.time += (compute_weak_subjectivity_period(ws_state) + 10) \
+            * cfg().slots_per_epoch * cfg().seconds_per_slot
+        assert not is_within_weak_subjectivity_period(store, ws_state, ws_checkpoint)
+
+    def test_ws_checkpoint_epoch_alignment(self):
+        sim = Simulation(64)
+        sim.run_epochs(4)
+        state = sim.store().block_states[fc.get_head(sim.store())]
+        epoch = get_latest_weak_subjectivity_checkpoint_epoch(state)
+        assert 0 <= epoch <= int(state.finalized_checkpoint.epoch)
