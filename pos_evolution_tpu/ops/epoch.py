@@ -1,0 +1,255 @@
+"""Dense epoch processing on device (north-star config #4).
+
+The full-registry sweeps of ``process_epoch`` (SURVEY.md §2.2, §2.8;
+pos-evolution.md:122-133, 793-852, 361-369) as one jitted pure function
+over a struct-of-arrays ``DenseRegistry``: justification/finalization
+tallies (masked reductions), inactivity scores, Altair flag rewards and
+penalties, the slashings penalty sweep, and the hysteresis effective-balance
+update, plus the participation-flag rotation.
+
+All integer arithmetic is int64 (exact Gwei semantics; differential tests
+assert bit-identical results against the NumPy spec oracle). Registry
+*churn* (activation queue/ejections, O(changes) per epoch) stays in the
+spec layer — the O(n) work is here.
+
+The sharded multi-chip version in ``parallel/sharded.py`` wraps these same
+functions in ``shard_map`` with ``psum`` over the validator axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from pos_evolution_tpu.config import (  # noqa: E402
+    PARTICIPATION_FLAG_WEIGHTS,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+    Config,
+)
+
+# FAR_FUTURE_EPOCH (2**64-1) does not fit int64; densification maps it to
+# this sentinel. All epoch comparisons behave identically.
+FAR_FUTURE_I64 = np.int64(2**62)
+
+
+class DenseRegistry(NamedTuple):
+    """Struct-of-arrays registry + per-epoch participation (the array level
+    of SURVEY.md §7)."""
+
+    effective_balance: jax.Array     # int64[N] Gwei
+    balance: jax.Array               # int64[N] Gwei
+    activation_epoch: jax.Array      # int64[N]
+    exit_epoch: jax.Array            # int64[N]
+    withdrawable_epoch: jax.Array    # int64[N]
+    slashed: jax.Array               # bool[N]
+    prev_flags: jax.Array            # uint8[N]
+    cur_flags: jax.Array             # uint8[N]
+    inactivity_scores: jax.Array     # int64[N]
+
+
+class EpochResult(NamedTuple):
+    registry: DenseRegistry
+    total_active_balance: jax.Array      # int64 scalar
+    prev_target_balance: jax.Array       # int64 scalar
+    cur_target_balance: jax.Array        # int64 scalar
+    justify_prev: jax.Array              # bool scalar
+    justify_cur: jax.Array               # bool scalar
+    new_justification_bits: jax.Array    # bool[4]
+    finalize_epoch: jax.Array            # int64 scalar (-1 = no finalization)
+
+
+def densify(state) -> DenseRegistry:
+    """Extract the dense arrays from a spec-level BeaconState (host)."""
+    reg = state.validators
+
+    def epochs(a):
+        a = a.astype(np.uint64)
+        out = np.where(a == np.uint64(2**64 - 1), np.uint64(FAR_FUTURE_I64), a)
+        return jnp.asarray(out.astype(np.int64))
+
+    return DenseRegistry(
+        effective_balance=jnp.asarray(reg.effective_balance.astype(np.int64)),
+        balance=jnp.asarray(state.balances.astype(np.int64)),
+        activation_epoch=epochs(reg.activation_epoch),
+        exit_epoch=epochs(reg.exit_epoch),
+        withdrawable_epoch=epochs(reg.withdrawable_epoch),
+        slashed=jnp.asarray(reg.slashed),
+        prev_flags=jnp.asarray(state.previous_epoch_participation),
+        cur_flags=jnp.asarray(state.current_epoch_participation),
+        inactivity_scores=jnp.asarray(state.inactivity_scores.astype(np.int64)),
+    )
+
+
+def isqrt_i64(x):
+    """Exact integer sqrt for non-negative int64 via float estimate + fixup."""
+    s = jnp.floor(jnp.sqrt(x.astype(jnp.float64))).astype(jnp.int64)
+    s = jnp.where((s + 1) * (s + 1) <= x, s + 1, s)
+    s = jnp.where(s * s > x, s - 1, s)
+    return s
+
+
+def _active(reg: DenseRegistry, epoch):
+    return (reg.activation_epoch <= epoch) & (epoch < reg.exit_epoch)
+
+
+def _has_flag(flags, idx: int):
+    return ((flags >> np.uint8(idx)) & np.uint8(1)).astype(bool)
+
+
+def _masked_sum(values, mask):
+    return jnp.sum(jnp.where(mask, values, 0))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def process_epoch_dense(reg: DenseRegistry,
+                        current_epoch,
+                        finalized_epoch,
+                        justification_bits,
+                        prev_justified_epoch,
+                        cur_justified_epoch,
+                        slashings_sum,
+                        cfg: Config) -> EpochResult:
+    """One epoch boundary over the dense registry.
+
+    Mirrors the spec-layer pipeline order exactly: justification tallies ->
+    inactivity updates -> rewards/penalties (using the *new* inactivity
+    scores) -> slashings sweep -> hysteresis -> flag rotation.
+    """
+    current_epoch = jnp.asarray(current_epoch, dtype=jnp.int64)
+    prev_epoch = jnp.maximum(current_epoch - 1, 0)
+    incr = np.int64(cfg.effective_balance_increment)
+
+    active_cur = _active(reg, current_epoch)
+    active_prev = _active(reg, prev_epoch)
+    eff = reg.effective_balance
+
+    total_active = jnp.maximum(incr, _masked_sum(eff, active_cur))
+
+    # --- justification tallies (pos-evolution.md:793-803) ---
+    prev_target_mask = (active_prev
+                        & _has_flag(reg.prev_flags, TIMELY_TARGET_FLAG_INDEX)
+                        & ~reg.slashed)
+    cur_target_mask = (active_cur
+                       & _has_flag(reg.cur_flags, TIMELY_TARGET_FLAG_INDEX)
+                       & ~reg.slashed)
+    prev_target = jnp.maximum(incr, _masked_sum(eff, prev_target_mask))
+    cur_target = jnp.maximum(incr, _masked_sum(eff, cur_target_mask))
+
+    past_genesis = current_epoch > 1
+    justify_prev = past_genesis & (prev_target * 3 >= total_active * 2)
+    justify_cur = past_genesis & (cur_target * 3 >= total_active * 2)
+
+    # Shift bits and apply the 2/3 rules (pos-evolution.md:827-837).
+    bits = justification_bits
+    new_bits = jnp.where(
+        past_genesis,
+        jnp.stack([justify_cur, justify_prev | bits[0], bits[1], bits[2]]),
+        bits)
+
+    # 4-case 2-finalization on epoch numbers (pos-evolution.md:842-851);
+    # the caller maps the winning epoch back to its checkpoint root.
+    new_prev_just = jnp.where(past_genesis, cur_justified_epoch, prev_justified_epoch)
+    old_prev, old_cur = prev_justified_epoch, cur_justified_epoch
+    fin = jnp.int64(-1)
+    fin = jnp.where(new_bits[1] & new_bits[2] & new_bits[3]
+                    & (old_prev + 3 == current_epoch), old_prev, fin)
+    fin = jnp.where(new_bits[1] & new_bits[2]
+                    & (old_prev + 2 == current_epoch), old_prev, fin)
+    fin = jnp.where(new_bits[0] & new_bits[1] & new_bits[2]
+                    & (old_cur + 2 == current_epoch), old_cur, fin)
+    fin = jnp.where(new_bits[0] & new_bits[1]
+                    & (old_cur + 1 == current_epoch), old_cur, fin)
+    fin = jnp.where(past_genesis, fin, jnp.int64(-1))
+
+    # --- inactivity scores (pos-evolution.md:369) ---
+    eligible = active_prev | (reg.slashed & (prev_epoch + 1 < reg.withdrawable_epoch))
+    target_participating = prev_target_mask
+    finality_delay = prev_epoch - finalized_epoch
+    in_leak = finality_delay > 4
+    scores = reg.inactivity_scores
+    scores = jnp.where(eligible & target_participating,
+                       jnp.maximum(scores - 1, 0), scores)
+    scores = jnp.where(eligible & ~target_participating,
+                       scores + np.int64(cfg.inactivity_score_bias), scores)
+    scores = jnp.where(~in_leak & eligible,
+                       scores - jnp.minimum(
+                           scores, np.int64(cfg.inactivity_score_recovery_rate)),
+                       scores)
+    new_scores = jnp.where(current_epoch > 0, scores, reg.inactivity_scores)
+
+    # --- rewards & penalties (Altair flag deltas) ---
+    base_reward = (eff // incr) * (
+        incr * np.int64(cfg.base_reward_factor) // isqrt_i64(total_active))
+    active_increments = total_active // incr
+
+    rewards = jnp.zeros_like(eff)
+    penalties = jnp.zeros_like(eff)
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        participating = (active_prev
+                         & _has_flag(reg.prev_flags, flag_index)
+                         & ~reg.slashed)
+        participating_increments = _masked_sum(eff, participating) // incr
+        numer = base_reward * np.int64(weight) * participating_increments
+        denom = active_increments * np.int64(WEIGHT_DENOMINATOR)
+        rewards = rewards + jnp.where(~in_leak & eligible & participating,
+                                      numer // denom, 0)
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties = penalties + jnp.where(
+                eligible & ~participating,
+                base_reward * np.int64(weight) // np.int64(WEIGHT_DENOMINATOR), 0)
+
+    inactivity_penalty = (eff * new_scores
+                          // np.int64(cfg.inactivity_score_bias
+                                      * cfg.inactivity_penalty_quotient))
+    penalties = penalties + jnp.where(eligible & ~target_participating,
+                                      inactivity_penalty, 0)
+    new_balance = jnp.where(current_epoch > 0,
+                            jnp.maximum(reg.balance + rewards - penalties, 0),
+                            reg.balance)
+
+    # --- slashings sweep (proportional penalties) ---
+    vector_half = np.int64(cfg.epochs_per_slashings_vector // 2)
+    adjusted_total = jnp.minimum(
+        slashings_sum * np.int64(cfg.proportional_slashing_multiplier), total_active)
+    hit = reg.slashed & (current_epoch + vector_half == reg.withdrawable_epoch)
+    slash_penalty = (eff // incr * adjusted_total) // total_active * incr
+    new_balance = jnp.maximum(new_balance - jnp.where(hit, slash_penalty, 0), 0)
+
+    # --- hysteresis effective-balance update (pos-evolution.md:122-133) ---
+    h_incr = np.int64(cfg.effective_balance_increment // cfg.hysteresis_quotient)
+    downward = h_incr * np.int64(cfg.hysteresis_downward_multiplier)
+    upward = h_incr * np.int64(cfg.hysteresis_upward_multiplier)
+    needs = ((new_balance + downward < eff) | (eff + upward < new_balance))
+    new_eff = jnp.where(
+        needs,
+        jnp.minimum(new_balance - new_balance % incr,
+                    np.int64(cfg.max_effective_balance)),
+        eff)
+
+    new_reg = reg._replace(
+        effective_balance=new_eff,
+        balance=new_balance,
+        inactivity_scores=new_scores,
+        prev_flags=reg.cur_flags,
+        cur_flags=jnp.zeros_like(reg.cur_flags),
+    )
+    return EpochResult(
+        registry=new_reg,
+        total_active_balance=total_active,
+        prev_target_balance=prev_target,
+        cur_target_balance=cur_target,
+        justify_prev=justify_prev,
+        justify_cur=justify_cur,
+        new_justification_bits=new_bits,
+        finalize_epoch=fin,
+    )

@@ -1,0 +1,102 @@
+"""SHA-256 on device (JAX/XLA): uint32-lane compression for TPU (N2).
+
+The TPU formulation of the batched SHA-256 in ``ssz/hash.py``: messages are
+prepared as (N, 16*blocks) big-endian uint32 word arrays (padding included),
+and the 64-round compression runs unrolled under ``jit`` as pure uint32
+vector arithmetic on the VPU — one lane per message. Used by the shuffle
+kernel (pos-evolution.md:522-530) and the merkleization kernel.
+
+uint32 add/xor/shift are native VPU ops; there is no u64 anywhere in the
+compression, which is exactly why SHA-256 maps well onto the TPU vector
+unit (SURVEY.md §2.7 N2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+# Exact Gwei/epoch integer semantics across all device kernels (balances sum
+# to ~2^55 at mainnet scale); the differential tests assert bit-equality
+# with the NumPy oracle.
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+_K = np.array([
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+    0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+    0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+    0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+    0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+    0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+], dtype=np.uint32)
+
+H0 = np.array([
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+], dtype=np.uint32)
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def sha256_compress(state, block_words):
+    """One compression: state (..., 8) u32, block_words (..., 16) u32."""
+    w = [block_words[..., t] for t in range(16)]
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
+        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+
+    a, b, c, d, e, f, g, h = [state[..., i] for i in range(8)]
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + np.uint32(_K[t]) + w[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+
+    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+    return state + out
+
+
+def sha256_words(msg_words):
+    """SHA-256 over pre-padded messages: (N, 16*blocks) u32 -> (N, 8) u32."""
+    n_blocks = msg_words.shape[-1] // 16
+    state = jnp.broadcast_to(jnp.asarray(H0), msg_words.shape[:-1] + (8,))
+    for b in range(n_blocks):
+        state = sha256_compress(state, msg_words[..., b * 16:(b + 1) * 16])
+    return state
+
+
+def sha256_pair_words(left, right):
+    """Merkle combiner: H(left || right) where left/right are (N, 8) u32
+    digest words. 64-byte message = one padded second block."""
+    n = left.shape[0]
+    pad = jnp.zeros((n, 16), dtype=jnp.uint32)
+    pad = pad.at[:, 0].set(np.uint32(0x80000000))
+    pad = pad.at[:, 15].set(np.uint32(512))
+    state = sha256_compress(
+        jnp.broadcast_to(jnp.asarray(H0), (n, 8)),
+        jnp.concatenate([left, right], axis=-1))
+    return sha256_compress(state, pad)
+
+
+def bytes_to_words(data: bytes) -> np.ndarray:
+    """Host helper: big-endian u32 words of a byte string (len % 4 == 0)."""
+    return np.frombuffer(data, dtype=">u4").astype(np.uint32)
+
+
+def words_to_digest(words: np.ndarray) -> bytes:
+    """Host helper: (8,) u32 state -> 32-byte digest."""
+    return np.asarray(words, dtype=np.uint32).astype(">u4").tobytes()

@@ -1,0 +1,84 @@
+"""Swap-or-not shuffle on device (north-star config #2).
+
+The reference's ``compute_shuffled_index`` (pos-evolution.md:513-535) runs
+O(SHUFFLE_ROUND_COUNT) hashes per validator. Here the whole registry is
+shuffled at once: a ``lax.fori_loop`` over the rounds (SURVEY.md §2.8),
+where each round hashes only ceil(n/256) position blocks with the vectorized
+SHA-256 and applies the flip decision to all indices in parallel — the
+round hash results are shared across all validators in the same 256-index
+position block.
+
+Round pivots depend only on (seed, round) and are precomputed on host;
+everything shape-dependent runs under ``jit`` with static (n, rounds).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pos_evolution_tpu.ops.sha256 import sha256_words
+from pos_evolution_tpu.ssz.hash import hash_eth2
+
+
+def host_pivots(seed: bytes, n: int, rounds: int) -> np.ndarray:
+    """pivot[r] = bytes_to_uint64(H(seed | r)[:8]) % n (pos-evolution.md:522)."""
+    return np.array(
+        [int.from_bytes(hash_eth2(seed + bytes([r]))[:8], "little") % n
+         for r in range(rounds)],
+        dtype=np.int64)
+
+
+def _seed_words(seed: bytes) -> np.ndarray:
+    return np.frombuffer(seed, dtype=">u4").astype(np.uint32)
+
+
+@partial(jax.jit, static_argnames=("n", "rounds"))
+def _shuffle_device(seed_words, pivots, n: int, rounds: int):
+    """Full permutation: returns p with p[i] = shuffled index of i."""
+    n_blocks = (n + 255) // 256
+    idx0 = jnp.arange(n, dtype=jnp.int32)
+
+    # Static message template for the per-round block hashes:
+    # bytes = seed(32) | round(1) | block_le(4) | 0x80 | zeros | len(296 bits)
+    block_ids = jnp.arange(n_blocks, dtype=jnp.uint32)
+    b0 = block_ids & 0xFF
+    b1 = (block_ids >> 8) & 0xFF
+    b2 = (block_ids >> 16) & 0xFF
+    b3 = (block_ids >> 24) & 0xFF
+
+    base = jnp.zeros((n_blocks, 16), dtype=jnp.uint32)
+    base = base.at[:, 0:8].set(jnp.broadcast_to(seed_words, (n_blocks, 8)))
+    base = base.at[:, 9].set((b3 << 24) | np.uint32(0x00800000))
+    base = base.at[:, 15].set(np.uint32(37 * 8))
+
+    def round_body(r, idx):
+        pivot = pivots[r]
+        flip = (pivot - idx.astype(jnp.int64)) % n
+        flip = flip.astype(jnp.int32)
+        pos = jnp.maximum(idx, flip)
+        # word 8 = round_byte<<24 | b0<<16 | b1<<8 | b2
+        r32 = r.astype(jnp.uint32)
+        msgs = base.at[:, 8].set((r32 << 24) | (b0 << 16) | (b1 << 8) | b2)
+        digests = sha256_words(msgs)  # (n_blocks, 8) u32, big-endian words
+        # byte k of the digest lives in word k>>2 at big-endian lane 24-8*(k&3)
+        k = (pos & 0xFF) >> 3
+        word = digests[pos >> 8, k >> 2]
+        byte = (word >> (np.uint32(24) - ((k.astype(jnp.uint32) & 3) << 3))) & 0xFF
+        bit = (byte >> (pos.astype(jnp.uint32) & 7)) & 1
+        return jnp.where(bit.astype(bool), flip, idx)
+
+    return jax.lax.fori_loop(0, rounds, round_body, idx0)
+
+
+def shuffle_permutation_jax(seed: bytes, n: int, rounds: int) -> jax.Array:
+    """Device permutation equivalent to the reference's per-index shuffle."""
+    if n == 0:
+        return jnp.zeros(0, dtype=jnp.int32)
+    return _shuffle_device(jnp.asarray(_seed_words(seed)),
+                           jnp.asarray(host_pivots(seed, n, rounds)),
+                           n, rounds)

@@ -1,0 +1,165 @@
+"""Differential tests: JAX/XLA kernels vs the NumPy/spec oracle
+(SURVEY.md §4.4b: identical inputs must give bit-identical outputs).
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config, use_config
+
+jax = pytest.importorskip("jax")
+
+
+class TestSha256Device:
+    def test_single_block_matches_hashlib(self):
+        import hashlib
+        from pos_evolution_tpu.ops.sha256 import sha256_words, words_to_digest
+        msg = b"\xab" * 37
+        padded = bytearray(64)
+        padded[:37] = msg
+        padded[37] = 0x80
+        padded[62:64] = (37 * 8).to_bytes(2, "big")
+        words = np.frombuffer(bytes(padded), dtype=">u4").astype(np.uint32)
+        out = sha256_words(jax.numpy.asarray(words[None, :]))
+        assert words_to_digest(np.asarray(out)[0]) == hashlib.sha256(msg).digest()
+
+    def test_pair_words_matches_merkle_combiner(self):
+        import hashlib
+        from pos_evolution_tpu.ops.sha256 import sha256_pair_words, words_to_digest
+        left = np.frombuffer(b"\x01" * 32, dtype=">u4").astype(np.uint32)
+        right = np.frombuffer(b"\x02" * 32, dtype=">u4").astype(np.uint32)
+        out = sha256_pair_words(jax.numpy.asarray(left[None]),
+                                jax.numpy.asarray(right[None]))
+        assert words_to_digest(np.asarray(out)[0]) == \
+            hashlib.sha256(b"\x01" * 32 + b"\x02" * 32).digest()
+
+
+class TestShuffleDevice:
+    @pytest.mark.parametrize("n,rounds", [(64, 10), (100, 90), (2048, 90)])
+    def test_matches_numpy_backend(self, n, rounds):
+        from pos_evolution_tpu.backend.numpy_backend import shuffle_permutation
+        from pos_evolution_tpu.ops.shuffle import shuffle_permutation_jax
+        seed = bytes(range(32))
+        got = np.asarray(shuffle_permutation_jax(seed, n, rounds)).astype(np.uint64)
+        want = shuffle_permutation(seed, n, rounds)
+        assert np.array_equal(got, want)
+
+    def test_matches_scalar_spec(self, minimal_cfg):
+        from pos_evolution_tpu.ops.shuffle import shuffle_permutation_jax
+        from pos_evolution_tpu.specs.helpers import compute_shuffled_index
+        seed = b"\x5a" * 32
+        got = np.asarray(shuffle_permutation_jax(seed, 64, minimal_cfg.shuffle_round_count))
+        want = [compute_shuffled_index(i, 64, seed) for i in range(64)]
+        assert got.tolist() == want
+
+    def test_is_permutation(self):
+        from pos_evolution_tpu.ops.shuffle import shuffle_permutation_jax
+        got = np.asarray(shuffle_permutation_jax(b"\x07" * 32, 1000, 90))
+        assert sorted(got.tolist()) == list(range(1000))
+
+
+def _random_dense_state(n=128, seed=0, epoch=9):
+    """A spec BeaconState with adversarially varied registry columns."""
+    from pos_evolution_tpu.specs.genesis import make_genesis
+    rng = np.random.default_rng(seed)
+    state, _ = make_genesis(n)
+    gwei = 10**9
+    state.slot = (epoch + 1) * minimal_config().slots_per_epoch - 1
+    reg = state.validators
+    state.balances = rng.integers(16 * gwei, 40 * gwei, n).astype(np.uint64)
+    reg.effective_balance = (np.minimum(state.balances // gwei, 32) * gwei).astype(np.uint64)
+    reg.slashed = rng.random(n) < 0.05
+    # a few exited / not-yet-active validators
+    reg.exit_epoch[rng.random(n) < 0.05] = epoch - 1
+    reg.activation_epoch[rng.random(n) < 0.05] = epoch + 2
+    # slashed validators about to hit the proportional penalty sweep
+    half = minimal_config().epochs_per_slashings_vector // 2
+    sweep = rng.random(n) < 0.03
+    reg.slashed |= sweep
+    reg.withdrawable_epoch[sweep] = epoch + half
+    state.previous_epoch_participation = rng.integers(0, 8, n).astype(np.uint8)
+    state.current_epoch_participation = rng.integers(0, 8, n).astype(np.uint8)
+    state.inactivity_scores = rng.integers(0, 50, n).astype(np.uint64)
+    state.justification_bits = rng.random(4) < 0.5
+    state.slashings[:] = rng.integers(0, 64 * gwei, state.slashings.shape[0])
+    from pos_evolution_tpu.specs.containers import Checkpoint
+    state.previous_justified_checkpoint = Checkpoint(epoch=epoch - 2, root=b"\x02" * 32)
+    state.current_justified_checkpoint = Checkpoint(epoch=epoch - 1, root=b"\x01" * 32)
+    state.finalized_checkpoint = Checkpoint(epoch=epoch - 3, root=b"\x03" * 32)
+    state.block_roots = rng.integers(0, 255, state.block_roots.shape).astype(np.uint8)
+    return state
+
+
+class TestDenseEpochDifferential:
+    """process_epoch_dense must be bit-identical to the spec pipeline."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_matches_spec_pipeline(self, minimal_cfg, seed):
+        from pos_evolution_tpu.ops.epoch import densify, process_epoch_dense
+        from pos_evolution_tpu.specs import epoch as spec_epoch
+        from pos_evolution_tpu.specs.helpers import get_current_epoch
+
+        state = _random_dense_state(n=128, seed=seed)
+        dense = densify(state)
+        current_epoch = get_current_epoch(state)
+        bits_before = state.justification_bits.copy()
+        prev_j = int(state.previous_justified_checkpoint.epoch)
+        cur_j = int(state.current_justified_checkpoint.epoch)
+        fin_before = int(state.finalized_checkpoint.epoch)
+        slashings_sum = int(state.slashings.sum())
+
+        # --- spec pipeline (mutates the state) ---
+        spec_epoch.process_justification_and_finalization(state)
+        spec_epoch.process_inactivity_updates(state)
+        spec_epoch.process_rewards_and_penalties(state)
+        spec_epoch.process_slashings(state)
+        spec_epoch.process_effective_balance_updates(state)
+        spec_epoch.process_participation_flag_updates(state)
+
+        # --- dense kernel ---
+        out = process_epoch_dense(dense, current_epoch, fin_before,
+                                  jax.numpy.asarray(bits_before),
+                                  prev_j, cur_j, slashings_sum, minimal_cfg)
+        reg = out.registry
+
+        assert np.array_equal(np.asarray(reg.balance),
+                              state.balances.astype(np.int64)), "balances diverge"
+        assert np.array_equal(np.asarray(reg.effective_balance),
+                              state.validators.effective_balance.astype(np.int64))
+        assert np.array_equal(np.asarray(reg.inactivity_scores),
+                              state.inactivity_scores.astype(np.int64))
+        assert np.array_equal(np.asarray(reg.prev_flags),
+                              state.previous_epoch_participation)
+        assert np.array_equal(np.asarray(out.new_justification_bits),
+                              state.justification_bits)
+        fin = int(out.finalize_epoch)
+        expect_fin = int(state.finalized_checkpoint.epoch)
+        if fin >= 0:
+            assert fin == expect_fin
+        else:
+            assert expect_fin == fin_before
+
+    def test_justification_thresholds(self, minimal_cfg):
+        """2/3 boundary must behave identically at the exact threshold."""
+        from pos_evolution_tpu.ops.epoch import densify, process_epoch_dense
+        state = _random_dense_state(n=60, seed=7)
+        gwei = 10**9
+        # all active, equal balances; exactly 40/60 target-participating
+        reg = state.validators
+        reg.slashed[:] = False
+        reg.exit_epoch[:] = 2**64 - 1
+        reg.activation_epoch[:] = 0
+        reg.effective_balance[:] = 32 * gwei
+        state.balances[:] = 32 * gwei
+        state.previous_epoch_participation[:] = 0
+        state.previous_epoch_participation[:40] = 0b010  # timely target
+        state.current_epoch_participation[:] = 0
+        dense = densify(state)
+        out = process_epoch_dense(dense, 9, 6,
+                                  jax.numpy.asarray(np.zeros(4, dtype=bool)),
+                                  7, 8, 0, minimal_cfg)
+        assert bool(out.justify_prev)   # 40*3 >= 60*2
+        out2 = process_epoch_dense(
+            dense._replace(prev_flags=dense.prev_flags.at[39].set(0)),
+            9, 6, jax.numpy.asarray(np.zeros(4, dtype=bool)), 7, 8, 0, minimal_cfg)
+        assert not bool(out2.justify_prev)  # 39*3 < 60*2
