@@ -1,0 +1,128 @@
+"""North-star benchmark (BASELINE.md): one mainnet-scale epoch of
+attestation aggregation + fork choice at 1M validators, on one chip.
+
+Workload per epoch (the reference's own protocol shape):
+- attestation aggregation: 2048 committee aggregates (64 committees x 32
+  slots, pos-evolution.md:472-475) covering ~1M signers, batch-verified on
+  device (config #3; fake-BLS pipeline shape — gather/hash/XOR-reduce);
+- fork choice: 32 per-slot get_head passes over a 64-block tree with the
+  full 1M-entry latest-message table (config #1);
+- plus the epoch-boundary registry sweep (config #4).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline = (1 s target) / measured — >1 means faster than the north-star
+target of <1 s on a TPU v5e (BASELINE.json).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from pos_evolution_tpu.config import mainnet_config
+    from pos_evolution_tpu.ops.aggregation import aggregate_verify_batch
+    from pos_evolution_tpu.ops.epoch import DenseRegistry, process_epoch_dense
+    from pos_evolution_tpu.ops.forkchoice import DenseStore, head_and_weights
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    n = 1_000_000 if on_accel else 65_536  # CPU smoke-run scales down
+    slots = 32
+    committees_per_slot = 64
+    a_total = slots * committees_per_slot           # 2048 aggregates
+    lanes = max(n // a_total, 1)                    # ~512 signers per aggregate
+    capacity = 64                                   # fork-choice tree size
+    gwei = 10**9
+    cfg = mainnet_config()
+    rng = np.random.default_rng(0)
+
+    # --- inputs ---
+    reg = DenseRegistry(
+        effective_balance=jnp.asarray(np.full(n, 32 * gwei, np.int64)),
+        balance=jnp.asarray(rng.integers(31 * gwei, 33 * gwei, n).astype(np.int64)),
+        activation_epoch=jnp.zeros(n, jnp.int64),
+        exit_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
+        withdrawable_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
+        slashed=jnp.zeros(n, bool),
+        prev_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+        cur_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+        inactivity_scores=jnp.zeros(n, jnp.int64),
+    )
+    bits = jnp.zeros(4, bool)
+
+    pk_states = jnp.asarray(
+        rng.integers(0, 2**32, (n, 8), dtype=np.uint64).astype(np.uint32))
+    committees = jnp.asarray(
+        rng.permutation(n)[: a_total * lanes].reshape(a_total, lanes).astype(np.int32))
+    agg_bits = jnp.asarray(rng.random((a_total, lanes)) < 0.99)
+    messages = jnp.asarray(
+        rng.integers(0, 2**32, (a_total, 8), dtype=np.uint64).astype(np.uint32))
+    signatures = jnp.asarray(rng.integers(0, 2**32, (a_total, 24), dtype=np.uint64)
+                             .astype(np.uint32))
+
+    parent = np.arange(-1, capacity - 1, dtype=np.int32)
+    store = DenseStore(
+        parent=jnp.asarray(parent),
+        slot=jnp.arange(capacity, dtype=jnp.int32),
+        rank=jnp.asarray(rng.permutation(capacity).astype(np.int32)),
+        real=jnp.ones(capacity, bool),
+        leaf_viable=jnp.ones(capacity, bool),
+        justified_idx=jnp.int32(0),
+        msg_block=jnp.asarray(rng.integers(0, capacity, n).astype(np.int32)),
+        msg_epoch=jnp.zeros(n, jnp.int64),
+        weight=reg.effective_balance,
+        boost_idx=jnp.int32(capacity - 1),
+        boost_amount=jnp.int64(32 * gwei * (n // 32) // 4),
+    )
+
+    def one_epoch(salt: int):
+        # Inputs vary with `salt` so no execution-cache layer (e.g. the axon
+        # relay) can replay results; costs are unchanged.
+        outs = []
+        outs.append(aggregate_verify_batch(
+            pk_states, committees, agg_bits,
+            messages.at[0, 0].set(np.uint32(salt)), signatures))
+        for s in range(slots):
+            st = store._replace(
+                msg_epoch=store.msg_epoch.at[0].set(np.int64(salt * slots + s)),
+                boost_idx=jnp.int32((salt * slots + s) % capacity))
+            h, w = head_and_weights(st, capacity)
+            outs.append(h)
+        outs.append(process_epoch_dense(
+            reg._replace(balance=reg.balance.at[0].set(np.int64(31 * gwei + salt))),
+            10, 8, bits, 8, 9, 0, cfg))
+        return outs
+
+    # warmup / compile
+    jax.block_until_ready(one_epoch(0))
+    # measure
+    reps = 3
+    times = []
+    for r in range(1, reps + 1):
+        t0 = time.perf_counter()
+        jax.block_until_ready(one_epoch(r))
+        times.append(time.perf_counter() - t0)
+    t = float(np.median(times))
+    if not on_accel:
+        # normalize the CPU smoke-run to the full validator count so the
+        # metric stays comparable in spirit (linear in n)
+        t = t * (1_000_000 / n)
+
+    print(json.dumps({
+        "metric": "epoch_1m_validators_aggregation_plus_forkchoice",
+        "value": round(t, 6),
+        "unit": "s",
+        "vs_baseline": round(1.0 / t, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,9 +48,14 @@ class FakeBLS:
         h = hashlib.sha256(b"fakebls-pk" + int(sk).to_bytes(32, "little")).digest()
         return (h + h[:16])  # 48 bytes
 
+    # 16-byte prefix: the first SHA-256 block of (prefix | pubkey) is then
+    # exactly 64 bytes and depends only on the pubkey, so the TPU batch
+    # kernel (ops/aggregation.py) precomputes it once per validator.
+    SIG_PREFIX = b"fakebls-sig-pad!"
+
     @staticmethod
     def _sig_for(pubkey: bytes, message: bytes) -> bytes:
-        h1 = hashlib.sha256(b"fakebls-sig" + pubkey + message).digest()
+        h1 = hashlib.sha256(FakeBLS.SIG_PREFIX + pubkey + message).digest()
         h2 = hashlib.sha256(h1).digest()
         h3 = hashlib.sha256(h2).digest()
         return h1 + h2 + h3  # 96 bytes

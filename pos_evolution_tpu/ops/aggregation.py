@@ -1,0 +1,127 @@
+"""Batched attestation aggregation + aggregate-verify on device (config #3).
+
+The reference aggregates one BLS signature per committee over
+``aggregation_bits`` (pos-evolution.md:714-717) and verifies with
+``FastAggregateVerify``; at mainnet scale that is ~1M signers across 2048
+committee aggregates per epoch (64 committees x 32 slots, :472-475).
+
+This kernel runs the whole epoch's verification as one batched pipeline:
+gather signer pubkeys by committee index, compute each signer's signature
+contribution, mask by the aggregation bitlists, XOR-reduce per committee
+(segment reduction), and compare against the provided aggregates.
+
+The signature scheme behind the pipeline is the crypto backend's: here the
+deterministic ``FakeBLS`` (sha256-based, XOR aggregation — bit-identical to
+``crypto/bls.py``), giving the full memory/gather/reduce shape of the real
+pipeline. The BLS12-381 pairing kernel (N1) drops into the same interface.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from pos_evolution_tpu.ops.sha256 import H0, sha256_compress, sha256_words  # noqa: E402
+
+_PREFIX = b"fakebls-sig-pad!"  # matches crypto/bls.py FakeBLS.SIG_PREFIX
+
+
+def _chain_hash(words):
+    """H(digest) for (..., 8) u32 digest words (32-byte message, 1 block)."""
+    shape = words.shape[:-1]
+    blk = jnp.zeros(shape + (16,), dtype=jnp.uint32)
+    blk = blk.at[..., 0:8].set(words)
+    blk = blk.at[..., 8].set(np.uint32(0x80000000))
+    blk = blk.at[..., 15].set(np.uint32(256))
+    return sha256_words(blk)
+
+
+def precompute_pk_states(pubkeys_u8: np.ndarray) -> jax.Array:
+    """Per-validator midstate: SHA-256 state after (prefix | pubkey), the
+    first 64-byte block of every signature this validator ever makes.
+    pubkeys_u8: (N, 48) uint8 -> (N, 8) uint32. Computed once per registry.
+    """
+    n = pubkeys_u8.shape[0]
+    block = np.zeros((n, 64), dtype=np.uint8)
+    block[:, 0:16] = np.frombuffer(_PREFIX, dtype=np.uint8)
+    block[:, 16:64] = pubkeys_u8
+    words = block.reshape(n, 16, 4)
+    w32 = ((words[..., 0].astype(np.uint32) << 24)
+           | (words[..., 1].astype(np.uint32) << 16)
+           | (words[..., 2].astype(np.uint32) << 8)
+           | words[..., 3].astype(np.uint32))
+    state = jnp.broadcast_to(jnp.asarray(H0), (n, 8))
+    return sha256_compress(state, jnp.asarray(w32))
+
+
+def _msg_block2(msg_words):
+    """Second signature block: msg(32) | 0x80 pad | length(96 bytes).
+    msg_words (..., 8) u32 -> (..., 16) u32."""
+    shape = msg_words.shape[:-1]
+    blk = jnp.zeros(shape + (16,), dtype=jnp.uint32)
+    blk = blk.at[..., 0:8].set(msg_words)
+    blk = blk.at[..., 8].set(np.uint32(0x80000000))
+    blk = blk.at[..., 15].set(np.uint32(96 * 8))
+    return blk
+
+
+@jax.jit
+def aggregate_verify_batch(pk_states, committees, bits, msg_words, signatures):
+    """Verify A committee aggregates at once.
+
+    pk_states  (N, 8) uint32 — per-validator signature midstates
+               (``precompute_pk_states``, refreshed only on registry change)
+    committees (A, C) int32  — validator index per committee lane
+    bits       (A, C) bool   — aggregation bitlists
+    msg_words  (A, 8) uint32 — signing roots per attestation (u32 words)
+    signatures (A, 24) uint32 — provided aggregate signature words
+    Returns bool[A].
+
+    Per signer: one schedule-shared compression (the message block is per
+    attestation, so its schedule is computed once per committee and
+    broadcast over lanes) + two chain hashes — the fake-scheme analogue of
+    the per-signer pairing work a real BLS kernel does.
+    """
+    a, c = committees.shape
+    states = pk_states[committees]                    # (A, C, 8)
+    block2 = _msg_block2(msg_words)[:, None, :]       # (A, 1, 16) broadcast
+    h1 = sha256_compress(states, jnp.broadcast_to(block2, (a, c, 16)))
+    h2 = _chain_hash(h1)
+    h3 = _chain_hash(h2)
+    sigs = jnp.concatenate([h1, h2, h3], axis=-1)     # (A, C, 24)
+    masked = jnp.where(bits[..., None], sigs, 0)
+    agg = jax.lax.reduce(masked, np.uint32(0),
+                         jax.lax.bitwise_xor, dimensions=(1,))
+    return (agg == signatures).all(axis=-1) & bits.any(axis=-1)
+
+
+def messages_to_words(messages_u8: np.ndarray) -> np.ndarray:
+    """Host helper: (A, 32) uint8 signing roots -> (A, 8) big-endian u32."""
+    q = messages_u8.reshape(-1, 8, 4).astype(np.uint32)
+    return (q[..., 0] << 24) | (q[..., 1] << 16) | (q[..., 2] << 8) | q[..., 3]
+
+
+@jax.jit
+def aggregate_bits_and_weights(bits, committee_weights):
+    """Aggregation duty (pos-evolution.md:474-475): OR-combine bitlists and
+    tally participating weight per committee.
+
+    bits (A, C) bool, committee_weights (A, C) int64 -> (participation
+    counts int32[A], participating weight int64[A]).
+    """
+    count = bits.sum(axis=-1, dtype=jnp.int32)
+    weight = jnp.where(bits, committee_weights, 0).sum(axis=-1)
+    return count, weight
+
+
+def pack_signature_words(sig_bytes_list) -> np.ndarray:
+    """Host helper: list of 96-byte signatures -> (A, 24) u32 words."""
+    raw = np.frombuffer(b"".join(bytes(s) for s in sig_bytes_list), dtype=">u4")
+    return raw.astype(np.uint32).reshape(len(sig_bytes_list), 24)

@@ -110,20 +110,29 @@ def _masked_sum(values, mask):
     return jnp.sum(jnp.where(mask, values, 0))
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def process_epoch_dense(reg: DenseRegistry,
-                        current_epoch,
-                        finalized_epoch,
-                        justification_bits,
-                        prev_justified_epoch,
-                        cur_justified_epoch,
-                        slashings_sum,
-                        cfg: Config) -> EpochResult:
+def _identity(x):
+    return x
+
+
+def epoch_core(reg: DenseRegistry,
+               current_epoch,
+               finalized_epoch,
+               justification_bits,
+               prev_justified_epoch,
+               cur_justified_epoch,
+               slashings_sum,
+               cfg: Config,
+               reduce_fn=_identity) -> EpochResult:
     """One epoch boundary over the dense registry.
 
     Mirrors the spec-layer pipeline order exactly: justification tallies ->
     inactivity updates -> rewards/penalties (using the *new* inactivity
     scores) -> slashings sweep -> hysteresis -> flag rotation.
+
+    ``reduce_fn`` wraps every registry-wide scalar reduction. Identity on a
+    single chip; ``lax.psum`` over the validator mesh axes in the
+    ``shard_map``-ped multi-chip pass (parallel/sharded.py) — the ICI
+    allreduce of north-star config #4.
     """
     current_epoch = jnp.asarray(current_epoch, dtype=jnp.int64)
     prev_epoch = jnp.maximum(current_epoch - 1, 0)
@@ -133,7 +142,7 @@ def process_epoch_dense(reg: DenseRegistry,
     active_prev = _active(reg, prev_epoch)
     eff = reg.effective_balance
 
-    total_active = jnp.maximum(incr, _masked_sum(eff, active_cur))
+    total_active = jnp.maximum(incr, reduce_fn(_masked_sum(eff, active_cur)))
 
     # --- justification tallies (pos-evolution.md:793-803) ---
     prev_target_mask = (active_prev
@@ -142,8 +151,8 @@ def process_epoch_dense(reg: DenseRegistry,
     cur_target_mask = (active_cur
                        & _has_flag(reg.cur_flags, TIMELY_TARGET_FLAG_INDEX)
                        & ~reg.slashed)
-    prev_target = jnp.maximum(incr, _masked_sum(eff, prev_target_mask))
-    cur_target = jnp.maximum(incr, _masked_sum(eff, cur_target_mask))
+    prev_target = jnp.maximum(incr, reduce_fn(_masked_sum(eff, prev_target_mask)))
+    cur_target = jnp.maximum(incr, reduce_fn(_masked_sum(eff, cur_target_mask)))
 
     past_genesis = current_epoch > 1
     justify_prev = past_genesis & (prev_target * 3 >= total_active * 2)
@@ -198,7 +207,7 @@ def process_epoch_dense(reg: DenseRegistry,
         participating = (active_prev
                          & _has_flag(reg.prev_flags, flag_index)
                          & ~reg.slashed)
-        participating_increments = _masked_sum(eff, participating) // incr
+        participating_increments = reduce_fn(_masked_sum(eff, participating)) // incr
         numer = base_reward * np.int64(weight) * participating_increments
         denom = active_increments * np.int64(WEIGHT_DENOMINATOR)
         rewards = rewards + jnp.where(~in_leak & eligible & participating,
@@ -253,3 +262,18 @@ def process_epoch_dense(reg: DenseRegistry,
         new_justification_bits=new_bits,
         finalize_epoch=fin,
     )
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def process_epoch_dense(reg: DenseRegistry,
+                        current_epoch,
+                        finalized_epoch,
+                        justification_bits,
+                        prev_justified_epoch,
+                        cur_justified_epoch,
+                        slashings_sum,
+                        cfg: Config) -> EpochResult:
+    """Single-chip jitted epoch boundary (reduce = local sum)."""
+    return epoch_core(reg, current_epoch, finalized_epoch, justification_bits,
+                      prev_justified_epoch, cur_justified_epoch, slashings_sum,
+                      cfg)

@@ -1,0 +1,236 @@
+"""Dense fork choice on device (north-star config #1).
+
+The spec ``get_head`` (pos-evolution.md:1102-1116) recomputes
+``get_latest_attesting_balance`` per fork per child — O(branches x messages x
+depth). The array level computes ALL subtree weights in one pass
+(SURVEY.md §3.2 "TPU mapping"):
+
+1. per-validator latest messages -> per-block vote weight via
+   ``segment_sum`` over the registry (equivocators/inactive masked out,
+   pos-evolution.md:1438);
+2. a boolean reachability matrix R (R[i,j] = j is i or an ancestor of i)
+   built by log2(B) boolean matrix squarings — MXU-friendly matmuls;
+3. subtree weights = R^T @ votes (+ proposer boost on the boosted block's
+   ancestor row, pos-evolution.md:916, 1355);
+4. viable-branch filtering (pos-evolution.md:874-880): keep blocks with a
+   viable leaf descendant, computed from the same R;
+5. greedy descent as a ``lax.while_loop`` with exact (weight,
+   lexicographic-rank) tie-breaking (pos-evolution.md:1114-1116).
+
+The fixed-capacity layout (blocks padded to ``capacity``) keeps every shape
+static for XLA. Blocks arrive in topological order so parent index < child
+index always holds.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+
+class DenseStore(NamedTuple):
+    """Fixed-capacity array image of the fork-choice Store (pos-evolution.md
+    :889-901): the dict-shaped store becomes parent-index arrays + a latest-
+    message table."""
+
+    parent: jax.Array          # int32[B]; -1 for the anchor root
+    slot: jax.Array            # int32[B]
+    rank: jax.Array            # int32[B] lexicographic rank of the block root
+    real: jax.Array            # bool[B] slot occupied
+    leaf_viable: jax.Array     # bool[B] leaf carries store's justified/finalized view
+    justified_idx: jax.Array   # int32 scalar: descent start
+    # latest-message table over validators
+    msg_block: jax.Array       # int32[N]; -1 = no message
+    msg_epoch: jax.Array       # int64[N]
+    weight: jax.Array          # int64[N] effective balance, 0 if masked out
+    boost_idx: jax.Array       # int32 scalar; -1 = no boost
+    boost_amount: jax.Array    # int64 scalar
+
+
+def _reachability(parent, real, capacity: int):
+    """R[i, j] = block j is i or an ancestor of i (within real blocks).
+
+    Boolean matrix squaring as f32 matmuls: path counts per entry are
+    bounded by ``capacity`` (< 2^24), so f32 accumulation is exact and the
+    squarings run on the MXU (s64 dots are not TPU-lowerable).
+    """
+    eye = jnp.eye(capacity, dtype=bool)
+    has_parent = (parent >= 0) & real
+    p = jnp.where(has_parent, parent, 0)
+    step = jnp.zeros((capacity, capacity), dtype=bool)
+    step = step.at[jnp.arange(capacity), p].set(has_parent)
+    r = eye | step
+    hops = max(int(np.ceil(np.log2(max(capacity, 2)))), 1)
+    for _ in range(hops):
+        rf = r.astype(jnp.float32)
+        r = jnp.dot(rf, rf, preferred_element_type=jnp.float32) > 0.5
+    return r
+
+
+def _exact_matvec_i64(r_bool, values_i64, capacity: int):
+    """Exact Σ_i R[i,j] * v[i] for int64 increment counts via hi/lo-split
+    f32 matmuls (both halves stay < 2^24 per output, so f32 is exact)."""
+    lo = (values_i64 & np.int64(0xFFF)).astype(jnp.float32)
+    hi = (values_i64 >> np.int64(12)).astype(jnp.float32)
+    rf = r_bool.astype(jnp.float32)
+    lo_sum = jnp.dot(rf.T, lo, preferred_element_type=jnp.float32)
+    hi_sum = jnp.dot(rf.T, hi, preferred_element_type=jnp.float32)
+    return hi_sum.astype(jnp.int64) * np.int64(4096) + lo_sum.astype(jnp.int64)
+
+
+@partial(jax.jit, static_argnames=("capacity", "increment"))
+def head_and_weights(store: DenseStore, capacity: int,
+                     increment: int = 10**9):
+    """Returns (head_idx, subtree_weights[B] in Gwei) — one fused pass.
+
+    Effective balances are always multiples of ``increment`` (hysteresis,
+    pos-evolution.md:122-133), so subtree sums run as exact hi/lo-split f32
+    matmuls over increment counts; the (not increment-aligned) proposer
+    boost is added afterwards in int64.
+    """
+    votes_valid = store.msg_block >= 0
+    seg_ids = jnp.where(votes_valid, store.msg_block, capacity)
+    vote_weight = jax.ops.segment_sum(
+        jnp.where(votes_valid, store.weight, 0), seg_ids,
+        num_segments=capacity + 1)[:capacity]
+
+    r = _reachability(store.parent, store.real, capacity)
+
+    vote_incr = vote_weight // np.int64(increment)
+    subtree = _exact_matvec_i64(r, vote_incr, capacity) * np.int64(increment)
+    # proposer boost rides the boosted block's ancestor chain
+    has_boost = store.boost_idx >= 0
+    boost_row = jnp.where(
+        has_boost,
+        r[jnp.maximum(store.boost_idx, 0)],
+        jnp.zeros(capacity, dtype=bool))
+    subtree = subtree + boost_row.astype(jnp.int64) * store.boost_amount
+
+    # viable-branch filter: block kept iff some viable leaf descends from it
+    is_parent = jnp.zeros(capacity, dtype=bool).at[
+        jnp.where(store.parent >= 0, store.parent, 0)].max(
+        (store.parent >= 0) & store.real)
+    leaf = store.real & ~is_parent
+    ok_leaf = leaf & store.leaf_viable
+    keep = jnp.dot(r.astype(jnp.float32).T, ok_leaf.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) > 0.5
+
+    def descend(carry):
+        head, _ = carry
+        children = (store.parent == head) & keep & store.real
+        any_child = children.any()
+        w = jnp.where(children, subtree, -1)
+        best_w = w.max()
+        # exact (weight, lexicographic root) tie-break
+        rank_key = jnp.where(children & (w == best_w), store.rank, -1)
+        best = jnp.argmax(rank_key).astype(jnp.int32)
+        new_head = jnp.where(any_child, best, head)
+        return new_head, any_child
+
+    def cond(carry):
+        return carry[1]
+
+    head0 = store.justified_idx
+    children0 = (store.parent == head0) & keep & store.real
+    head, _ = jax.lax.while_loop(cond, descend, (head0, children0.any()))
+    return head, subtree
+
+
+# --- host-side densification --------------------------------------------------
+
+def build_dense_store(store, capacity: int | None = None):
+    """Build a DenseStore from a spec-level Store (host side).
+
+    Returns (dense, roots) where roots[i] is the block root at index i.
+    """
+    from pos_evolution_tpu.config import GENESIS_EPOCH, cfg
+    from pos_evolution_tpu.specs.forkchoice import (
+        get_current_slot, get_proposer_boost,
+    )
+    from pos_evolution_tpu.specs.helpers import compute_epoch_at_slot
+
+    roots = list(store.blocks.keys())  # insertion = topological order
+    b = len(roots)
+    if capacity is None:
+        capacity = max(int(2 ** np.ceil(np.log2(max(b, 2)))), 2)
+    index_of = {r: i for i, r in enumerate(roots)}
+    rank = np.argsort(np.argsort(np.array([r for r in roots], dtype=object)))
+
+    parent = np.full(capacity, -1, dtype=np.int32)
+    slot = np.zeros(capacity, dtype=np.int32)
+    real = np.zeros(capacity, dtype=bool)
+    leaf_viable = np.zeros(capacity, dtype=bool)
+    rank_arr = np.zeros(capacity, dtype=np.int32)
+    rank_arr[:b] = rank
+
+    jc, fc_ = store.justified_checkpoint, store.finalized_checkpoint
+    for i, root in enumerate(roots):
+        block = store.blocks[root]
+        real[i] = True
+        slot[i] = int(block.slot)
+        pr = bytes(block.parent_root)
+        parent[i] = index_of.get(pr, -1)
+        head_state = store.block_states[root]
+        correct_justified = (
+            int(jc.epoch) == GENESIS_EPOCH
+            or head_state.current_justified_checkpoint == jc)
+        correct_finalized = (
+            int(fc_.epoch) == GENESIS_EPOCH
+            or head_state.finalized_checkpoint == fc_)
+        leaf_viable[i] = correct_justified and correct_finalized
+
+    justified_state = store.checkpoint_states[jc.as_key()]
+    n = len(justified_state.validators)
+    reg = justified_state.validators
+    current_epoch = compute_epoch_at_slot(get_current_slot(store))
+    active = ((reg.activation_epoch <= np.uint64(current_epoch))
+              & (np.uint64(current_epoch) < reg.exit_epoch))
+
+    msg_block = np.full(n, -1, dtype=np.int32)
+    msg_epoch = np.zeros(n, dtype=np.int64)
+    weight = np.zeros(n, dtype=np.int64)
+    for v, message in store.latest_messages.items():
+        if v >= n or v in store.equivocating_indices:
+            continue
+        idx = index_of.get(message.root)
+        if idx is None:
+            continue
+        msg_block[v] = idx
+        msg_epoch[v] = message.epoch
+    valid = (msg_block >= 0) & active & ~reg.slashed
+    weight[valid] = reg.effective_balance[valid].astype(np.int64)
+    msg_block[~valid] = -1
+
+    boost_idx = index_of.get(bytes(store.proposer_boost_root), -1) \
+        if store.proposer_boost_root != b"\x00" * 32 else -1
+    boost_amount = get_proposer_boost(store) if boost_idx >= 0 else 0
+
+    dense = DenseStore(
+        parent=jnp.asarray(parent),
+        slot=jnp.asarray(slot),
+        rank=jnp.asarray(rank_arr),
+        real=jnp.asarray(real),
+        leaf_viable=jnp.asarray(leaf_viable),
+        justified_idx=jnp.int32(index_of[bytes(jc.root)]),
+        msg_block=jnp.asarray(msg_block),
+        msg_epoch=jnp.asarray(msg_epoch),
+        weight=jnp.asarray(weight),
+        boost_idx=jnp.int32(boost_idx),
+        boost_amount=jnp.int64(boost_amount),
+    )
+    return dense, roots, capacity
+
+
+def get_head_dense(store) -> bytes:
+    """Drop-in accelerated get_head for a spec-level Store."""
+    dense, roots, capacity = build_dense_store(store)
+    head_idx, _ = head_and_weights(dense, capacity)
+    return roots[int(head_idx)]

@@ -48,6 +48,13 @@ def _rotr(x, n: int):
     return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
 
 
+def _unroll_rounds() -> bool:
+    # Fully unrolled rounds fuse best on TPU; on XLA:CPU the unrolled
+    # multi-compression graph sends compile time superlinear (minutes), so
+    # the CPU path loops over a (64, ...) schedule stack instead.
+    return jax.default_backend() != "cpu"
+
+
 def sha256_compress(state, block_words):
     """One compression: state (..., 8) u32, block_words (..., 16) u32."""
     w = [block_words[..., t] for t in range(16)]
@@ -56,16 +63,29 @@ def sha256_compress(state, block_words):
         s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
         w.append(w[t - 16] + s0 + w[t - 7] + s1)
 
-    a, b, c, d, e, f, g, h = [state[..., i] for i in range(8)]
-    for t in range(64):
+    def round_step(a, b, c, d, e, f, g, h, kt, wt):
         s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + np.uint32(_K[t]) + w[t]
+        t1 = h + s1 + ch + kt + wt
         s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
-        t2 = s0 + maj
-        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+        return (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
 
+    init = tuple(state[..., i] for i in range(8))
+    if _unroll_rounds():
+        carry = init
+        for t in range(64):
+            carry = round_step(*carry, np.uint32(_K[t]), w[t])
+        a, b, c, d, e, f, g, h = carry
+    else:
+        w_stack = jnp.stack(w, axis=0)  # (64, ...) leading axis
+        k_stack = jnp.asarray(_K)
+
+        def round_body(t, carry):
+            wt = jax.lax.dynamic_index_in_dim(w_stack, t, axis=0, keepdims=False)
+            return round_step(*carry, k_stack[t], wt)
+
+        a, b, c, d, e, f, g, h = jax.lax.fori_loop(0, 64, round_body, init)
     out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
     return state + out
 

@@ -1,0 +1,8 @@
+"""Parallelism: device mesh, collectives, sharded registry passes."""
+
+from pos_evolution_tpu.parallel.collectives import (
+    POD_AXIS,
+    SHARD_AXIS,
+    JaxCollectives,
+    NumpyCollectives,
+)

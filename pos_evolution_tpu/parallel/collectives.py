@@ -1,0 +1,100 @@
+"""Distributed communication backend (SURVEY.md §2.8, §5).
+
+The reference's only communication primitive is abstract best-effort gossip
+(pos-evolution.md:187-189); its parallelism is committee-based
+(:472-475). The TPU-native equivalent is a thin collectives abstraction
+over named mesh axes:
+
+- ``validators`` axes (``pods`` x ``shard``): the registry is sharded here;
+  epoch sweeps reduce with ``psum`` over ICI within a pod and DCN across
+  pods (north-star configs #4/#5);
+- simulated gossip = ``all_gather`` of message tensors with delivery masks
+  (partitions are masks, so adversarial scheduling stays jittable);
+- SSF supermajority tallies = cross-pod allreduce (config #5).
+
+The ``numpy`` implementation of the same five primitives is the
+single-process fallback, so every collective code path also runs without
+JAX (SURVEY.md §2.8 "CPU backend implements the same interface").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["JaxCollectives", "NumpyCollectives", "POD_AXIS", "SHARD_AXIS"]
+
+POD_AXIS = "pods"     # DCN-class axis (across pods / hosts)
+SHARD_AXIS = "shard"  # ICI-class axis (within a pod)
+
+
+class JaxCollectives:
+    """Named-axis collectives inside ``shard_map``/``pjit`` traces."""
+
+    name = "jax"
+
+    @staticmethod
+    def psum(x, axis):
+        import jax
+        return jax.lax.psum(x, axis)
+
+    @staticmethod
+    def pmax(x, axis):
+        import jax
+        return jax.lax.pmax(x, axis)
+
+    @staticmethod
+    def all_gather(x, axis, axis_index=0, tiled=False):
+        import jax
+        return jax.lax.all_gather(x, axis, axis=axis_index, tiled=tiled)
+
+    @staticmethod
+    def ppermute(x, axis, perm):
+        import jax
+        return jax.lax.ppermute(x, axis, perm)
+
+    @staticmethod
+    def broadcast(x, axis, src=0):
+        # broadcast = select src shard then all-gather; on a mesh axis the
+        # cheapest form is psum of a masked value
+        import jax
+        idx = jax.lax.axis_index(axis)
+        contrib = jax.numpy.where(idx == src, x, jax.numpy.zeros_like(x))
+        return jax.lax.psum(contrib, axis)
+
+    @staticmethod
+    def axis_index(axis):
+        import jax
+        return jax.lax.axis_index(axis)
+
+
+class NumpyCollectives:
+    """Single-process reference semantics: one shard holds everything, so
+    reductions are identities over the lone participant."""
+
+    name = "numpy"
+
+    @staticmethod
+    def psum(x, axis):
+        return x
+
+    @staticmethod
+    def pmax(x, axis):
+        return x
+
+    @staticmethod
+    def all_gather(x, axis, axis_index=0, tiled=False):
+        x = np.asarray(x)
+        return x if tiled else x[None, ...]
+
+    @staticmethod
+    def ppermute(x, axis, perm):
+        # single participant: only the self-loop (0 -> 0) delivers
+        return x if any(s == 0 and d == 0 for s, d in perm) else np.zeros_like(x)
+
+    @staticmethod
+    def broadcast(x, axis, src=0):
+        return x
+
+    @staticmethod
+    def axis_index(axis):
+        return 0

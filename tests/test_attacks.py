@@ -45,8 +45,12 @@ class TestBalancingAttack:
     def test_halts_finality_in_preboost_gasper(self):
         """pos-evolution.md:1321-1348: equivocating proposer + swayer votes
         keep two chains tied; no checkpoint beyond genesis justifies."""
+        # The reference assumes enough Byzantine validators in *every* slot
+        # committee (:1330 "at least six Byzantine validators in every
+        # slot"); with random committee draws a 30% pool guarantees the
+        # per-slot swayer budget.
         with use_config(minimal_config().replace(proposer_score_boost_percent=0)):
-            r = run_balancing_attack(64, n_epochs=4, corrupted_fraction=0.25)
+            r = run_balancing_attack(64, n_epochs=4, corrupted_fraction=0.3)
         assert r.tie_maintained, "adversary lost the tie"
         assert r.head_L != r.head_R, "views converged"
         assert r.finalized_epoch_L == 0 and r.finalized_epoch_R == 0

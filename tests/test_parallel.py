@@ -1,0 +1,110 @@
+"""Multi-device tests on the virtual 8-device CPU mesh (SURVEY.md §4.4c):
+the sharded epoch pass must equal the single-chip kernel exactly; SSF
+tallies and gossip must execute their collective paths.
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config
+
+jax = pytest.importorskip("jax")
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from pos_evolution_tpu.parallel.sharded import make_mesh
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual CPU devices"
+    return make_mesh(8, n_pods=2)
+
+
+def _dense_registry(n, seed=0):
+    import jax.numpy as jnp
+    from pos_evolution_tpu.ops.epoch import DenseRegistry
+    rng = np.random.default_rng(seed)
+    gwei = 10**9
+    bal = rng.integers(20 * gwei, 40 * gwei, n).astype(np.int64)
+    return DenseRegistry(
+        effective_balance=jnp.asarray(np.minimum(bal // gwei, 32) * gwei),
+        balance=jnp.asarray(bal),
+        activation_epoch=jnp.asarray(
+            np.where(rng.random(n) < 0.9, 0, 99).astype(np.int64)),
+        exit_epoch=jnp.asarray(
+            np.where(rng.random(n) < 0.95, 2**62, 5).astype(np.int64)),
+        withdrawable_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
+        slashed=jnp.asarray(rng.random(n) < 0.05),
+        prev_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+        cur_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+        inactivity_scores=jnp.asarray(rng.integers(0, 30, n).astype(np.int64)),
+    )
+
+
+class TestShardedEpoch:
+    def test_matches_single_chip(self, mesh):
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.epoch import process_epoch_dense
+        from pos_evolution_tpu.parallel.sharded import (
+            shard_registry, sharded_epoch_step,
+        )
+        cfg = minimal_config()
+        reg = _dense_registry(256)
+        bits = jnp.asarray(np.array([0, 1, 1, 0], dtype=bool))
+        single = process_epoch_dense(reg, 9, 6, bits, 7, 8, 12345, cfg)
+
+        step = sharded_epoch_step(mesh, cfg)
+        sharded_reg = shard_registry(mesh, reg)
+        multi = step(sharded_reg, jnp.int64(9), jnp.int64(6), bits,
+                     jnp.int64(7), jnp.int64(8), jnp.int64(12345))
+
+        for f in reg._fields:
+            a = np.asarray(getattr(single.registry, f))
+            b = np.asarray(getattr(multi.registry, f))
+            assert np.array_equal(a, b), f"sharded {f} diverges"
+        assert int(single.total_active_balance) == int(multi.total_active_balance)
+        assert np.array_equal(np.asarray(single.new_justification_bits),
+                              np.asarray(multi.new_justification_bits))
+        assert int(single.finalize_epoch) == int(multi.finalize_epoch)
+
+
+class TestSSFTally:
+    def test_supermajority_cross_pod(self, mesh):
+        import jax.numpy as jnp
+        from pos_evolution_tpu.parallel.sharded import ssf_supermajority_tally
+        n = 128
+        gwei = 10**9
+        eff = jnp.asarray(np.full(n, 32 * gwei, np.int64))
+        total = jnp.int64(n * 32 * gwei)
+        tally = ssf_supermajority_tally(mesh)
+        votes = jnp.asarray(np.arange(n) < 86)  # 86/128 > 2/3
+        s, ok = tally(votes, eff, total)
+        assert bool(ok) and int(s) == 86 * 32 * gwei
+        votes = jnp.asarray(np.arange(n) < 85)  # 85/128 < 2/3 (85*3=255<256)
+        s, ok = tally(votes, eff, total)
+        assert not bool(ok)
+
+
+class TestGossip:
+    def test_masked_all_gather(self, mesh):
+        import jax.numpy as jnp
+        from pos_evolution_tpu.parallel.sharded import gossip_all_gather
+        n = 64
+        msgs = jnp.asarray(np.arange(n, dtype=np.int64))
+        # recipient i hears only senders with the same parity (a partition)
+        mask = np.zeros((n, n), dtype=bool)
+        for i in range(n):
+            mask[i, i % 2::2] = True
+        gossip = gossip_all_gather(mesh)
+        out = np.asarray(gossip(msgs, jnp.asarray(mask)))
+        evens = sum(range(0, n, 2))
+        odds = sum(range(1, n, 2))
+        assert out[0] == evens and out[1] == odds and out[2] == evens
+
+
+class TestNumpyCollectivesParity:
+    def test_same_interface(self):
+        from pos_evolution_tpu.parallel.collectives import NumpyCollectives
+        c = NumpyCollectives
+        x = np.arange(4)
+        assert np.array_equal(c.psum(x, "shard"), x)
+        assert c.all_gather(x, "shard").shape == (1, 4)
+        assert c.axis_index("shard") == 0
