@@ -1,0 +1,17 @@
+"""Protocol variants (L7): propose-vote-merge family + SSF."""
+
+from pos_evolution_tpu.models.protocols import (
+    PVMAdversary,
+    PVMParams,
+    PVMSimulation,
+    goldfish,
+    lmd,
+    rlmd,
+)
+from pos_evolution_tpu.models.ssf import (
+    Acknowledgment,
+    FFGVote,
+    SSFCheckpoint,
+    SSFSimulation,
+    is_ack_slashable,
+)

@@ -1,0 +1,221 @@
+"""Concrete propose-vote-merge protocols: LMD-GHOST, RLMD-GHOST, Goldfish.
+
+One simulation driver executes the three-phase template of
+pos-evolution.md:1602-1608 under the sleepy adversary model
+(:191-199, 1547); the protocol instance sets the fork-choice expiry
+window, leader election, confirmation rules, and slot shape:
+
+- ``lmd()``      eta = inf, round-robin proposers — (a more secure variant
+                 of) LMD-GHOST (pos-evolution.md:1585)
+- ``rlmd(eta)``  vote expiry eta, view-merge — RLMD-GHOST (:1581-1609)
+- ``goldfish()`` eta = 1, VRF leaders + subsampling, kappa-deep slow
+                 confirmation and optional 3/4 fast confirmation in 4-phase
+                 slots — Goldfish / GHOST-Eph (:1543-1579)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from pos_evolution_tpu.models.pvm import (
+    GENESIS_ROOT,
+    HeadVote,
+    PVMBlock,
+    PVMValidator,
+    View,
+    ghost_head,
+    vrf_is_eligible,
+    vrf_output,
+)
+
+
+@dataclass
+class PVMParams:
+    n_validators: int
+    vote_expiry: int | None = None   # None = LMD (eta = inf); 1 = Goldfish
+    use_vrf: bool = False            # VRF leader election (:1554)
+    subsample_rate: float = 1.0      # voter subsampling (:1545)
+    kappa: int = 3                   # kappa-deep confirmation (:1556)
+    fast_confirm: bool = False       # 4-phase slot with 3/4 rule (:1562-1569)
+    fast_confirm_threshold: float = 0.75
+
+
+def lmd(n: int) -> PVMParams:
+    return PVMParams(n_validators=n, vote_expiry=None)
+
+
+def rlmd(n: int, eta: int) -> PVMParams:
+    return PVMParams(n_validators=n, vote_expiry=eta)
+
+
+def goldfish(n: int, kappa: int = 3, fast_confirm: bool = False,
+             subsample_rate: float = 1.0) -> PVMParams:
+    return PVMParams(n_validators=n, vote_expiry=1, use_vrf=True,
+                     kappa=kappa, fast_confirm=fast_confirm,
+                     subsample_rate=subsample_rate)
+
+
+@dataclass
+class PVMAdversary:
+    """Adversarial scheduling hooks (all default honest/synchronous).
+
+    - ``asleep(slot, v)``: sleepy model (pos-evolution.md:193, 1547)
+    - ``drop_proposal(slot, v)``: proposal does not reach v in time
+      (network asynchrony / targeted delay, :197-199, 1328)
+    - ``drop_votes(slot, v)``: slot votes do not reach v's merge phase
+    """
+
+    asleep: Callable[[int, int], bool] = lambda t, v: False
+    drop_proposal: Callable[[int, int], bool] = lambda t, v: False
+    drop_votes: Callable[[int, int], bool] = lambda t, v: False
+
+
+class PVMSimulation:
+    """Round-based execution of a propose-vote-merge protocol."""
+
+    def __init__(self, params: PVMParams, adversary: PVMAdversary | None = None):
+        self.p = params
+        self.adv = adversary or PVMAdversary()
+        self.validators = [PVMValidator(i) for i in range(params.n_validators)]
+        self.slot = 1
+        self.fast_confirmed: dict[int, bytes] = {}  # per-validator latest
+        self.log: list[dict] = []
+
+    # -- protocol roles --------------------------------------------------
+    def _leaders(self, slot: int, awake: list[int]) -> list[int]:
+        if not awake:
+            return []
+        if self.p.use_vrf:
+            # every awake validator with minimal VRF output proposes; voters
+            # accept the minimum (pos-evolution.md:1554)
+            return [min(awake, key=lambda v: vrf_output(v, slot))]
+        return [slot % self.p.n_validators]
+
+    def _eligible_voter(self, v: int, slot: int) -> bool:
+        if self.p.subsample_rate >= 1.0:
+            return True
+        return vrf_is_eligible(v, slot, b"vote", self.p.subsample_rate)
+
+    def head_for(self, v: PVMValidator, slot: int) -> bytes:
+        return ghost_head(v.view, slot, self.p.vote_expiry)
+
+    # -- one slot --------------------------------------------------------
+    def run_slot(self) -> None:
+        t = self.slot
+        p = self.p
+        awake = [v.index for v in self.validators
+                 if not self.adv.asleep(t, v.index)
+                 and self.validators[v.index].status == "awake"]
+
+        # wake transitions: asleep -> dreamy -> awake (pos-evolution.md:1547)
+        for val in self.validators:
+            sleeping = self.adv.asleep(t, val.index)
+            if sleeping:
+                val.status = "asleep"
+            elif val.status == "asleep":
+                val.status = "dreamy"   # joins this slot, acts next slot
+            elif val.status == "dreamy":
+                val.merge_buffer()
+                val.status = "awake"
+
+        # --- Propose (round k*t): leader merges buffer, runs FC, extends
+        proposals: list[tuple[PVMBlock, View]] = []
+        for leader in self._leaders(t, awake):
+            lv = self.validators[leader]
+            lv.merge_buffer()
+            head = self.head_for(lv, t)
+            block = PVMBlock(slot=t, parent=head, proposer=leader)
+            lv.view.add_block(block)
+            proposals.append((block, lv.view.copy()))
+
+        # --- Vote (round k*t + Δ): merge proposed view, vote FC
+        votes: list[HeadVote] = []
+        for v in awake:
+            val = self.validators[v]
+            got_proposal = False
+            for block, pview in proposals:
+                if self.adv.drop_proposal(t, v):
+                    continue
+                # view-merge: adopt the proposer's referenced view
+                val.view.merge(pview)
+                val.view.add_block(block)
+                got_proposal = True
+            if not self._eligible_voter(v, t):
+                continue
+            head = self.head_for(val, t)
+            vote = HeadVote(slot=t, block_root=head, validator=v)
+            val.view.add_vote(vote)
+            votes.append(vote)
+
+        # --- optional fast-confirmation phase (round k*t + 2Δ, :1562-1569)
+        if p.fast_confirm:
+            tally: dict[bytes, int] = {}
+            for vote in votes:
+                tally[vote.block_root] = tally.get(vote.block_root, 0) + 1
+            # "more than 3/4 of the *eligible voters* of slot t" (:1567) —
+            # the subsampled committee of the full set, awake or not
+            eligible = sum(1 for v in range(p.n_validators)
+                           if self._eligible_voter(v, t))
+            for root, count in tally.items():
+                blk_ok = any(b.root == root and b.slot == t for b, _ in proposals)
+                if blk_ok and eligible and count > p.fast_confirm_threshold * eligible:
+                    for v in awake:
+                        if not self.adv.drop_votes(t, v):
+                            self.fast_confirmed[v] = root
+
+        # --- Merge (last Δ): deliver votes/blocks into buffers, merge
+        for val in self.validators:
+            target_asleep = val.status != "awake"
+            for block, _ in proposals:
+                val.buffer_message(block)
+            for vote in votes:
+                if not self.adv.drop_votes(t, vote.validator) or target_asleep:
+                    val.buffer_message(vote)
+            if val.status == "awake" and val.index in awake:
+                val.merge_buffer()
+
+        self._record(t, awake, proposals, votes)
+        self.slot += 1
+
+    def run_slots(self, n: int) -> None:
+        for _ in range(n):
+            self.run_slot()
+
+    # -- confirmation rules ----------------------------------------------
+    def confirmed_ledger(self, v: int) -> bytes:
+        """kappa-deep (slow) confirmation: the prefix of the canonical chain
+        at blocks from slots <= t - kappa (pos-evolution.md:1556); a
+        previously fast-confirmed block is never rolled back (:1568)."""
+        val = self.validators[v]
+        head = self.head_for(val, self.slot)
+        cutoff = self.slot - self.p.kappa
+        cur = head
+        while cur != GENESIS_ROOT and val.view.blocks[cur].slot > cutoff:
+            cur = val.view.blocks[cur].parent
+        fast = self.fast_confirmed.get(v)
+        if fast is not None and fast in val.view.blocks:
+            if val.view.is_ancestor(cur, fast):
+                return fast
+        return cur
+
+    def chain_of(self, v: int, root: bytes | None = None) -> list[bytes]:
+        val = self.validators[v]
+        cur = root if root is not None else self.head_for(val, self.slot)
+        out = []
+        while True:
+            out.append(cur)
+            if cur == GENESIS_ROOT:
+                return out[::-1]
+            cur = val.view.blocks[cur].parent
+
+    def _record(self, t, awake, proposals, votes):
+        heads = {v.index: self.head_for(v, t + 1).hex()[:8]
+                 for v in self.validators[:4]}
+        self.log.append({
+            "slot": t, "awake": len(awake),
+            "proposals": len(proposals), "votes": len(votes),
+            "heads": heads,
+        })

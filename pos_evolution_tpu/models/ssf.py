@@ -1,0 +1,151 @@
+"""Single-slot finality protocol (L7; pos-evolution.md:1611-1650).
+
+RLMD-GHOST with fast confirmation (4Δ slots: propose -> head-vote ->
+FFG-vote/fast-confirm -> merge, :1617, :1631-1637) plus a per-slot FFG
+gadget:
+
+- checkpoints are (block, slot) pairs; FFG votes link source -> target
+  where source = the voter's latest justified checkpoint LJ and target =
+  the highest fast-confirmed descendant of LJ (or LJ itself) at the
+  current slot (:1624-1629);
+- a checkpoint justifies when 2/3 of validators cast the same link in a
+  slot (supermajority link, :1626);
+- finalization: a justified C with a supermajority link C -> C' at
+  C'.t = C.t + 1 finalizes C (:1626); additionally validators *acknowledge*
+  a just-justified checkpoint, and 2/3 acknowledgments finalize it within
+  its own slot (:1646) — true single-slot finality;
+- slashing: an acknowledgment ((C, t), t) conflicts with any FFG vote
+  (A, t') -> (B, t'') with t' < t < t'' (surround-the-ack, :1646).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from pos_evolution_tpu.models.pvm import GENESIS_ROOT, ghost_head
+from pos_evolution_tpu.models.protocols import PVMAdversary, PVMParams, PVMSimulation
+
+
+@dataclass(frozen=True)
+class SSFCheckpoint:
+    block: bytes
+    slot: int
+
+
+@dataclass(frozen=True)
+class FFGVote:
+    """[FFG-VOTE, C1, C2, v] with C1.t < C2.t (pos-evolution.md:1624)."""
+
+    source: SSFCheckpoint
+    target: SSFCheckpoint
+    validator: int
+
+
+@dataclass(frozen=True)
+class Acknowledgment:
+    """((B, t), t): the voter saw (B, t) justified at slot t (:1646)."""
+
+    checkpoint: SSFCheckpoint
+    slot: int
+    validator: int
+
+
+def is_ack_slashable(ack: Acknowledgment, vote: FFGVote) -> bool:
+    """Surround-the-ack condition (pos-evolution.md:1646): slashable iff the
+    FFG vote's span strictly surrounds the acknowledged slot."""
+    return (ack.validator == vote.validator
+            and vote.source.slot < ack.slot < vote.target.slot)
+
+
+class SSFSimulation(PVMSimulation):
+    """SSF = RLMD-GHOST (4Δ, fast confirm) + per-slot FFG + acknowledgments."""
+
+    def __init__(self, n_validators: int, eta: int = 4,
+                 adversary: PVMAdversary | None = None):
+        params = PVMParams(n_validators=n_validators, vote_expiry=eta,
+                           fast_confirm=True)
+        super().__init__(params, adversary)
+        genesis_cp = SSFCheckpoint(block=GENESIS_ROOT, slot=0)
+        self.latest_justified: dict[int, SSFCheckpoint] = {
+            v: genesis_cp for v in range(n_validators)}
+        self.justified: set[SSFCheckpoint] = {genesis_cp}
+        self.finalized: set[SSFCheckpoint] = {genesis_cp}
+        self.ffg_votes: list[FFGVote] = []
+        self.acks: list[Acknowledgment] = []
+
+    # -- fork choice with LJ filtering (pos-evolution.md:1628) -------------
+    def head_for(self, val, slot: int) -> bytes:
+        head = ghost_head(val.view, slot, self.p.vote_expiry)
+        lj = self.latest_justified[val.index]
+        if lj.block in val.view.blocks and not val.view.is_ancestor(lj.block, head):
+            # branches not containing LJ are filtered; fall back to LJ
+            return lj.block
+        return head
+
+    def _supermajority(self, count: int) -> bool:
+        return 3 * count >= 2 * self.p.n_validators
+
+    def run_slot(self) -> None:
+        t = self.slot
+        super().run_slot()  # propose, head-vote, fast-confirm, merge
+
+        # --- FFG voting round (3/4 into the slot, :1631-1637) ---
+        awake = [v.index for v in self.validators
+                 if self.validators[v.index].status == "awake"
+                 and not self.adv.asleep(t, v.index)]
+        links: dict[tuple[SSFCheckpoint, SSFCheckpoint], set[int]] = {}
+        for v in awake:
+            val = self.validators[v]
+            source = self.latest_justified[v]
+            fast = self.fast_confirmed.get(v)
+            if (fast is not None and fast in val.view.blocks
+                    and val.view.is_ancestor(source.block, fast)):
+                target_block = fast
+            else:
+                target_block = source.block
+            target = SSFCheckpoint(block=target_block, slot=t)
+            vote = FFGVote(source=source, target=target, validator=v)
+            self.ffg_votes.append(vote)
+            links.setdefault((source, target), set()).add(v)
+
+        # --- justification on supermajority links (:1626) ---
+        newly_justified: list[SSFCheckpoint] = []
+        for (source, target), voters in links.items():
+            if source in self.justified and self._supermajority(len(voters)):
+                if target not in self.justified:
+                    self.justified.add(target)
+                    newly_justified.append(target)
+                # C -> C' with consecutive slots finalizes C (:1626)
+                if target.slot == source.slot + 1:
+                    self.finalized.add(source)
+
+        # update everyone's LJ (synchrony: justification gossiped in-slot)
+        for cp in newly_justified:
+            for v in awake:
+                if cp.slot > self.latest_justified[v].slot:
+                    self.latest_justified[v] = cp
+
+        # --- acknowledgments: 2/3 acks finalize within the slot (:1646) ---
+        for cp in newly_justified:
+            ackers = set()
+            for v in awake:
+                ack = Acknowledgment(checkpoint=cp, slot=t, validator=v)
+                self.acks.append(ack)
+                ackers.add(v)
+            if self._supermajority(len(ackers)):
+                self.finalized.add(cp)
+
+    # -- observability -----------------------------------------------------
+    def finalized_blocks(self) -> set[bytes]:
+        return {cp.block for cp in self.finalized}
+
+    def max_finalized_slot(self) -> int:
+        return max(cp.slot for cp in self.finalized)
+
+    def detect_ack_slashings(self) -> list[tuple[Acknowledgment, FFGVote]]:
+        out = []
+        for ack in self.acks:
+            for vote in self.ffg_votes:
+                if is_ack_slashable(ack, vote):
+                    out.append((ack, vote))
+        return out

@@ -1,0 +1,271 @@
+"""Protocol-variant tests (L7; SURVEY.md §2.9): propose-vote-merge family,
+view-merge, Goldfish (expiry/VRF/subsampling/sleepy joining/confirmation),
+RLMD-GHOST eta-expiry, SSF single-slot finality, and the avalanche attack
+on vanilla GHOST (§2.10).
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.models import (
+    PVMAdversary,
+    PVMSimulation,
+    SSFSimulation,
+    goldfish,
+    is_ack_slashable,
+    lmd,
+    rlmd,
+)
+from pos_evolution_tpu.models.pvm import (
+    GENESIS_ROOT,
+    HeadVote,
+    PVMBlock,
+    View,
+    ghost_head,
+    vanilla_ghost_head,
+)
+from pos_evolution_tpu.models.ssf import Acknowledgment, FFGVote, SSFCheckpoint
+
+
+class TestPVMTemplate:
+    def test_lmd_honest_chain_grows(self):
+        sim = PVMSimulation(lmd(16))
+        sim.run_slots(10)
+        chains = [sim.chain_of(v) for v in range(16)]
+        assert all(c == chains[0] for c in chains), "honest views diverged"
+        assert len(chains[0]) == 11  # genesis + one block per slot
+
+    def test_view_merge_aligns_voters(self):
+        """pos-evolution.md:1540: with synchrony and an honest proposer the
+        merged view makes every honest validator vote for the proposal."""
+        sim = PVMSimulation(rlmd(12, eta=4))
+        for _ in range(6):
+            sim.run_slot()
+            last = sim.log[-1]
+            assert last["votes"] == 12
+        # all votes each slot were unanimous for that slot's proposal
+        v0 = sim.validators[0].view
+        for (validator, slot), root in v0.votes.items():
+            blk = v0.blocks[root]
+            assert blk.slot == slot, "vote was not for the slot's proposal"
+
+    def test_rlmd_expiry_window(self):
+        """Only votes from the last eta slots count (pos-evolution.md:1585)."""
+        view = View()
+        b1 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=0)
+        b2 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=1)
+        view.add_block(b1)
+        view.add_block(b2)
+        # 3 old votes for b1 at slot 1; 1 fresh vote for b2 at slot 5
+        for v in range(3):
+            view.add_vote(HeadVote(slot=1, block_root=b1.root, validator=v))
+        view.add_vote(HeadVote(slot=5, block_root=b2.root, validator=9))
+        # eta = inf: b1's 3 old votes win
+        assert ghost_head(view, 6, None) == b1.root
+        # eta = 2 at slot 6: only slots 4-5 count -> b2 wins
+        assert ghost_head(view, 6, 2) == b2.root
+
+    def test_goldfish_is_eta_one(self):
+        """Goldfish == RLMD with eta = 1 (pos-evolution.md:1585)."""
+        view = View()
+        b1 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=0)
+        b2 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=1)
+        view.add_block(b1)
+        view.add_block(b2)
+        for v in range(5):
+            view.add_vote(HeadVote(slot=3, block_root=b1.root, validator=v))
+        view.add_vote(HeadVote(slot=4, block_root=b2.root, validator=7))
+        # at slot 5 with eta=1 only slot-4 votes count
+        assert ghost_head(view, 5, 1) == b2.root
+
+    def test_equivocating_votes_discounted(self):
+        """Fork-choice discounting (pos-evolution.md:1411): equivocators
+        lose all weight."""
+        view = View()
+        b1 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=0)
+        b2 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=1)
+        view.add_block(b1)
+        view.add_block(b2)
+        view.add_vote(HeadVote(slot=2, block_root=b1.root, validator=5))
+        view.add_vote(HeadVote(slot=2, block_root=b2.root, validator=5))  # equivocates
+        view.add_vote(HeadVote(slot=2, block_root=b2.root, validator=6))
+        assert 5 in view.equivocators
+        assert ghost_head(view, 3, None) == b2.root
+
+
+class TestGoldfish:
+    def test_honest_run_with_vrf_leaders(self):
+        sim = PVMSimulation(goldfish(16))
+        sim.run_slots(10)
+        chains = [sim.chain_of(v) for v in range(16)]
+        assert all(c == chains[0] for c in chains)
+        assert len(chains[0]) == 11
+
+    def test_kappa_deep_confirmation(self):
+        sim = PVMSimulation(goldfish(16, kappa=3))
+        sim.run_slots(10)
+        confirmed = sim.confirmed_ledger(0)
+        blk = sim.validators[0].view.blocks[confirmed]
+        assert blk.slot <= sim.slot - 3
+        # confirmed prefix is on every validator's canonical chain
+        for v in range(16):
+            assert confirmed in sim.chain_of(v)
+
+    def test_fast_confirmation_full_participation(self):
+        """3/4 rule fast-confirms the slot's proposal (pos-evolution.md:
+        1562-1569)."""
+        sim = PVMSimulation(goldfish(16, fast_confirm=True))
+        sim.run_slots(5)
+        root = sim.fast_confirmed.get(0)
+        assert root is not None
+        assert sim.validators[0].view.blocks[root].slot >= 4
+
+    def test_no_fast_confirm_below_threshold(self):
+        adv = PVMAdversary(asleep=lambda t, v: v < 6)  # 10/16 < 3/4 awake
+        sim = PVMSimulation(goldfish(16, fast_confirm=True), adv)
+        sim.run_slots(5)
+        assert sim.fast_confirmed.get(15) is None
+
+    def test_sleepy_join_dreamy_then_awake(self):
+        """asleep -> dreamy -> awake joining (pos-evolution.md:1547);
+        under half-honest-awake the chain keeps growing and rejoiners
+        converge."""
+        asleep_until = 6
+        adv = PVMAdversary(asleep=lambda t, v: v >= 10 and t < asleep_until)
+        sim = PVMSimulation(goldfish(16), adv)
+        sim.run_slots(12)
+        # sleeper rejoined and agrees with the always-awake validators
+        assert sim.chain_of(15) == sim.chain_of(0)
+        assert len(sim.chain_of(0)) >= 11
+
+    def test_subsampling_still_progresses(self):
+        sim = PVMSimulation(goldfish(32, subsample_rate=0.5))
+        sim.run_slots(8)
+        assert len(sim.chain_of(0)) == 9
+        total_votes = sum(e["votes"] for e in sim.log)
+        assert total_votes < 32 * 8  # strictly subsampled
+
+    def test_one_async_slot_is_survivable_for_liveness(self):
+        """A fully-async slot halts that slot's progress but the chain
+        resumes — the *safety* brittleness (pos-evolution.md:1579-1583) is
+        exactly why RLMD generalizes the expiry."""
+        adv = PVMAdversary(drop_proposal=lambda t, v: t == 4,
+                           drop_votes=lambda t, v: t == 4)
+        sim = PVMSimulation(goldfish(16), adv)
+        sim.run_slots(10)
+        assert len(sim.chain_of(0)) >= 10
+
+
+class TestSSF:
+    def test_single_slot_finality_under_synchrony(self):
+        """pos-evolution.md:1637: honest proposer + synchrony + honest
+        supermajority => the proposal justifies and (via acknowledgments,
+        :1646) finalizes within its own slot."""
+        sim = SSFSimulation(16)
+        sim.run_slots(6)
+        assert sim.max_finalized_slot() >= 5
+        # every slot's proposal finalized
+        assert len(sim.finalized) >= 6
+
+    def test_no_finality_without_supermajority(self):
+        adv = PVMAdversary(asleep=lambda t, v: v < 6)  # 10/16 < 2/3... 10*3=30<32
+        sim = SSFSimulation(16, adversary=adv)
+        sim.run_slots(5)
+        assert sim.max_finalized_slot() == 0
+
+    def test_finalized_chain_is_prefix_of_available(self):
+        """Prefix property (pos-evolution.md:1188)."""
+        sim = SSFSimulation(16)
+        sim.run_slots(6)
+        chain = sim.chain_of(0)
+        for blk in sim.finalized_blocks():
+            assert blk in chain
+
+    def test_ack_surround_slashing_truth_table(self):
+        cp = SSFCheckpoint(block=b"\x01" * 32, slot=5)
+        ack = Acknowledgment(checkpoint=cp, slot=5, validator=3)
+        surround = FFGVote(source=SSFCheckpoint(b"\x00" * 32, 4),
+                           target=SSFCheckpoint(b"\x02" * 32, 7), validator=3)
+        inside = FFGVote(source=SSFCheckpoint(b"\x00" * 32, 5),
+                         target=SSFCheckpoint(b"\x02" * 32, 6), validator=3)
+        other = FFGVote(source=SSFCheckpoint(b"\x00" * 32, 4),
+                        target=SSFCheckpoint(b"\x02" * 32, 7), validator=4)
+        assert is_ack_slashable(ack, surround)
+        assert not is_ack_slashable(ack, inside)   # source not before ack slot
+        assert not is_ack_slashable(ack, other)    # different validator
+
+    def test_honest_run_has_no_slashings(self):
+        sim = SSFSimulation(12)
+        sim.run_slots(5)
+        assert sim.detect_ack_slashings() == []
+
+
+class TestAvalancheAttack:
+    """pos-evolution.md:1469-1501: withheld flat subtree + equivocation
+    reuse displaces honest chains under vanilla (block-count) GHOST; LMD
+    vote weighting + discounting kills the attack."""
+
+    def _honest_chain(self, view, length, start_slot=1, parent=GENESIS_ROOT):
+        roots = []
+        for i in range(length):
+            b = PVMBlock(slot=start_slot + i, parent=parent, proposer=100 + i)
+            view.add_block(b)
+            parent = b.root
+            roots.append(b.root)
+        return roots
+
+    def test_withheld_subtree_displaces_honest_chain(self):
+        view = View()
+        honest = self._honest_chain(view, 6)
+        # adversary releases k=7 withheld blocks: chain g->A1->A2 with a
+        # flat wide subtree under A2 (pos-evolution.md:1489-1495)
+        a1 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=0, salt=1)
+        a2 = PVMBlock(slot=2, parent=a1.root, proposer=1, salt=1)
+        view.add_block(a1)
+        view.add_block(a2)
+        for k in range(5):
+            view.add_block(PVMBlock(slot=3 + k, parent=a2.root,
+                                    proposer=2 + k, salt=1))
+        head = vanilla_ghost_head(view)
+        assert not view.is_ancestor(honest[0], head), "honest chain survived"
+        assert view.is_ancestor(a1.root, head)
+
+    def test_equivocation_reuse_displaces_again(self):
+        view = View()
+        a1 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=0, salt=1)
+        a2 = PVMBlock(slot=2, parent=a1.root, proposer=1, salt=1)
+        view.add_block(a1)
+        view.add_block(a2)
+        for k in range(5):
+            view.add_block(PVMBlock(slot=3 + k, parent=a2.root,
+                                    proposer=2 + k, salt=1))
+        # honest validators now build on the adversary's tip
+        honest_new = self._honest_chain(view, 3, start_slot=8, parent=a2.root)
+        # adversary REUSES blocks 3..6 as equivocations (same proposer+slot,
+        # different parent) deeper in its own chain
+        deep_parent = a2.root
+        for k in range(4):
+            eq = PVMBlock(slot=3 + k, parent=deep_parent, proposer=2 + k, salt=2)
+            view.add_block(eq)
+            deep_parent = eq.root
+        head = vanilla_ghost_head(view)
+        assert not view.is_ancestor(honest_new[0], head), \
+            "honest blocks survived the reuse round"
+
+    def test_lmd_with_discounting_defeats_avalanche(self):
+        """pos-evolution.md:1501: under the vote-based LMD rule with
+        equivocation discounting the withheld blocks carry no weight."""
+        view = View()
+        honest = self._honest_chain(view, 6)
+        # honest validators actually voted for their chain
+        for v in range(8):
+            view.add_vote(HeadVote(slot=6, block_root=honest[-1], validator=v))
+        a1 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=0, salt=1)
+        a2 = PVMBlock(slot=2, parent=a1.root, proposer=1, salt=1)
+        view.add_block(a1)
+        view.add_block(a2)
+        for k in range(5):
+            view.add_block(PVMBlock(slot=3 + k, parent=a2.root,
+                                    proposer=2 + k, salt=1))
+        head = ghost_head(view, 7, None)
+        assert view.is_ancestor(honest[0], head), "LMD failed to hold the chain"
