@@ -153,7 +153,7 @@ def build_dense_store(store, capacity: int | None = None):
     """
     from pos_evolution_tpu.config import GENESIS_EPOCH, cfg
     from pos_evolution_tpu.specs.forkchoice import (
-        get_current_slot, get_proposer_boost,
+        _leaf_is_viable, get_current_slot, get_proposer_boost,
     )
     from pos_evolution_tpu.specs.helpers import compute_epoch_at_slot
 
@@ -171,21 +171,15 @@ def build_dense_store(store, capacity: int | None = None):
     rank_arr = np.zeros(capacity, dtype=np.int32)
     rank_arr[:b] = rank
 
-    jc, fc_ = store.justified_checkpoint, store.finalized_checkpoint
+    jc = store.justified_checkpoint
     for i, root in enumerate(roots):
         block = store.blocks[root]
         real[i] = True
         slot[i] = int(block.slot)
         pr = bytes(block.parent_root)
         parent[i] = index_of.get(pr, -1)
-        head_state = store.block_states[root]
-        correct_justified = (
-            int(jc.epoch) == GENESIS_EPOCH
-            or head_state.current_justified_checkpoint == jc)
-        correct_finalized = (
-            int(fc_.epoch) == GENESIS_EPOCH
-            or head_state.finalized_checkpoint == fc_)
-        leaf_viable[i] = correct_justified and correct_finalized
+        # same voting-source viability rule as the spec layer
+        leaf_viable[i] = _leaf_is_viable(store, root)
 
     justified_state = store.checkpoint_states[jc.as_key()]
     n = len(justified_state.validators)

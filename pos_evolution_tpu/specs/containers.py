@@ -59,10 +59,9 @@ class Bytes32Rows(Sedes):
         self.is_list = is_list
 
     def is_fixed(self):
-        return not self.is_list
-
-    def fixed_size(self):
-        return 32 * self.limit
+        # Offset-framed even in the Vector case: the runtime length is
+        # config-dependent (minimal vs mainnet presets share the class).
+        return False
 
     def serialize(self, value) -> bytes:
         return np.ascontiguousarray(value, dtype=np.uint8).tobytes()

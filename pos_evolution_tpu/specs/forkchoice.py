@@ -154,13 +154,24 @@ def get_latest_attesting_balance(store: Store, root: bytes) -> int:
 # --- viable-branch filtering (pos-evolution.md:874-880, 1104-1106) ------------
 
 def _leaf_is_viable(store: Store, root: bytes) -> bool:
+    """A leaf is viable when its chain's justified view has caught up to the
+    store's (pos-evolution.md:874-880): its voting source matches the
+    store's justified epoch (with a 2-epoch catch-up grace so anchors
+    resumed mid-chain stay viable), and it descends from the store's
+    finalized checkpoint."""
     head_state = store.block_states[root]
+    current_epoch = compute_epoch_at_slot(get_current_slot(store))
+    voting_source = head_state.current_justified_checkpoint
     correct_justified = (
         int(store.justified_checkpoint.epoch) == GENESIS_EPOCH
-        or head_state.current_justified_checkpoint == store.justified_checkpoint)
+        or int(voting_source.epoch) == int(store.justified_checkpoint.epoch)
+        or int(voting_source.epoch) + 2 >= current_epoch)
+    finalized_slot = compute_start_slot_at_epoch(int(store.finalized_checkpoint.epoch))
     correct_finalized = (
         int(store.finalized_checkpoint.epoch) == GENESIS_EPOCH
-        or head_state.finalized_checkpoint == store.finalized_checkpoint)
+        or (int(store.blocks[root].slot) > finalized_slot
+            and get_ancestor(store, root, finalized_slot)
+            == bytes(store.finalized_checkpoint.root)))
     return correct_justified and correct_finalized
 
 

@@ -1,0 +1,17 @@
+"""Auxiliary subsystems: snapshots, metrics/tracing, invariants."""
+
+from pos_evolution_tpu.utils.metrics import (
+    HandlerTimer,
+    StoreInvariantChecker,
+    slot_record,
+)
+from pos_evolution_tpu.utils.snapshot import (
+    load_anchor,
+    load_dense,
+    load_store,
+    resume_store,
+    save_anchor,
+    save_dense,
+    save_store,
+    snapshot_head,
+)

@@ -1,0 +1,116 @@
+"""Observability: per-handler tracing + structured per-slot metrics
+(SURVEY.md §5 tracing/metrics; absent in the reference, which is prose).
+
+- ``HandlerTimer``: wall-clock tracing of ``on_block`` / ``on_attestation``
+  / ``get_head`` with percentile summaries — the north-star fork-choice p50
+  metric comes from here.
+- ``SlotLog``: the structured per-slot record mirroring the quantities the
+  spec itself tracks in state (justification bits pos-evolution.md:364,
+  participation flags :361-362, equivocator set :897).
+- ``StoreInvariantChecker``: the concurrency-adjacent contract of
+  pos-evolution.md:1041 (failed handlers must not modify the store),
+  enforced by snapshot/compare around handler calls — the framework's
+  "race detector" analogue (the handlers are the only mutation sites).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+import numpy as np
+
+
+class HandlerTimer:
+    """Collects wall-clock samples per named handler."""
+
+    def __init__(self):
+        self.samples: dict[str, list[float]] = defaultdict(list)
+
+    @contextmanager
+    def track(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.samples[name].append(time.perf_counter() - t0)
+
+    def wrap(self, name: str, fn):
+        def wrapped(*a, **kw):
+            with self.track(name):
+                return fn(*a, **kw)
+        return wrapped
+
+    def percentile(self, name: str, q: float) -> float:
+        xs = self.samples.get(name, [])
+        return float(np.percentile(xs, q)) if xs else float("nan")
+
+    def summary(self) -> dict:
+        return {
+            name: {
+                "count": len(xs),
+                "p50_ms": round(float(np.percentile(xs, 50)) * 1e3, 4),
+                "p95_ms": round(float(np.percentile(xs, 95)) * 1e3, 4),
+                "total_s": round(float(np.sum(xs)), 4),
+            }
+            for name, xs in self.samples.items()
+        }
+
+
+def slot_record(store, slot: int) -> dict:
+    """Structured per-slot log entry (SURVEY.md §5 metrics)."""
+    from pos_evolution_tpu.specs.forkchoice import get_head
+    head = get_head(store)
+    head_state = store.block_states[head]
+    n = len(head_state.validators)
+    participation = (
+        float((head_state.current_epoch_participation > 0).sum()) / n if n else 0.0)
+    return {
+        "slot": slot,
+        "head_root": head.hex()[:16],
+        "head_slot": int(store.blocks[head].slot),
+        "justified_epoch": int(store.justified_checkpoint.epoch),
+        "finalized_epoch": int(store.finalized_checkpoint.epoch),
+        "justification_bits": head_state.justification_bits.astype(int).tolist(),
+        "participation": round(participation, 4),
+        "n_blocks": len(store.blocks),
+        "n_latest_messages": len(store.latest_messages),
+        "equivocators": len(store.equivocating_indices),
+    }
+
+
+class StoreInvariantChecker:
+    """Wraps fork-choice handlers; on handler exception, verifies the store
+    is unchanged (pos-evolution.md:1041) and re-raises."""
+
+    def __init__(self, store):
+        self.store = store
+        self.violations: list[str] = []
+
+    def _fingerprint(self):
+        s = self.store
+        return (
+            s.time,
+            tuple(sorted(s.blocks.keys())),
+            tuple(sorted((v, m.epoch, m.root) for v, m in s.latest_messages.items())),
+            (int(s.justified_checkpoint.epoch), bytes(s.justified_checkpoint.root)),
+            (int(s.finalized_checkpoint.epoch), bytes(s.finalized_checkpoint.root)),
+            (int(s.best_justified_checkpoint.epoch),
+             bytes(s.best_justified_checkpoint.root)),
+            bytes(s.proposer_boost_root),
+            frozenset(s.equivocating_indices),
+            tuple(sorted(s.checkpoint_states.keys())),
+        )
+
+    def call(self, handler, *args, **kwargs):
+        before = self._fingerprint()
+        try:
+            return handler(self.store, *args, **kwargs)
+        except AssertionError:
+            after = self._fingerprint()
+            if before != after:
+                self.violations.append(
+                    f"{getattr(handler, '__name__', handler)} mutated the store "
+                    f"on a failed call")
+            raise

@@ -1,0 +1,152 @@
+"""Checkpoint / resume (SURVEY.md §5).
+
+The reference's own resume mechanism is the anchor-state store init
+(pos-evolution.md:1077-1095) from a finalized or weak-subjectivity
+checkpoint — "checkpoints that act as new genesis" (:1216). Simulator
+snapshots therefore are SSZ-serialized ``BeaconState`` + anchor
+``BeaconBlock`` pairs (optionally the full Store), and resume goes through
+``get_forkchoice_store`` exactly like a syncing client.
+
+Dense device arrays (the TPU array level) snapshot via host offload to
+``.npz`` — the orbax-style path for registry-scale state.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+
+import numpy as np
+
+from pos_evolution_tpu.specs.containers import (
+    BeaconBlock,
+    BeaconState,
+    Checkpoint,
+    LatestMessage,
+)
+from pos_evolution_tpu.ssz import deserialize, hash_tree_root, serialize
+
+
+def _frame(out: io.BytesIO, payload: bytes) -> None:
+    out.write(struct.pack("<Q", len(payload)))
+    out.write(payload)
+
+
+def _unframe(buf: io.BytesIO) -> bytes:
+    (n,) = struct.unpack("<Q", buf.read(8))
+    return buf.read(n)
+
+
+# --- anchor snapshots (the spec's own mechanism) ------------------------------
+
+def save_anchor(state: BeaconState, block: BeaconBlock) -> bytes:
+    """Snapshot = SSZ(state) + SSZ(block); the pair satisfies the store-init
+    contract ``block.state_root == hash_tree_root(state)``."""
+    assert bytes(block.state_root) == hash_tree_root(state), \
+        "anchor block/state inconsistent"
+    out = io.BytesIO()
+    _frame(out, serialize(state))
+    _frame(out, serialize(block))
+    return out.getvalue()
+
+
+def load_anchor(data: bytes) -> tuple[BeaconState, BeaconBlock]:
+    buf = io.BytesIO(data)
+    state = deserialize(_unframe(buf), BeaconState)
+    block = deserialize(_unframe(buf), BeaconBlock)
+    return state, block
+
+
+def resume_store(data: bytes):
+    """Rebuild a fork-choice store from a snapshot — the weak-subjectivity
+    sync flow (pos-evolution.md:1221, 1293)."""
+    from pos_evolution_tpu.specs.forkchoice import get_forkchoice_store
+    state, block = load_anchor(data)
+    return get_forkchoice_store(state, block)
+
+
+def snapshot_head(store) -> bytes:
+    """Snapshot the current head block + post-state of a running store."""
+    from pos_evolution_tpu.specs.forkchoice import get_head
+    head = get_head(store)
+    return save_anchor(store.block_states[head], store.blocks[head])
+
+
+# --- full-store snapshots -----------------------------------------------------
+
+def save_store(store) -> bytes:
+    """Serialize an entire Store (view) for exact-resume debugging."""
+    out = io.BytesIO()
+    meta = {
+        "time": store.time,
+        "genesis_time": store.genesis_time,
+        "justified": [int(store.justified_checkpoint.epoch),
+                      bytes(store.justified_checkpoint.root).hex()],
+        "finalized": [int(store.finalized_checkpoint.epoch),
+                      bytes(store.finalized_checkpoint.root).hex()],
+        "best_justified": [int(store.best_justified_checkpoint.epoch),
+                           bytes(store.best_justified_checkpoint.root).hex()],
+        "proposer_boost_root": bytes(store.proposer_boost_root).hex(),
+        "equivocating": sorted(store.equivocating_indices),
+        "latest_messages": {str(v): [m.epoch, m.root.hex()]
+                            for v, m in store.latest_messages.items()},
+        "block_order": [r.hex() for r in store.blocks],
+        "checkpoint_keys": [[e, r.hex()] for (e, r) in store.checkpoint_states],
+    }
+    _frame(out, json.dumps(meta).encode())
+    for root in store.blocks:
+        _frame(out, serialize(store.blocks[root]))
+        _frame(out, serialize(store.block_states[root]))
+    for key in store.checkpoint_states:
+        _frame(out, serialize(store.checkpoint_states[key]))
+    return out.getvalue()
+
+
+def load_store(data: bytes):
+    from pos_evolution_tpu.specs.forkchoice import Store
+    buf = io.BytesIO(data)
+    meta = json.loads(_unframe(buf).decode())
+    blocks, block_states = {}, {}
+    for root_hex in meta["block_order"]:
+        block = deserialize(_unframe(buf), BeaconBlock)
+        state = deserialize(_unframe(buf), BeaconState)
+        blocks[bytes.fromhex(root_hex)] = block
+        block_states[bytes.fromhex(root_hex)] = state
+    checkpoint_states = {}
+    for epoch, root_hex in meta["checkpoint_keys"]:
+        checkpoint_states[(epoch, bytes.fromhex(root_hex))] = \
+            deserialize(_unframe(buf), BeaconState)
+
+    def cp(pair):
+        return Checkpoint(epoch=pair[0], root=bytes.fromhex(pair[1]))
+
+    return Store(
+        time=meta["time"],
+        genesis_time=meta["genesis_time"],
+        justified_checkpoint=cp(meta["justified"]),
+        finalized_checkpoint=cp(meta["finalized"]),
+        best_justified_checkpoint=cp(meta["best_justified"]),
+        proposer_boost_root=bytes.fromhex(meta["proposer_boost_root"]),
+        equivocating_indices=set(meta["equivocating"]),
+        blocks=blocks,
+        block_states=block_states,
+        checkpoint_states=checkpoint_states,
+        latest_messages={int(v): LatestMessage(epoch=m[0], root=bytes.fromhex(m[1]))
+                         for v, m in meta["latest_messages"].items()},
+    )
+
+
+# --- dense-array host offload -------------------------------------------------
+
+def save_dense(path: str, registry) -> None:
+    """Host-offload a DenseRegistry pytree to .npz."""
+    np.savez_compressed(path, **{f: np.asarray(getattr(registry, f))
+                                 for f in registry._fields})
+
+
+def load_dense(path: str):
+    from pos_evolution_tpu.ops.epoch import DenseRegistry
+    import jax.numpy as jnp
+    with np.load(path) as z:
+        return DenseRegistry(**{f: jnp.asarray(z[f]) for f in DenseRegistry._fields})

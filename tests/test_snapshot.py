@@ -1,0 +1,116 @@
+"""Checkpoint/resume + observability tests (SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.specs import forkchoice as fc
+from pos_evolution_tpu.specs.genesis import make_genesis
+from pos_evolution_tpu.specs.validator import attest_all_committees, build_block
+from pos_evolution_tpu.sim import Simulation
+from pos_evolution_tpu.ssz import hash_tree_root
+from pos_evolution_tpu.utils import (
+    HandlerTimer,
+    StoreInvariantChecker,
+    load_anchor,
+    load_store,
+    resume_store,
+    save_anchor,
+    save_store,
+    slot_record,
+    snapshot_head,
+)
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+class TestStateRoundtrip:
+    def test_beacon_state_ssz_roundtrip(self):
+        from pos_evolution_tpu.specs.containers import BeaconState
+        from pos_evolution_tpu.ssz import deserialize, serialize
+        state, _ = make_genesis(16)
+        data = serialize(state)
+        back = deserialize(data, BeaconState)
+        assert hash_tree_root(back) == hash_tree_root(state)
+
+    def test_post_transition_state_roundtrip(self):
+        from pos_evolution_tpu.specs.containers import BeaconState
+        from pos_evolution_tpu.specs.transition import state_transition
+        from pos_evolution_tpu.ssz import deserialize, serialize
+        state, _ = make_genesis(16)
+        sb = build_block(state, 1)
+        state_transition(state, sb, True)
+        back = deserialize(serialize(state), BeaconState)
+        assert hash_tree_root(back) == hash_tree_root(state)
+
+
+class TestAnchorResume:
+    def test_resume_from_head_snapshot_continues_chain(self):
+        """Resume == the reference's own anchor mechanism (:1077, :1216)."""
+        sim = Simulation(32)
+        sim.run_epochs(3)
+        snap = snapshot_head(sim.store())
+
+        store2 = resume_store(snap)
+        head = fc.get_head(store2)
+        anchor_state = store2.block_states[head]
+        # the resumed store accepts and follows new blocks
+        slot = int(anchor_state.slot) + 1
+        fc.on_tick(store2, store2.genesis_time + slot * 12)
+        sb = build_block(anchor_state, slot)
+        fc.on_block(store2, sb)
+        assert fc.get_head(store2) == hash_tree_root(sb.message)
+
+    def test_anchor_consistency_enforced(self):
+        state, block = make_genesis(8)
+        block.state_root = b"\x09" * 32
+        with pytest.raises(AssertionError):
+            save_anchor(state, block)
+
+
+class TestFullStoreSnapshot:
+    def test_store_roundtrip_preserves_head_and_messages(self):
+        sim = Simulation(32)
+        sim.run_epochs(2)
+        store = sim.store()
+        data = save_store(store)
+        back = load_store(data)
+        assert fc.get_head(back) == fc.get_head(store)
+        assert back.latest_messages == store.latest_messages
+        assert back.justified_checkpoint == store.justified_checkpoint
+        # the restored store keeps processing
+        slot = fc.get_current_slot(back) + 1
+        fc.on_tick(back, back.genesis_time + slot * 12)
+        head_state = back.block_states[fc.get_head(back)]
+        sb = build_block(head_state, slot)
+        fc.on_block(back, sb)
+
+
+class TestObservability:
+    def test_handler_timer_percentiles(self):
+        sim = Simulation(32)
+        timer = HandlerTimer()
+        timed_head = timer.wrap("get_head", fc.get_head)
+        sim.run_epochs(1)
+        for _ in range(5):
+            timed_head(sim.store())
+        s = timer.summary()["get_head"]
+        assert s["count"] == 5 and s["p50_ms"] >= 0
+
+    def test_slot_record_fields(self):
+        sim = Simulation(32)
+        sim.run_epochs(2)
+        rec = slot_record(sim.store(), sim.slot)
+        assert rec["head_slot"] == 2 * 8
+        assert 0 <= rec["participation"] <= 1
+        assert rec["n_latest_messages"] > 0
+
+    def test_invariant_checker_passes_on_honest_handlers(self):
+        state, anchor = make_genesis(16)
+        store = fc.get_forkchoice_store(state, anchor)
+        checker = StoreInvariantChecker(store)
+        fc.on_tick(store, store.genesis_time + 12)
+        sb = build_block(state, 1)
+        sb.signature = b"\x00" * 96  # invalid: handler must not mutate
+        with pytest.raises(AssertionError):
+            checker.call(fc.on_block, sb)
+        assert checker.violations == []
