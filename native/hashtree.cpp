@@ -1,0 +1,184 @@
+// Native SSZ hashing core (component N2, SURVEY.md §2.7).
+//
+// The reference's implied native dependency: every real pyspec deployment
+// links a native SHA-256/merkleization library for seed derivation
+// (pos-evolution.md:486), the swap-or-not shuffle's per-round position
+// hashes (:522-530), per-block state roots (:423), and the "<32 MB
+// re-merkleized per epoch" balances array (:114).
+//
+// Exposed C ABI (loaded via ctypes from pos_evolution_tpu/native.py):
+//   ht_sha256_batch   - N independent equal-length messages
+//   ht_merkleize      - padded binary merkle root with zero-subtree
+//                       virtualization (SSZ merkleize(chunks, limit))
+//   ht_validator_roots- batched 8-leaf hash_tree_root per validator record
+//
+// Build: g++ -O3 -shared -fPIC (see Makefile). No external dependencies.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+constexpr uint32_t H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+inline void compress(uint32_t state[8], const uint8_t *block) {
+  uint32_t w[64];
+  for (int t = 0; t < 16; ++t) {
+    w[t] = (uint32_t(block[4 * t]) << 24) | (uint32_t(block[4 * t + 1]) << 16) |
+           (uint32_t(block[4 * t + 2]) << 8) | uint32_t(block[4 * t + 3]);
+  }
+  for (int t = 16; t < 64; ++t) {
+    uint32_t s0 = rotr(w[t - 15], 7) ^ rotr(w[t - 15], 18) ^ (w[t - 15] >> 3);
+    uint32_t s1 = rotr(w[t - 2], 17) ^ rotr(w[t - 2], 19) ^ (w[t - 2] >> 10);
+    w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int t = 0; t < 64; ++t) {
+    uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + s1 + ch + K[t] + w[t];
+    uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = s0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+inline void digest_to_bytes(const uint32_t state[8], uint8_t *out) {
+  for (int i = 0; i < 8; ++i) {
+    out[4 * i] = uint8_t(state[i] >> 24);
+    out[4 * i + 1] = uint8_t(state[i] >> 16);
+    out[4 * i + 2] = uint8_t(state[i] >> 8);
+    out[4 * i + 3] = uint8_t(state[i]);
+  }
+}
+
+void sha256_one(const uint8_t *msg, uint64_t len, uint8_t *out) {
+  uint32_t state[8];
+  std::memcpy(state, H0, sizeof(H0));
+  uint64_t full = len / 64;
+  for (uint64_t b = 0; b < full; ++b) compress(state, msg + 64 * b);
+  uint8_t tail[128];
+  uint64_t rem = len - 64 * full;
+  std::memset(tail, 0, sizeof(tail));
+  std::memcpy(tail, msg + 64 * full, rem);
+  tail[rem] = 0x80;
+  uint64_t tail_blocks = (rem + 1 + 8 > 64) ? 2 : 1;
+  uint64_t bits = len * 8;
+  for (int i = 0; i < 8; ++i)
+    tail[64 * tail_blocks - 1 - i] = uint8_t(bits >> (8 * i));
+  for (uint64_t b = 0; b < tail_blocks; ++b) compress(state, tail + 64 * b);
+  digest_to_bytes(state, out);
+}
+
+// hash of two concatenated 32-byte nodes: the merkle combiner
+inline void hash_pair(const uint8_t *left, const uint8_t *right, uint8_t *out) {
+  uint32_t state[8];
+  std::memcpy(state, H0, sizeof(H0));
+  uint8_t block[64];
+  std::memcpy(block, left, 32);
+  std::memcpy(block + 32, right, 32);
+  compress(state, block);
+  // padding block for a 64-byte message
+  uint8_t pad[64];
+  std::memset(pad, 0, sizeof(pad));
+  pad[0] = 0x80;
+  pad[62] = 0x02;  // 512 bits big-endian
+  compress(state, pad);
+  digest_to_bytes(state, out);
+}
+
+constexpr int MAX_DEPTH = 64;
+uint8_t ZERO_HASHES[MAX_DEPTH + 1][32];
+bool zero_ready = false;
+
+void init_zero_hashes() {
+  if (zero_ready) return;
+  std::memset(ZERO_HASHES[0], 0, 32);
+  for (int i = 0; i < MAX_DEPTH; ++i)
+    hash_pair(ZERO_HASHES[i], ZERO_HASHES[i], ZERO_HASHES[i + 1]);
+  zero_ready = true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// msgs: n contiguous messages of `len` bytes; out: n x 32 bytes.
+void ht_sha256_batch(const uint8_t *msgs, uint64_t n, uint64_t len,
+                     uint8_t *out) {
+  for (uint64_t i = 0; i < n; ++i)
+    sha256_one(msgs + i * len, len, out + 32 * i);
+}
+
+// SSZ merkleize(chunks, limit): chunks = count x 32 bytes; depth =
+// ceil(log2(max(limit,1))). Scratch must hold count*32 bytes (may alias a
+// copy of chunks). Root written to out (32 bytes).
+void ht_merkleize(const uint8_t *chunks, uint64_t count, uint32_t depth,
+                  uint8_t *scratch, uint8_t *out) {
+  init_zero_hashes();
+  if (count == 0) {
+    std::memcpy(out, ZERO_HASHES[depth], 32);
+    return;
+  }
+  std::memcpy(scratch, chunks, count * 32);
+  uint64_t width = count;
+  for (uint32_t level = 0; level < depth; ++level) {
+    uint64_t next = width / 2;
+    for (uint64_t i = 0; i < next; ++i)
+      hash_pair(scratch + 64 * i, scratch + 64 * i + 32, scratch + 32 * i);
+    if (width % 2 == 1) {
+      hash_pair(scratch + 32 * (width - 1), ZERO_HASHES[level],
+                scratch + 32 * next);
+      ++next;
+    }
+    width = next;
+  }
+  std::memcpy(out, scratch, 32);
+}
+
+// Batched Validator hash_tree_root: 8 leaves per validator, depth-3 tree
+// (SURVEY.md §2.1 Validator layout). leaves: n x 256 bytes (8 chunks);
+// out: n x 32.
+void ht_validator_roots(const uint8_t *leaves, uint64_t n, uint8_t *out) {
+  uint8_t level1[4 * 32];
+  uint8_t level2[2 * 32];
+  for (uint64_t v = 0; v < n; ++v) {
+    const uint8_t *leaf = leaves + 256 * v;
+    for (int i = 0; i < 4; ++i)
+      hash_pair(leaf + 64 * i, leaf + 64 * i + 32, level1 + 32 * i);
+    hash_pair(level1, level1 + 32, level2);
+    hash_pair(level1 + 64, level1 + 96, level2 + 32);
+    hash_pair(level2, level2 + 32, out + 32 * v);
+  }
+}
+
+// Mix a list length into a root: sha256(root || le64(length) padded to 32).
+void ht_mix_in_length(const uint8_t *root, uint64_t length, uint8_t *out) {
+  uint8_t len_chunk[32];
+  std::memset(len_chunk, 0, 32);
+  for (int i = 0; i < 8; ++i) len_chunk[i] = uint8_t(length >> (8 * i));
+  hash_pair(root, len_chunk, out);
+}
+
+}  // extern "C"

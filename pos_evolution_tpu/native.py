@@ -1,0 +1,94 @@
+"""ctypes bindings for the native (C++) runtime components.
+
+Loads ``native/build/libhashtree.so`` (component N2, SURVEY.md §2.7),
+building it with the in-tree Makefile on first use when a toolchain is
+available. Falls back cleanly to the NumPy/hashlib paths when absent, so
+the framework stays importable without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from functools import lru_cache
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                           "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libhashtree.so")
+
+
+@lru_cache(maxsize=1)
+def _load():
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ht_sha256_batch.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64, u8p]
+    lib.ht_merkleize.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint32, u8p, u8p]
+    lib.ht_validator_roots.argtypes = [u8p, ctypes.c_uint64, u8p]
+    lib.ht_mix_in_length.argtypes = [u8p, ctypes.c_uint64, u8p]
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def sha256_batch(msgs: np.ndarray) -> np.ndarray:
+    """(N, L) uint8 -> (N, 32) digests via the C++ core."""
+    lib = _load()
+    msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
+    n, length = msgs.shape
+    out = np.empty((n, 32), dtype=np.uint8)
+    if n:
+        lib.ht_sha256_batch(_ptr(msgs), n, length, _ptr(out))
+    return out
+
+
+def merkleize_chunks(chunks: np.ndarray, limit: int | None = None) -> bytes:
+    """Whole-tree SSZ merkleize in one native call."""
+    lib = _load()
+    chunks = np.ascontiguousarray(chunks, dtype=np.uint8).reshape(-1, 32)
+    count = chunks.shape[0]
+    if limit is None:
+        limit = max(count, 1)
+    if count > limit:
+        raise ValueError(f"{count} chunks exceed limit {limit}")
+    depth = (max(limit, 1) - 1).bit_length() if limit > 1 else 0
+    out = np.empty(32, dtype=np.uint8)
+    scratch = np.empty(max(count, 1) * 32, dtype=np.uint8)
+    lib.ht_merkleize(_ptr(chunks), count, depth, _ptr(scratch), _ptr(out))
+    return out.tobytes()
+
+
+def validator_roots(leaves: np.ndarray) -> np.ndarray:
+    """(N, 8, 32) field-leaf chunks -> (N, 32) Validator roots."""
+    lib = _load()
+    leaves = np.ascontiguousarray(leaves, dtype=np.uint8).reshape(-1, 256)
+    n = leaves.shape[0]
+    out = np.empty((n, 32), dtype=np.uint8)
+    if n:
+        lib.ht_validator_roots(_ptr(leaves), n, _ptr(out))
+    return out
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    lib = _load()
+    root_arr = np.frombuffer(bytes(root), dtype=np.uint8).copy()
+    out = np.empty(32, dtype=np.uint8)
+    lib.ht_mix_in_length(_ptr(root_arr), length, _ptr(out))
+    return out.tobytes()

@@ -98,14 +98,25 @@ def _pad_messages(msgs: np.ndarray) -> np.ndarray:
 # Below this batch size the fixed Python overhead of the lane kernel
 # (~300 numpy dispatches) loses to a C hashlib loop.
 _LANE_THRESHOLD = 1024
+# Above this size, dispatch to the native C++ core (component N2) when built.
+_NATIVE_THRESHOLD = 64
+
+
+def _native():
+    try:
+        from pos_evolution_tpu import native
+        return native if native.available() else None
+    except Exception:
+        return None
 
 
 def sha256_batch(msgs: np.ndarray) -> np.ndarray:
     """SHA-256 of N equal-length messages at once.
 
-    msgs: (N, L) uint8 array. Returns (N, 32) uint8 digests. Small batches
-    go through hashlib (C, ~1us each); large batches use the vectorized
-    uint32-lane kernel (the same formulation as the TPU kernel).
+    msgs: (N, L) uint8 array. Returns (N, 32) uint8 digests. Dispatch:
+    tiny batches -> hashlib loop; medium/large -> native C++ core (N2)
+    when built; fallback -> vectorized uint32-lane kernel (the same
+    formulation as the TPU kernel).
     """
     msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
     if msgs.ndim != 2:
@@ -113,6 +124,10 @@ def sha256_batch(msgs: np.ndarray) -> np.ndarray:
     n = msgs.shape[0]
     if n == 0:
         return np.empty((0, 32), dtype=np.uint8)
+    if n >= _NATIVE_THRESHOLD:
+        native = _native()
+        if native is not None:
+            return native.sha256_batch(msgs)
     if n < _LANE_THRESHOLD:
         out = np.empty((n, 32), dtype=np.uint8)
         raw = msgs.tobytes()

@@ -63,6 +63,14 @@ def merkleize_chunks(chunks: np.ndarray, limit: int | None = None) -> bytes:
     depth = _depth_for(limit)
     if count == 0:
         return ZERO_HASHES[depth].tobytes()
+    if count >= 32:
+        # Whole-tree merkleization in one native call (component N2).
+        try:
+            from pos_evolution_tpu import native
+            if native.available():
+                return native.merkleize_chunks(chunks, limit)
+        except Exception:
+            pass
     layer = chunks
     for level in range(depth):
         if layer.shape[0] % 2 == 1:
