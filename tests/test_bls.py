@@ -1,0 +1,116 @@
+"""BLS12-381 tests (component N1): pairing algebra, signature scheme,
+serialization, and the spec layer running on the real crypto backend
+(the bls-setting toggle of SURVEY.md §4.4a).
+"""
+
+import pytest
+
+from pos_evolution_tpu.crypto import bls12_381 as B
+from pos_evolution_tpu.crypto.bls import FakeBLS, set_bls_backend
+
+
+class TestPairing:
+    def test_generators_in_subgroups(self):
+        assert B.g1_on_curve(B.G1_GEN)
+        assert B.g2_on_curve(B.G2_GEN)
+        assert B.subgroup_check_g1(B.G1_GEN)
+        assert B.subgroup_check_g2(B.G2_GEN)
+
+    def test_bilinearity(self):
+        e1 = B.pairing(B.G1_GEN, B.G2_GEN)
+        assert not e1.is_one()
+        e2 = B.pairing(B.ec_mul(B.G1_GEN, 2), B.G2_GEN)
+        assert e2 == e1 * e1
+        # e(2P, 3Q) == e(P, Q)^6
+        e6 = B.pairing(B.ec_mul(B.G1_GEN, 2), B.ec_mul(B.G2_GEN, 3))
+        assert e6 == e1.pow(6)
+
+    def test_pairings_equal_product_check(self):
+        # e(g1, 5*g2) == e(5*g1, g2)
+        assert B.pairings_equal(
+            [(B.G1_GEN, B.ec_mul(B.G2_GEN, 5))],
+            [(B.ec_mul(B.G1_GEN, 5), B.G2_GEN)])
+
+
+class TestSerialization:
+    def test_g1_roundtrip(self):
+        for k in (1, 2, 7, 123456789):
+            p = B.ec_mul(B.G1_GEN, k)
+            assert B.g1_decompress(B.g1_compress(p)) == p
+
+    def test_g2_roundtrip(self):
+        for k in (1, 3, 99):
+            p = B.ec_mul(B.G2_GEN, k)
+            assert B.g2_decompress(B.g2_compress(p)) == p
+
+    def test_infinity(self):
+        assert B.g1_decompress(B.g1_compress(None)) is None
+        assert B.g2_decompress(B.g2_compress(None)) is None
+
+    def test_invalid_x_rejected(self):
+        bad = (B._FLAG_COMPRESSED | 5).to_bytes(48, "big")
+        # x = 5 has no y on G1 (or decompresses fine; accept either but
+        # require determinism)
+        try:
+            p = B.g1_decompress(bad)
+            assert B.g1_on_curve(p)
+        except ValueError:
+            pass
+
+
+class TestSignatures:
+    def test_sign_verify(self):
+        pk = B.PyBLS.SkToPk(42)
+        msg = b"\x01" * 32
+        sig = B.PyBLS.Sign(42, msg)
+        assert len(pk) == 48 and len(sig) == 96
+        assert B.PyBLS.Verify(pk, msg, sig)
+        assert not B.PyBLS.Verify(pk, b"\x02" * 32, sig)
+        assert not B.PyBLS.Verify(B.PyBLS.SkToPk(43), msg, sig)
+
+    def test_fast_aggregate_verify(self):
+        msg = b"\x07" * 32
+        pks = [B.PyBLS.SkToPk(k) for k in (1, 2, 3)]
+        agg = B.PyBLS.Aggregate([B.PyBLS.Sign(k, msg) for k in (1, 2, 3)])
+        assert B.PyBLS.FastAggregateVerify(pks, msg, agg)
+        assert not B.PyBLS.FastAggregateVerify(pks[:2], msg, agg)
+        assert not B.PyBLS.FastAggregateVerify([], msg, agg)
+
+
+class TestSpecOnRealBLS:
+    def test_block_transition_with_real_crypto(self, minimal_cfg):
+        """The spec layer is crypto-agnostic: a block with a real-BLS
+        proposer signature, RANDAO reveal, and aggregate attestation
+        passes state_transition (pos-evolution.md:412-424)."""
+        set_bls_backend(B.PyBLS)
+        try:
+            from pos_evolution_tpu.specs.genesis import make_genesis
+            from pos_evolution_tpu.specs.transition import state_transition
+            from pos_evolution_tpu.specs.validator import (
+                attest_all_committees, build_block,
+            )
+            from pos_evolution_tpu.ssz import hash_tree_root
+            state, _ = make_genesis(4)
+            sb1 = build_block(state, 1)
+            state_transition(state, sb1, True)
+            atts = attest_all_committees(state, 1, hash_tree_root(sb1.message))
+            sb2 = build_block(state, 2, attestations=atts)
+            state_transition(state, sb2, True)
+            assert int(state.slot) == 2
+            assert (state.current_epoch_participation > 0).any()
+        finally:
+            set_bls_backend(FakeBLS)
+
+    def test_bad_signature_rejected_with_real_crypto(self, minimal_cfg):
+        set_bls_backend(B.PyBLS)
+        try:
+            from pos_evolution_tpu.specs.genesis import make_genesis
+            from pos_evolution_tpu.specs.transition import state_transition
+            from pos_evolution_tpu.specs.validator import build_block
+            state, _ = make_genesis(4)
+            sb = build_block(state, 1)
+            sb.signature = B.PyBLS.Sign(999, b"\x00" * 32)
+            with pytest.raises(AssertionError):
+                state_transition(state.copy(), sb, True)
+        finally:
+            set_bls_backend(FakeBLS)
