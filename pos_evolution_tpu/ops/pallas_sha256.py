@@ -1,0 +1,144 @@
+"""Pallas TPU kernel: batched SHA-256 merkle-level compression (N2).
+
+The hot merkleization shape (SURVEY.md §2.7): hash N pairs of 32-byte
+nodes -> N digests, repeated level by level (state roots pos-evolution.md
+:423, the balances-array "<32 MB per epoch" rehash :114). The kernel lays
+messages out transposed — word index on the sublane axis, message index on
+the 128-wide lane axis — so every round is pure uint32 VPU arithmetic over
+a (1, TILE) vector, and tiles stream through VMEM on a 1-D grid.
+
+Used through ``merkle_level_pallas`` (one tree level) and
+``merkleize_words_device`` (whole tree on device); falls back to the XLA
+formulation in ``ops/sha256.py`` when Pallas is unavailable.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from pos_evolution_tpu.ops.sha256 import _K, H0, sha256_pair_words  # noqa: E402
+
+TILE = 512  # messages per grid step (lanes)
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _schedule(w16: list) -> jax.Array:
+    """Expand 16 message words to the (64, TILE) schedule stack."""
+    w = list(w16)
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
+        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+    return jnp.stack(w, axis=0)
+
+
+def _rounds(state_words, w_stack, k_stack):
+    """64 compression rounds as a fori_loop over the schedule stack —
+    bounded graph size for both Mosaic and interpret-mode lowering."""
+
+    def body(t, carry):
+        a, b, c, d, e, f, g, h = carry
+        wt = jax.lax.dynamic_index_in_dim(w_stack, t, axis=0, keepdims=False)
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k_stack[t] + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        return (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
+
+    return jax.lax.fori_loop(0, 64, body, tuple(state_words))
+
+
+def _merkle_level_kernel(k_ref, in_ref, out_ref):
+    """k_ref: (64,) u32 round constants; in_ref: (16, TILE) u32 — the
+    64-byte message block of each pair, transposed; out_ref: (8, TILE) u32
+    digests (includes the fixed padding block)."""
+    lanes = in_ref.shape[1]
+    k_stack = k_ref[:]
+    w_stack = _schedule([in_ref[t, :] for t in range(16)])
+    init = tuple(jnp.full((lanes,), np.uint32(H0[i])) for i in range(8))
+    mid = _rounds(init, w_stack, k_stack)
+    state1 = tuple(mid[i] + init[i] for i in range(8))
+
+    # second block: fixed SHA-256 padding for a 64-byte message
+    zero = jnp.zeros((lanes,), dtype=jnp.uint32)
+    pad16 = [zero] * 16
+    pad16[0] = jnp.full((lanes,), np.uint32(0x80000000))
+    pad16[15] = jnp.full((lanes,), np.uint32(512))
+    fin = _rounds(state1, _schedule(pad16), k_stack)
+    for i in range(8):
+        out_ref[i, :] = fin[i] + state1[i]
+
+
+def _pallas_level_call(pairs_t: jax.Array, interpret: bool) -> jax.Array:
+    from jax.experimental import pallas as pl
+
+    n = pairs_t.shape[1]
+    return pl.pallas_call(
+        _merkle_level_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, n), jnp.uint32),
+        grid=(n // TILE,),
+        in_specs=[pl.BlockSpec((64,), lambda i: (0,)),
+                  pl.BlockSpec((16, TILE), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((8, TILE), lambda i: (0, i)),
+        interpret=interpret,
+    )(jnp.asarray(_K), pairs_t)
+
+
+_jitted_level = jax.jit(partial(_pallas_level_call, interpret=False))
+
+
+def merkle_level_pallas(pairs_t: jax.Array, interpret: bool = False) -> jax.Array:
+    """One merkle level: pairs_t (16, N) u32 (transposed 64-byte messages,
+    N a multiple of TILE) -> (8, N) u32 digests. Interpret mode runs
+    eagerly (jit-wrapping the interpreter embeds a huge graph in XLA:CPU)."""
+    if interpret:
+        return _pallas_level_call(pairs_t, interpret=True)
+    return _jitted_level(pairs_t)
+
+
+def _level_xla(nodes: jax.Array) -> jax.Array:
+    """(2k, 8) u32 digest words -> (k, 8): XLA fallback combiner."""
+    return sha256_pair_words(nodes[0::2], nodes[1::2])
+
+
+def _level(nodes: jax.Array, use_pallas: bool, interpret: bool) -> jax.Array:
+    k = nodes.shape[0] // 2
+    if not use_pallas or k % TILE != 0:
+        return _level_xla(nodes)
+    pairs_t = nodes.reshape(k, 16).T  # (16, k): word-major, message-minor
+    return merkle_level_pallas(pairs_t, interpret=interpret).T
+
+
+def merkleize_words_device(leaves: jax.Array, depth: int,
+                           zero_words: np.ndarray,
+                           use_pallas: bool = True,
+                           interpret: bool = False) -> jax.Array:
+    """Device merkle root of (N, 8) u32 digest-word leaves, padded with
+    zero-subtree roots to depth ``depth``. N must be a power of two (pad
+    the tail with ``zero_words[0]`` first).
+
+    zero_words: (depth+1, 8) u32 — ZERO_HASHES as big-endian words.
+    """
+    nodes = leaves
+    level = 0
+    while nodes.shape[0] > 1:
+        nodes = _level(nodes, use_pallas, interpret)
+        level += 1
+    root = nodes[0]
+    # fold the remaining virtual zero-subtrees up to the target depth
+    for lv in range(level, depth):
+        pair = jnp.stack([root, jnp.asarray(zero_words[lv])])
+        root = _level_xla(pair)[0]
+    return root

@@ -1,0 +1,64 @@
+"""Pallas kernel tests (interpret mode on CPU; compiled on TPU)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pos_evolution_tpu.ops.pallas_sha256 import (  # noqa: E402
+    TILE,
+    merkle_level_pallas,
+    merkleize_words_device,
+)
+from pos_evolution_tpu.ssz.merkle import ZERO_HASHES, merkleize_chunks  # noqa: E402
+
+
+def _to_words(data: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 -> (N, 8) u32 big-endian words."""
+    q = data.reshape(-1, 8, 4).astype(np.uint32)
+    return (q[..., 0] << 24) | (q[..., 1] << 16) | (q[..., 2] << 8) | q[..., 3]
+
+
+def _zero_words(depth: int) -> np.ndarray:
+    return _to_words(ZERO_HASHES[: depth + 1].reshape(-1, 32))
+
+
+class TestMerkleLevelKernel:
+    def test_matches_hashlib(self):
+        rng = np.random.default_rng(0)
+        n = TILE
+        left = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+        right = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+        msgs = np.concatenate([_to_words(left), _to_words(right)], axis=1)  # (n, 16)
+        out = np.asarray(merkle_level_pallas(
+            jax.numpy.asarray(msgs.T), interpret=True)).T
+        for i in (0, 1, n // 2, n - 1):
+            expect = hashlib.sha256(left[i].tobytes() + right[i].tobytes()).digest()
+            got = out[i].astype(">u4").tobytes()
+            assert got == expect, f"row {i} mismatch"
+
+    def test_multi_tile_grid(self):
+        rng = np.random.default_rng(1)
+        n = 2 * TILE
+        msgs = rng.integers(0, 2**32, (16, n), dtype=np.uint64).astype(np.uint32)
+        out = np.asarray(merkle_level_pallas(jax.numpy.asarray(msgs), interpret=True))
+        assert out.shape == (8, n)
+        # spot-check one column against hashlib
+        col = 777
+        block = msgs[:, col].astype(">u4").tobytes()
+        assert out[:, col].astype(">u4").tobytes() == \
+            hashlib.sha256(block).digest()
+
+
+class TestDeviceMerkleize:
+    @pytest.mark.parametrize("n,depth", [(8, 3), (8, 6), (1024, 10)])
+    def test_matches_host_merkleize(self, n, depth):
+        rng = np.random.default_rng(n)
+        chunks = rng.integers(0, 256, (n, 32), dtype=np.uint8)
+        want = merkleize_chunks(chunks, limit=2**depth)
+        got = np.asarray(merkleize_words_device(
+            jax.numpy.asarray(_to_words(chunks)), depth, _zero_words(depth),
+            use_pallas=(n // 2 % TILE == 0), interpret=True))
+        assert got.astype(">u4").tobytes() == want
