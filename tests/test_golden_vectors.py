@@ -1,0 +1,86 @@
+"""Golden-vector conformance (SURVEY.md §4.5): fixed scenarios produce
+pinned hash_tree_root / digest values, mirroring the pyspec -> client-team
+test-vector pipeline (pos-evolution.md:9). Any semantic drift in SSZ,
+state transition, shuffling, or committee assignment trips these.
+
+Regenerate intentionally with:
+    python tests/test_golden_vectors.py --regen
+"""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config, use_config
+
+VECTOR_FILE = os.path.join(os.path.dirname(__file__), "golden_vectors.json")
+
+
+def compute_vectors() -> dict:
+    with use_config(minimal_config()):
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.helpers import (
+            get_beacon_committee, get_beacon_proposer_index,
+            get_shuffled_permutation,
+        )
+        from pos_evolution_tpu.specs.transition import state_transition
+        from pos_evolution_tpu.specs.validator import (
+            attest_all_committees, build_block,
+        )
+        from pos_evolution_tpu.ssz import hash_tree_root, serialize
+
+        out = {}
+        state, anchor = make_genesis(64)
+        out["genesis_state_root"] = hash_tree_root(state).hex()
+        out["genesis_block_root"] = hash_tree_root(anchor).hex()
+
+        sb1 = build_block(state, 1)
+        state_transition(state, sb1, True)
+        out["state_root_after_block_1"] = hash_tree_root(state).hex()
+
+        atts = attest_all_committees(state, 1, hash_tree_root(sb1.message))
+        sb2 = build_block(state, 2, attestations=atts)
+        state_transition(state, sb2, True)
+        out["state_root_after_block_2"] = hash_tree_root(state).hex()
+        out["state_ssz_digest_after_block_2"] = hashlib.sha256(
+            serialize(state)).hexdigest()
+
+        # run to the end of epoch 2 (first possible justification)
+        for slot in range(3, 3 * 8 + 1):
+            atts_prev = attest_all_committees(
+                state, slot - 1, state.block_roots[(slot - 1) % 64].tobytes())
+            sb = build_block(state, slot, attestations=atts_prev)
+            state_transition(state, sb, True)
+        out["state_root_epoch_3"] = hash_tree_root(state).hex()
+        out["justified_epoch_3"] = int(state.current_justified_checkpoint.epoch)
+
+        perm = get_shuffled_permutation(b"\x21" * 32, 4096)
+        out["shuffle_4096_digest"] = hashlib.sha256(
+            np.asarray(perm, dtype=np.uint64).tobytes()).hexdigest()
+
+        fresh, _ = make_genesis(64)
+        committee = get_beacon_committee(fresh, 3, 1)
+        out["committee_slot3_idx1"] = [int(v) for v in committee]
+        out["proposer_slot_0"] = int(get_beacon_proposer_index(fresh))
+        return out
+
+
+@pytest.mark.skipif(not os.path.exists(VECTOR_FILE),
+                    reason="golden vectors not generated")
+def test_golden_vectors_stable():
+    with open(VECTOR_FILE) as f:
+        want = json.load(f)
+    got = compute_vectors()
+    mismatches = {k: (want[k], got[k]) for k in want if got.get(k) != want[k]}
+    assert not mismatches, f"golden vectors drifted: {mismatches}"
+
+
+if __name__ == "__main__":
+    import sys
+    if "--regen" in sys.argv:
+        with open(VECTOR_FILE, "w") as f:
+            json.dump(compute_vectors(), f, indent=1)
+        print(f"wrote {VECTOR_FILE}")
