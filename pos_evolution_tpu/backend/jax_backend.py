@@ -1,0 +1,68 @@
+"""JAX/XLA ExecutionBackend: the TPU compute path behind the spec layer.
+
+Same interface as ``numpy_backend`` — spec-level functions dispatch here
+when ``set_backend("jax")`` is active. The hot kernels live in ``ops/``;
+this module adapts them to the backend API and flags the accelerated
+epoch path (``specs/epoch.process_epoch`` then runs the fused device sweep
+with exact host write-back).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+name = "jax"
+accelerated_epoch = True
+
+
+def shuffle_permutation(seed: bytes, n: int, rounds: int) -> np.ndarray:
+    from pos_evolution_tpu.ops.shuffle import shuffle_permutation_jax
+    return np.asarray(shuffle_permutation_jax(seed, n, rounds)).astype(np.uint64)
+
+
+def committee_weight_sums(effective_balance: np.ndarray, masks: np.ndarray) -> np.ndarray:
+    import jax.numpy as jnp
+    return np.asarray(
+        jnp.asarray(masks, dtype=jnp.int64) @ jnp.asarray(effective_balance))
+
+
+def segment_sum(values: np.ndarray, segment_ids: np.ndarray,
+                num_segments: int) -> np.ndarray:
+    import jax
+    import jax.numpy as jnp
+    return np.asarray(jax.ops.segment_sum(
+        jnp.asarray(values), jnp.asarray(segment_ids), num_segments=num_segments))
+
+
+def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
+    """Same contract as numpy_backend.subtree_weights (parent[i] < i)."""
+    w = node_weight.astype(np.int64).copy()
+    for i in range(len(w) - 1, 0, -1):
+        p = parent[i]
+        if p >= 0:
+            w[p] += w[i]
+    return w
+
+
+def epoch_sweep(state, cfg):
+    """Run the fused device epoch sweep for a spec-level BeaconState.
+
+    Returns the EpochResult; the caller (specs/epoch.py) performs the exact
+    host write-back and the O(changes) bookkeeping.
+    """
+    import jax.numpy as jnp
+
+    from pos_evolution_tpu.ops.epoch import densify, process_epoch_dense
+    from pos_evolution_tpu.specs.helpers import get_current_epoch
+
+    dense = densify(state)
+    return process_epoch_dense(
+        dense,
+        get_current_epoch(state),
+        int(state.finalized_checkpoint.epoch),
+        jnp.asarray(np.asarray(state.justification_bits, dtype=bool)),
+        int(state.previous_justified_checkpoint.epoch),
+        int(state.current_justified_checkpoint.epoch),
+        int(state.slashings.sum()),
+        cfg,
+    )

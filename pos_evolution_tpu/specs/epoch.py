@@ -43,6 +43,10 @@ from pos_evolution_tpu.ssz.merkle import merkleize_chunks
 
 
 def process_epoch(state: BeaconState) -> None:
+    from pos_evolution_tpu.backend import get_backend
+    if getattr(get_backend(), "accelerated_epoch", False):
+        _process_epoch_accelerated(state)
+        return
     process_justification_and_finalization(state)
     process_inactivity_updates(state)
     process_rewards_and_penalties(state)
@@ -54,6 +58,63 @@ def process_epoch(state: BeaconState) -> None:
     process_randao_mixes_reset(state)
     process_historical_roots_update(state)
     process_participation_flag_updates(state)
+    process_sync_committee_updates(state)
+
+
+def _process_epoch_accelerated(state: BeaconState) -> None:
+    """Epoch boundary via the fused device sweep (ops/epoch.py), with exact
+    host write-back — bit-identical to the NumPy pipeline above.
+
+    The device kernel covers the O(n) sweeps (justification tallies,
+    inactivity, rewards, slashings penalties, hysteresis, flag rotation);
+    the host keeps the O(changes) bookkeeping: checkpoint roots, registry
+    churn (run against pre-hysteresis effective balances, preserving the
+    reference ordering), and the per-epoch resets/rotations.
+    """
+    from pos_evolution_tpu.backend import get_backend
+    import numpy as np
+
+    current_epoch = get_current_epoch(state)
+    old_prev_justified = state.previous_justified_checkpoint
+    old_cur_justified = state.current_justified_checkpoint
+
+    out = get_backend().epoch_sweep(state, cfg())
+
+    # --- justification / finalization bookkeeping (roots live host-side) ---
+    if current_epoch > GENESIS_EPOCH + 1:
+        state.previous_justified_checkpoint = state.current_justified_checkpoint
+        if bool(out.justify_prev):
+            state.current_justified_checkpoint = Checkpoint(
+                epoch=get_previous_epoch(state),
+                root=get_block_root(state, get_previous_epoch(state)))
+        if bool(out.justify_cur):
+            state.current_justified_checkpoint = Checkpoint(
+                epoch=current_epoch, root=get_block_root(state, current_epoch))
+        state.justification_bits = np.array(out.new_justification_bits)
+        fin = int(out.finalize_epoch)
+        if fin >= 0:
+            # later finalization cases (which win in the spec) use the old
+            # *current* justified checkpoint — check it first
+            if fin == int(old_cur_justified.epoch):
+                state.finalized_checkpoint = old_cur_justified
+            elif fin == int(old_prev_justified.epoch):
+                state.finalized_checkpoint = old_prev_justified
+
+    # --- write back sweeps; effective balances AFTER churn (spec order) ---
+    reg = out.registry
+    state.balances = np.array(reg.balance).astype(np.uint64)
+    state.inactivity_scores = np.array(reg.inactivity_scores).astype(np.uint64)
+    new_eff = np.array(reg.effective_balance).astype(np.uint64)
+
+    process_registry_updates(state)  # reads pre-hysteresis effective balances
+    process_eth1_data_reset(state)
+    state.validators.effective_balance = new_eff
+    state.previous_epoch_participation = np.array(reg.prev_flags)
+    state.current_epoch_participation = np.array(reg.cur_flags)
+
+    process_slashings_reset(state)
+    process_randao_mixes_reset(state)
+    process_historical_roots_update(state)
     process_sync_committee_updates(state)
 
 

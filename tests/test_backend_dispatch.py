@@ -1,0 +1,64 @@
+"""ExecutionBackend dispatch tests (layer LB): the spec layer must produce
+bit-identical states under the numpy and jax backends (SURVEY.md §4.4b —
+"identical spec-level inputs must give bit-identical justification/
+finalization/head outputs").
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.backend import get_backend, set_backend
+from pos_evolution_tpu.specs.genesis import make_genesis
+from pos_evolution_tpu.specs.transition import state_transition
+from pos_evolution_tpu.specs.validator import attest_all_committees, build_block
+from pos_evolution_tpu.ssz import hash_tree_root
+
+jax = pytest.importorskip("jax")
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+@pytest.fixture(autouse=True)
+def _restore_backend():
+    yield
+    set_backend("numpy")
+
+
+def _run_chain(n_epochs: int):
+    state, _ = make_genesis(64)
+    atts = []
+    roots = []
+    for slot in range(1, n_epochs * 8 + 1):
+        sb = build_block(state, slot, attestations=atts)
+        state_transition(state, sb, True)
+        atts = attest_all_committees(state, slot, hash_tree_root(sb.message))
+        if slot % 8 == 0:
+            roots.append(hash_tree_root(state).hex())
+    return state, roots
+
+
+class TestBackendParity:
+    def test_chain_identical_across_backends(self):
+        set_backend("numpy")
+        state_np, roots_np = _run_chain(4)
+        set_backend("jax")
+        assert get_backend().name == "jax"
+        state_jax, roots_jax = _run_chain(4)
+        assert roots_np == roots_jax, "per-epoch state roots diverged"
+        assert int(state_jax.finalized_checkpoint.epoch) >= 2
+        assert state_np.finalized_checkpoint == state_jax.finalized_checkpoint
+
+    def test_shuffle_identical_across_backends(self):
+        from pos_evolution_tpu.specs.helpers import get_shuffled_permutation
+        seed = b"\x3c" * 32
+        set_backend("numpy")
+        p_np = np.asarray(get_shuffled_permutation(seed, 500))
+        set_backend("jax")
+        p_jax = np.asarray(get_shuffled_permutation(seed, 500))
+        assert np.array_equal(p_np, p_jax)
+
+    def test_accelerated_epoch_flag(self):
+        import pos_evolution_tpu.backend.jax_backend as jb
+        import pos_evolution_tpu.backend.numpy_backend as nb
+        assert getattr(jb, "accelerated_epoch", False)
+        assert not getattr(nb, "accelerated_epoch", False)
