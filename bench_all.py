@@ -1,0 +1,170 @@
+"""All five BASELINE.md benchmark configs, reported as one JSON object.
+
+(bench.py stays the single-line headline metric the driver records; this
+harness documents the full matrix of SURVEY.md §6 / BASELINE.json configs.)
+
+1. LMD-GHOST fork choice, 1,024 validators / 32 slots — pure-Python spec
+   ``get_head`` p50 (CPU reference) + dense head for comparison
+2. swap-or-not shuffle, 64K validators (device)
+3. attestation aggregation batch verify, 2048 aggregates / ~1M signers
+4. full process_epoch sweep, 1M validators, shard_map over the available mesh
+5. SSF supermajority tally, 1M validators, ICI->DCN psum
+
+Usage: python bench_all.py  (runs on TPU if present, CPU otherwise)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def _timeit(fn, reps=5):
+    fn(0)
+    t0 = time.perf_counter()
+    for i in range(1, reps + 1):
+        fn(i)
+    return (time.perf_counter() - t0) / reps
+
+
+def config1_forkchoice_python():
+    from pos_evolution_tpu.config import mainnet_config, use_config
+    with use_config(mainnet_config().replace(slots_per_epoch=32)):
+        from pos_evolution_tpu.specs import forkchoice as fc
+        from pos_evolution_tpu.specs.containers import LatestMessage
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import build_block
+        from pos_evolution_tpu.ssz import hash_tree_root
+
+        state, anchor = make_genesis(1024)
+        store = fc.get_forkchoice_store(state, anchor)
+        parent_state = state
+        roots = [hash_tree_root(anchor)]
+        for slot in range(1, 9):  # a chain with one fork
+            fc.on_tick(store, store.genesis_time + slot * 12)
+            sb = build_block(parent_state, slot,
+                             graffiti=bytes([slot]) * 32)
+            fc.on_block(store, sb)
+            roots.append(hash_tree_root(sb.message))
+            parent_state = store.block_states[roots[-1]]
+        # every validator has a latest message spread over the chain
+        rng = np.random.default_rng(0)
+        for v in range(1024):
+            store.latest_messages[v] = LatestMessage(
+                epoch=0, root=roots[rng.integers(0, len(roots))])
+        times = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            head = fc.get_head(store)
+            times.append(time.perf_counter() - t0)
+        out = {"p50_ms": round(float(np.percentile(times, 50)) * 1e3, 3),
+               "p95_ms": round(float(np.percentile(times, 95)) * 1e3, 3)}
+        try:
+            from pos_evolution_tpu.ops.forkchoice import get_head_dense
+            t0 = time.perf_counter()
+            dense_head = get_head_dense(store)
+            out["dense_first_call_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+            out["dense_matches"] = bool(dense_head == head)
+        except Exception as e:  # device path unavailable
+            out["dense_error"] = str(e)[:80]
+        return out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    results = {"backend": jax.default_backend(),
+               "n_devices": len(jax.devices())}
+
+    results["config1_lmd_ghost_1024"] = config1_forkchoice_python()
+
+    on_accel = jax.default_backend() != "cpu"
+    n = 1_000_000 if on_accel else 65_536
+    scale = 1_000_000 // n
+    rng = np.random.default_rng(0)
+    gwei = 10**9
+
+    # --- config 2: shuffle 64K ---
+    from pos_evolution_tpu.ops.shuffle import shuffle_permutation_jax
+    def shuf(i):
+        jax.block_until_ready(shuffle_permutation_jax(bytes([i]) * 32, 65536, 90))
+    t = _timeit(shuf, reps=3)
+    results["config2_shuffle_64k"] = {"ms": round(t * 1e3, 2)}
+
+    # --- config 3: aggregation ---
+    from pos_evolution_tpu.ops.aggregation import aggregate_verify_batch
+    A, C = 2048, max(n // 2048, 8)
+    pk_states = jnp.asarray(rng.integers(0, 2**32, (n, 8), dtype=np.uint64)
+                            .astype(np.uint32))
+    committees = jnp.asarray(rng.permutation(n)[:A * C].reshape(A, C).astype(np.int32))
+    bits = jnp.asarray(rng.random((A, C)) < 0.99)
+    msgs = jnp.asarray(rng.integers(0, 2**32, (A, 8), dtype=np.uint64)
+                       .astype(np.uint32))
+    sigs = jnp.asarray(rng.integers(0, 2**32, (A, 24), dtype=np.uint64)
+                       .astype(np.uint32))
+
+    def agg(i):
+        jax.block_until_ready(aggregate_verify_batch(
+            pk_states, committees, bits, msgs.at[0, 0].set(np.uint32(i)), sigs))
+    t = _timeit(agg, reps=3)
+    results["config3_aggregation"] = {
+        "aggregates": A, "signers": A * C, "ms": round(t * 1e3, 1),
+        "signer_verifies_per_s": int(A * C / t)}
+
+    # --- config 4: sharded epoch sweep at 1M ---
+    from pos_evolution_tpu.config import mainnet_config
+    from pos_evolution_tpu.ops.epoch import DenseRegistry
+    from pos_evolution_tpu.parallel.sharded import (
+        make_mesh, shard_registry, sharded_epoch_step,
+    )
+    cfg = mainnet_config()
+    reg = DenseRegistry(
+        effective_balance=jnp.asarray(np.full(n, 32 * gwei, np.int64)),
+        balance=jnp.asarray(rng.integers(31 * gwei, 33 * gwei, n).astype(np.int64)),
+        activation_epoch=jnp.zeros(n, jnp.int64),
+        exit_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
+        withdrawable_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
+        slashed=jnp.zeros(n, bool),
+        prev_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+        cur_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+        inactivity_scores=jnp.zeros(n, jnp.int64),
+    )
+    mesh = make_mesh()
+    step = sharded_epoch_step(mesh, cfg)
+    sharded = shard_registry(mesh, reg)
+    bits4 = jnp.zeros(4, bool)
+
+    def epoch(i):
+        out = step(sharded._replace(
+            balance=sharded.balance.at[0].set(np.int64(31 * gwei + i))),
+            jnp.int64(10), jnp.int64(8), bits4, jnp.int64(8), jnp.int64(9),
+            jnp.int64(0))
+        jax.block_until_ready(out)
+    t = _timeit(epoch, reps=3)
+    results["config4_epoch_1m_sharded"] = {
+        "n_validators": n, "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
+        "ms_scaled_to_1m": round(t * 1e3 * scale, 2)}
+
+    # --- config 5: SSF supermajority tally ---
+    from pos_evolution_tpu.parallel.sharded import ssf_supermajority_tally
+    tally = ssf_supermajority_tally(mesh)
+    votes = jnp.asarray(np.arange(n) % 3 != 0)
+    eff = reg.effective_balance
+    total = jnp.int64(n * 32 * gwei)
+
+    def ssf(i):
+        jax.block_until_ready(tally(
+            votes.at[i % n].set(bool(i % 2)), eff, total))
+    t = _timeit(ssf, reps=3)
+    results["config5_ssf_tally_1m"] = {"ms_scaled_to_1m": round(t * 1e3 * scale, 3)}
+
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    main()
