@@ -1,0 +1,69 @@
+"""Tour of the framework: honest finality, an attack, a variant, the
+TPU array level. Run: python examples/demo.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pos_evolution_tpu.config import minimal_config, use_config
+
+
+def honest_finality():
+    print("== 1. Honest Gasper run: justification and finality ==")
+    from pos_evolution_tpu.sim import Simulation
+    sim = Simulation(64)
+    sim.run_epochs(5)
+    for m in sim.metrics[:: sim.cfg.slots_per_epoch]:
+        print(f"  slot {m['slot']:>2}  head={m['head']}  "
+              f"justified={m['justified_epoch']}  finalized={m['finalized_epoch']}")
+    assert sim.finalized_epoch() >= 3
+
+
+def balancing_attack():
+    print("\n== 2. Balancing attack vs pre-boost Gasper (liveness failure) ==")
+    from pos_evolution_tpu.config import cfg, use_config
+    with use_config(cfg().replace(proposer_score_boost_percent=0)):
+        from pos_evolution_tpu.sim.attacks import run_balancing_attack
+        r = run_balancing_attack(64, n_epochs=3, corrupted_fraction=0.3)
+        print(f"  views split: {r.head_L != r.head_R}; "
+              f"justified epochs: L={r.justified_epoch_L} R={r.justified_epoch_R} "
+              f"(frozen at genesis)")
+
+
+def ssf():
+    print("\n== 3. Single-slot finality (RLMD-GHOST + per-slot FFG + acks) ==")
+    from pos_evolution_tpu.models import SSFSimulation
+    sim = SSFSimulation(16)
+    sim.run_slots(5)
+    print(f"  after 5 slots: max finalized slot = {sim.max_finalized_slot()} "
+          f"(finalized within the proposing slot)")
+
+
+def array_level():
+    print("\n== 4. Array level: fused epoch sweep + dense fork choice ==")
+    import numpy as np
+    import jax
+    from pos_evolution_tpu.backend import set_backend
+    from pos_evolution_tpu.sim import Simulation
+    set_backend("jax")
+    try:
+        t0 = time.time()
+        sim = Simulation(64, accelerated_forkchoice=True)
+        sim.run_epochs(3)
+        print(f"  3 epochs with device epoch sweeps + device get_head: "
+              f"{time.time() - t0:.1f}s on {jax.default_backend()}; "
+              f"justified={sim.justified_epoch()}")
+    finally:
+        set_backend("numpy")
+
+
+if __name__ == "__main__":
+    with use_config(minimal_config()):
+        honest_finality()
+        balancing_attack()
+        ssf()
+        array_level()
+    print("\nAll demos completed.")

@@ -90,7 +90,7 @@ class Simulation:
     """Round-based multi-validator simulation over a Schedule."""
 
     def __init__(self, n_validators: int, schedule: Schedule | None = None,
-                 genesis_time: int = 0):
+                 genesis_time: int = 0, accelerated_forkchoice: bool = False):
         self.cfg = cfg()
         self.schedule = schedule or honest_schedule(n_validators)
         state, anchor = make_genesis(n_validators, genesis_time)
@@ -103,6 +103,16 @@ class Simulation:
         ]
         self.slot = 0
         self.metrics: list[dict] = []
+        # Device fork choice (ops/forkchoice.py): every head query runs the
+        # dense segment-sum + reachability pass instead of the spec walk —
+        # differential-equal by test_dense_forkchoice.py.
+        self.accelerated_forkchoice = accelerated_forkchoice
+
+    def _get_head(self, store: fc.Store) -> bytes:
+        if self.accelerated_forkchoice:
+            from pos_evolution_tpu.ops.forkchoice import get_head_dense
+            return get_head_dense(store)
+        return fc.get_head(store)
 
     # -- time helpers --
     def slot_start(self, slot: int) -> int:
@@ -119,7 +129,7 @@ class Simulation:
 
     # -- duties --
     def _head_state(self, group: ViewGroup, slot: int):
-        head = fc.get_head(group.store)
+        head = self._get_head(group.store)
         return head, advance_state_to_slot(group.store.block_states[head], slot)
 
     def _propose(self, slot: int) -> None:
@@ -149,7 +159,7 @@ class Simulation:
     def _pack_attestations(self, group: ViewGroup, slot: int) -> list:
         c = self.cfg
         out = []
-        head = fc.get_head(group.store)
+        head = self._get_head(group.store)
         head_state = group.store.block_states[head]
         for att in group.pool.values():
             a_slot = int(att.data.slot)
@@ -210,7 +220,7 @@ class Simulation:
     # -- observability (SURVEY.md §5: structured per-slot log) --
     def _record_metrics(self, slot: int) -> None:
         g0 = self.groups[0].store
-        head = fc.get_head(g0)
+        head = self._get_head(g0)
         self.metrics.append({
             "slot": slot,
             "head": head.hex()[:8],

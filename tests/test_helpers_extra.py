@@ -1,0 +1,111 @@
+"""Coverage for remaining spec helpers: domains/fork versioning, sync
+committee assignment, proposer weighting, churn limits, seeds.
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    cfg,
+)
+from pos_evolution_tpu.specs.containers import Fork
+from pos_evolution_tpu.specs.genesis import make_genesis
+from pos_evolution_tpu.specs.helpers import (
+    compute_domain,
+    compute_epoch_at_slot,
+    compute_proposer_index,
+    compute_start_slot_at_epoch,
+    get_active_validator_indices,
+    get_domain,
+    get_seed,
+    get_validator_churn_limit,
+    integer_squareroot,
+    is_assigned_to_sync_committee,
+)
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+class TestTimeMath:
+    def test_epoch_slot_roundtrip(self):
+        spe = cfg().slots_per_epoch
+        for e in (0, 1, 7, 1000):
+            assert compute_epoch_at_slot(compute_start_slot_at_epoch(e)) == e
+            assert compute_epoch_at_slot(compute_start_slot_at_epoch(e) + spe - 1) == e
+
+    def test_integer_squareroot(self):
+        for n in (0, 1, 2, 3, 4, 15, 16, 17, 10**12, 32 * 10**9 * 10**6):
+            s = integer_squareroot(n)
+            assert s * s <= n < (s + 1) * (s + 1)
+
+
+class TestDomains:
+    def test_domain_depends_on_fork_version(self):
+        d1 = compute_domain(DOMAIN_BEACON_PROPOSER, b"\x00" * 4, b"\x01" * 32)
+        d2 = compute_domain(DOMAIN_BEACON_PROPOSER, b"\x01\x00\x00\x00", b"\x01" * 32)
+        d3 = compute_domain(DOMAIN_BEACON_ATTESTER, b"\x00" * 4, b"\x01" * 32)
+        assert d1 != d2 and d1 != d3
+        assert d1[:4] == DOMAIN_BEACON_PROPOSER
+
+    def test_get_domain_selects_fork_by_epoch(self):
+        state, _ = make_genesis(8)
+        state.fork = Fork(previous_version=b"\x00" * 4,
+                          current_version=b"\x01\x00\x00\x00", epoch=5)
+        state.slot = 6 * cfg().slots_per_epoch
+        old = get_domain(state, DOMAIN_BEACON_PROPOSER, epoch=3)
+        new = get_domain(state, DOMAIN_BEACON_PROPOSER, epoch=6)
+        assert old != new
+        assert new == compute_domain(DOMAIN_BEACON_PROPOSER, b"\x01\x00\x00\x00",
+                                     bytes(state.genesis_validators_root))
+
+
+class TestSeeds:
+    def test_seed_varies_by_epoch_and_domain(self):
+        state, _ = make_genesis(8)
+        state.randao_mixes = np.random.default_rng(0).integers(
+            0, 255, state.randao_mixes.shape).astype(np.uint8)
+        s1 = get_seed(state, 1, DOMAIN_BEACON_ATTESTER)
+        s2 = get_seed(state, 2, DOMAIN_BEACON_ATTESTER)
+        s3 = get_seed(state, 1, DOMAIN_BEACON_PROPOSER)
+        assert len({s1, s2, s3}) == 3
+
+
+class TestSyncAssignment:
+    def test_assignment_matches_membership(self):
+        state, _ = make_genesis(16)
+        members = {bytes(pk) for pk in state.current_sync_committee.pubkeys}
+        for v in range(16):
+            assigned = is_assigned_to_sync_committee(state, 0, v)
+            assert assigned == (state.validators.pubkeys[v].tobytes() in members)
+
+    def test_far_future_period_rejected(self):
+        state, _ = make_genesis(16)
+        far = 10 * cfg().epochs_per_sync_committee_period
+        with pytest.raises(AssertionError):
+            is_assigned_to_sync_committee(state, far, 0)
+
+
+class TestProposerSampling:
+    def test_weighting_by_effective_balance(self):
+        """pos-evolution.md:622: acceptance probability ~ balance/32."""
+        state, _ = make_genesis(64)
+        half = cfg().max_effective_balance // 2
+        state.validators.effective_balance[:32] = half  # first half at 16 ETH
+        indices = get_active_validator_indices(state, 0)
+        rng = np.random.default_rng(0)
+        counts = np.zeros(64)
+        for trial in range(400):
+            seed = rng.integers(0, 255, 32, dtype=np.uint8).tobytes()
+            counts[compute_proposer_index(state, indices, seed)] += 1
+        light = counts[:32].sum()
+        heavy = counts[32:].sum()
+        # heavy validators should win roughly twice as often
+        assert 1.5 < heavy / light < 2.7, (light, heavy)
+
+
+class TestChurn:
+    def test_churn_floor(self):
+        state, _ = make_genesis(8)
+        assert get_validator_churn_limit(state) == cfg().min_per_epoch_churn_limit

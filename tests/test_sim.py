@@ -29,6 +29,18 @@ class TestHonestRun:
         assert all("head" in m and "finalized_epoch" in m for m in sim.metrics)
 
 
+class TestAcceleratedForkChoice:
+    def test_accelerated_run_matches_spec_run(self):
+        """Device fork choice inside the driver reproduces the spec run
+        head-for-head (SURVEY.md §4.4b)."""
+        pytest.importorskip("jax")
+        fast = Simulation(64, accelerated_forkchoice=True)
+        fast.run_epochs(2)
+        ref = Simulation(64)
+        ref.run_epochs(2)
+        assert [m["head"] for m in fast.metrics] == [m["head"] for m in ref.metrics]
+
+
 class TestSleepyValidators:
     def test_minority_asleep_still_finalizes(self):
         """Dynamic availability: < 1/3 asleep must not stop finality
