@@ -88,15 +88,23 @@ def _exact_matvec_i64(r_bool, values_i64, capacity: int):
 
 @partial(jax.jit, static_argnames=("capacity", "increment"))
 def head_and_weights(store: DenseStore, capacity: int,
-                     increment: int = 10**9):
+                     increment: int = 10**9,
+                     min_vote_epoch=None):
     """Returns (head_idx, subtree_weights[B] in Gwei) — one fused pass.
 
     Effective balances are always multiples of ``increment`` (hysteresis,
     pos-evolution.md:122-133), so subtree sums run as exact hi/lo-split f32
     matmuls over increment counts; the (not increment-aligned) proposer
     boost is added afterwards in int64.
+
+    ``min_vote_epoch`` applies the RLMD-GHOST vote-expiry window
+    (pos-evolution.md:1585, 1596): latest messages with target epoch below
+    it carry no weight (eta = window size; None = LMD's eta = inf; the
+    Goldfish limit keeps only the most recent slot's votes).
     """
     votes_valid = store.msg_block >= 0
+    if min_vote_epoch is not None:
+        votes_valid = votes_valid & (store.msg_epoch >= min_vote_epoch)
     seg_ids = jnp.where(votes_valid, store.msg_block, capacity)
     vote_weight = jax.ops.segment_sum(
         jnp.where(votes_valid, store.weight, 0), seg_ids,

@@ -89,6 +89,31 @@ class TestDenseHeadDifferential:
             r = run_balancing_attack(64, n_epochs=2)
             assert r.head_L != r.head_R  # the interesting case
 
+    def test_vote_expiry_window(self):
+        """RLMD/Goldfish expiry at the array level: windowed-out latest
+        messages carry no weight (pos-evolution.md:1585)."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.forkchoice import build_dense_store, head_and_weights
+        state, anchor = make_genesis(64)
+        store = fc.get_forkchoice_store(state, anchor)
+        tick_to_slot(store, 1, offset=cfg().seconds_per_slot)
+        sb_a = build_block(state, 1, graffiti=b"\x0a" * 32)
+        sb_b = build_block(state, 1, graffiti=b"\x0b" * 32)
+        fc.on_block(store, sb_a)
+        fc.on_block(store, sb_b)
+        ra, rb = hash_tree_root(sb_a.message), hash_tree_root(sb_b.message)
+        loser, winner = sorted([ra, rb])
+        att = make_committee_attestation(store.block_states[loser], 1, 0, loser)
+        tick_to_slot(store, 2)
+        fc.on_attestation(store, att)
+        dense, roots, capacity = build_dense_store(store)
+        # votes (epoch 0) count with no window -> smaller root wins
+        h0, _ = head_and_weights(dense, capacity)
+        assert roots[int(h0)] == loser
+        # expiry window beyond epoch 0 -> votes expire -> tie-break wins
+        h1, _ = head_and_weights(dense, capacity, min_vote_epoch=jnp.int64(1))
+        assert roots[int(h1)] == winner
+
     def test_deep_chain_with_skips(self):
         state, anchor = make_genesis(32)
         store = fc.get_forkchoice_store(state, anchor)
