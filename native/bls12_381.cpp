@@ -1,0 +1,899 @@
+// Native BLS12-381 (component N1, SURVEY.md §2.7).
+//
+// C++ port of the framework's from-scratch pairing stack
+// (pos_evolution_tpu/crypto/bls12_381.py, the correctness oracle): 6x64-bit
+// Montgomery field arithmetic, the Fp2/Fp6/Fp12 tower, affine curve ops on
+// G1 and the sextic twist G2, the ate Miller loop + final exponentiation,
+// the deterministic sha256 try-and-increment hash-to-G2, ZCash-style
+// compressed serialization, and the min-pubkey-size signature scheme
+// (Sign/Verify/Aggregate/FastAggregateVerify). Differential tests pin this
+// bit-identical to the Python oracle.
+//
+// C ABI at the bottom; loaded via ctypes (pos_evolution_tpu/native.py).
+
+#include <cstdint>
+#include <cstring>
+
+#include "bls_constants.h"
+
+using u64 = uint64_t;
+using u128 = unsigned __int128;
+
+// ===========================================================================
+// SHA-256 (for hash_to_g2; self-contained copy)
+// ===========================================================================
+namespace sha {
+static const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+static void sha256(const uint8_t *msg, size_t len, uint8_t out[32]) {
+  uint32_t st[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  auto compress = [&](const uint8_t *b) {
+    uint32_t w[64];
+    for (int t = 0; t < 16; ++t)
+      w[t] = (uint32_t(b[4 * t]) << 24) | (uint32_t(b[4 * t + 1]) << 16) |
+             (uint32_t(b[4 * t + 2]) << 8) | b[4 * t + 3];
+    for (int t = 16; t < 64; ++t) {
+      uint32_t s0 = rotr(w[t - 15], 7) ^ rotr(w[t - 15], 18) ^ (w[t - 15] >> 3);
+      uint32_t s1 = rotr(w[t - 2], 17) ^ rotr(w[t - 2], 19) ^ (w[t - 2] >> 10);
+      w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+    }
+    uint32_t a = st[0], bb = st[1], c = st[2], d = st[3], e = st[4], f = st[5],
+             g = st[6], h = st[7];
+    for (int t = 0; t < 64; ++t) {
+      uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = h + s1 + ch + K[t] + w[t];
+      uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t mj = (a & bb) ^ (a & c) ^ (bb & c);
+      h = g; g = f; f = e; e = d + t1; d = c; c = bb; bb = a; a = t1 + s0 + mj;
+    }
+    st[0] += a; st[1] += bb; st[2] += c; st[3] += d;
+    st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+  };
+  size_t full = len / 64;
+  for (size_t i = 0; i < full; ++i) compress(msg + 64 * i);
+  uint8_t tail[128];
+  size_t rem = len - 64 * full;
+  std::memset(tail, 0, sizeof(tail));
+  std::memcpy(tail, msg + 64 * full, rem);
+  tail[rem] = 0x80;
+  size_t blocks = (rem + 9 > 64) ? 2 : 1;
+  u64 bits = u64(len) * 8;
+  for (int i = 0; i < 8; ++i) tail[64 * blocks - 1 - i] = uint8_t(bits >> (8 * i));
+  for (size_t i = 0; i < blocks; ++i) compress(tail + 64 * i);
+  for (int i = 0; i < 8; ++i) {
+    out[4 * i] = uint8_t(st[i] >> 24);
+    out[4 * i + 1] = uint8_t(st[i] >> 16);
+    out[4 * i + 2] = uint8_t(st[i] >> 8);
+    out[4 * i + 3] = uint8_t(st[i]);
+  }
+}
+}  // namespace sha
+
+// ===========================================================================
+// Fp: 6x64-bit Montgomery arithmetic mod the BLS12-381 prime
+// ===========================================================================
+struct Fp { u64 l[6]; };
+
+static u64 N0INV;       // -p^{-1} mod 2^64
+static Fp FP_R;         // 2^384 mod p (Montgomery one)
+static Fp FP_R2;        // (2^384)^2 mod p
+static Fp FP_ZERO = {};
+
+static inline bool fp_gte_p(const u64 a[6]) {
+  for (int i = 5; i >= 0; --i) {
+    if (a[i] > P_LIMBS[i]) return true;
+    if (a[i] < P_LIMBS[i]) return false;
+  }
+  return true;  // equal
+}
+
+static inline void fp_sub_p(u64 a[6]) {
+  u128 borrow = 0;
+  for (int i = 0; i < 6; ++i) {
+    u128 d = (u128)a[i] - P_LIMBS[i] - borrow;
+    a[i] = (u64)d;
+    borrow = (d >> 64) & 1;
+  }
+}
+
+static inline Fp fp_add(const Fp &a, const Fp &b) {
+  Fp r;
+  u128 carry = 0;
+  for (int i = 0; i < 6; ++i) {
+    u128 s = (u128)a.l[i] + b.l[i] + carry;
+    r.l[i] = (u64)s;
+    carry = s >> 64;
+  }
+  if (carry || fp_gte_p(r.l)) fp_sub_p(r.l);
+  return r;
+}
+
+static inline Fp fp_sub(const Fp &a, const Fp &b) {
+  Fp r;
+  u128 borrow = 0;
+  for (int i = 0; i < 6; ++i) {
+    u128 d = (u128)a.l[i] - b.l[i] - borrow;
+    r.l[i] = (u64)d;
+    borrow = (d >> 64) & 1;
+  }
+  if (borrow) {  // add p back
+    u128 carry = 0;
+    for (int i = 0; i < 6; ++i) {
+      u128 s = (u128)r.l[i] + P_LIMBS[i] + carry;
+      r.l[i] = (u64)s;
+      carry = s >> 64;
+    }
+  }
+  return r;
+}
+
+static inline Fp fp_neg(const Fp &a) { return fp_sub(FP_ZERO, a); }
+
+static inline bool fp_is_zero(const Fp &a) {
+  u64 acc = 0;
+  for (int i = 0; i < 6; ++i) acc |= a.l[i];
+  return acc == 0;
+}
+
+static inline bool fp_eq(const Fp &a, const Fp &b) {
+  u64 acc = 0;
+  for (int i = 0; i < 6; ++i) acc |= a.l[i] ^ b.l[i];
+  return acc == 0;
+}
+
+// CIOS Montgomery multiplication
+static Fp fp_mul(const Fp &a, const Fp &b) {
+  u64 t[8] = {0};
+  for (int i = 0; i < 6; ++i) {
+    u128 carry = 0;
+    for (int j = 0; j < 6; ++j) {
+      u128 cur = (u128)t[j] + (u128)a.l[i] * b.l[j] + carry;
+      t[j] = (u64)cur;
+      carry = cur >> 64;
+    }
+    u128 cur = (u128)t[6] + carry;
+    t[6] = (u64)cur;
+    t[7] = (u64)(cur >> 64);
+
+    u64 m = t[0] * N0INV;
+    carry = ((u128)t[0] + (u128)m * P_LIMBS[0]) >> 64;
+    for (int j = 1; j < 6; ++j) {
+      u128 c2 = (u128)t[j] + (u128)m * P_LIMBS[j] + carry;
+      t[j - 1] = (u64)c2;
+      carry = c2 >> 64;
+    }
+    cur = (u128)t[6] + carry;
+    t[5] = (u64)cur;
+    t[6] = t[7] + (u64)(cur >> 64);
+    t[7] = 0;
+  }
+  Fp r;
+  std::memcpy(r.l, t, 48);
+  if (t[6] || fp_gte_p(r.l)) fp_sub_p(r.l);
+  return r;
+}
+
+static inline Fp fp_sqr(const Fp &a) { return fp_mul(a, a); }
+
+// pow by big-endian byte exponent (square-and-multiply MSB first)
+static Fp fp_pow_bytes(const Fp &a, const uint8_t *exp, size_t n) {
+  Fp r = FP_R;  // one
+  for (size_t i = 0; i < n; ++i) {
+    for (int bit = 7; bit >= 0; --bit) {
+      r = fp_sqr(r);
+      if ((exp[i] >> bit) & 1) r = fp_mul(r, a);
+    }
+  }
+  return r;
+}
+
+static uint8_t P_MINUS_2[48];
+
+static Fp fp_inv(const Fp &a) { return fp_pow_bytes(a, P_MINUS_2, 48); }
+
+// to/from standard representation
+static Fp fp_from_bytes_be(const uint8_t *b, size_t n) {
+  // parse up to 48 bytes big-endian, reduce mod p, convert to Montgomery
+  Fp r = {};
+  for (size_t i = 0; i < n; ++i) {
+    // r = r*256 + b[i]  (shift by 8 via adds; faster: limb shifting)
+    u128 carry = b[i];
+    for (int j = 0; j < 6; ++j) {
+      u128 cur = ((u128)r.l[j] << 8) | (carry & 0xff);
+      carry = (carry >> 8) | ((u128)r.l[j] >> 56);
+      r.l[j] = (u64)cur;
+    }
+    while (fp_gte_p(r.l)) fp_sub_p(r.l);
+  }
+  return fp_mul(r, FP_R2);
+}
+
+static void fp_to_bytes_be(const Fp &a, uint8_t out[48]) {
+  Fp one = {};
+  one.l[0] = 1;
+  Fp std_form = fp_mul(a, one);  // Montgomery reduce: a * 1 = a/R... careful
+  // fp_mul(a, one) computes a*1*R^{-1} = standard form of a. Correct.
+  for (int i = 0; i < 6; ++i)
+    for (int j = 0; j < 8; ++j)
+      out[47 - 8 * i - j] = uint8_t(std_form.l[i] >> (8 * j));
+}
+
+static bool fp_is_odd_std(const Fp &a) {
+  Fp one = {};
+  one.l[0] = 1;
+  return fp_mul(a, one).l[0] & 1;
+}
+
+// standard-form comparison: a > (p-1)/2 ("lexicographically large")
+static bool fp_is_large_std(const Fp &a) {
+  uint8_t ab[48];
+  fp_to_bytes_be(a, ab);
+  static uint8_t half[48];
+  static bool init = false;
+  if (!init) {
+    // (p-1)/2 big-endian: compute from P_LIMBS
+    u64 h[6];
+    u64 carry = 0;
+    for (int i = 5; i >= 0; --i) {
+      u64 cur = (P_LIMBS[i] >> 1) | (carry << 63);
+      carry = P_LIMBS[i] & 1;
+      h[i] = cur;
+    }
+    // p odd -> (p-1)/2 == p >> 1
+    for (int i = 0; i < 6; ++i)
+      for (int j = 0; j < 8; ++j) half[47 - 8 * i - j] = uint8_t(h[i] >> (8 * j));
+    init = true;
+  }
+  return std::memcmp(ab, half, 48) > 0;
+}
+
+// ===========================================================================
+// Fp2 = Fp[u]/(u^2+1)
+// ===========================================================================
+struct Fp2 { Fp a, b; };
+
+static Fp2 FP2_ZERO, FP2_ONE, XI2;  // XI2 = u + 1
+
+static inline Fp2 fp2_add(const Fp2 &x, const Fp2 &y) {
+  return {fp_add(x.a, y.a), fp_add(x.b, y.b)};
+}
+static inline Fp2 fp2_sub(const Fp2 &x, const Fp2 &y) {
+  return {fp_sub(x.a, y.a), fp_sub(x.b, y.b)};
+}
+static inline Fp2 fp2_neg(const Fp2 &x) { return {fp_neg(x.a), fp_neg(x.b)}; }
+
+static Fp2 fp2_mul(const Fp2 &x, const Fp2 &y) {
+  Fp t0 = fp_mul(x.a, y.a);
+  Fp t1 = fp_mul(x.b, y.b);
+  Fp t2 = fp_mul(fp_add(x.a, x.b), fp_add(y.a, y.b));
+  return {fp_sub(t0, t1), fp_sub(fp_sub(t2, t0), t1)};
+}
+
+static Fp2 fp2_sqr(const Fp2 &x) {
+  Fp t0 = fp_mul(fp_add(x.a, x.b), fp_sub(x.a, x.b));
+  Fp t1 = fp_mul(x.a, x.b);
+  return {t0, fp_add(t1, t1)};
+}
+
+static Fp2 fp2_inv(const Fp2 &x) {
+  Fp d = fp_inv(fp_add(fp_mul(x.a, x.a), fp_mul(x.b, x.b)));
+  return {fp_mul(x.a, d), fp_neg(fp_mul(x.b, d))};
+}
+
+static inline bool fp2_is_zero(const Fp2 &x) {
+  return fp_is_zero(x.a) && fp_is_zero(x.b);
+}
+static inline bool fp2_eq(const Fp2 &x, const Fp2 &y) {
+  return fp_eq(x.a, y.a) && fp_eq(x.b, y.b);
+}
+
+static Fp2 fp2_pow_bytes(const Fp2 &x, const uint8_t *exp, size_t n) {
+  Fp2 r = FP2_ONE;
+  for (size_t i = 0; i < n; ++i)
+    for (int bit = 7; bit >= 0; --bit) {
+      r = fp2_sqr(r);
+      if ((exp[i] >> bit) & 1) r = fp2_mul(r, x);
+    }
+  return r;
+}
+
+static Fp2 EIGHTH_ROOTS[4];
+
+// sqrt in Fp2 (q^2 = 9 mod 16 method, mirrors the Python); returns false if
+// non-residue
+static bool fp2_sqrt(const Fp2 &a, Fp2 *out) {
+  Fp2 cand = fp2_pow_bytes(a, SQRT_EXP, SQRT_EXP_len);
+  for (int k = 0; k < 4; ++k) {
+    Fp2 x = fp2_mul(cand, EIGHTH_ROOTS[k]);
+    if (fp2_eq(fp2_sqr(x), a)) {
+      *out = x;
+      return true;
+    }
+  }
+  return false;
+}
+
+// ===========================================================================
+// Fp6 = Fp2[v]/(v^3 - XI), Fp12 = Fp6[w]/(w^2 - v)
+// ===========================================================================
+struct Fp6 { Fp2 a, b, c; };
+struct Fp12 { Fp6 a, b; };
+
+static Fp6 FP6_ZERO, FP6_ONE;
+static Fp12 FP12_ONE;
+
+static inline Fp6 fp6_add(const Fp6 &x, const Fp6 &y) {
+  return {fp2_add(x.a, y.a), fp2_add(x.b, y.b), fp2_add(x.c, y.c)};
+}
+static inline Fp6 fp6_sub(const Fp6 &x, const Fp6 &y) {
+  return {fp2_sub(x.a, y.a), fp2_sub(x.b, y.b), fp2_sub(x.c, y.c)};
+}
+static inline Fp6 fp6_neg(const Fp6 &x) {
+  return {fp2_neg(x.a), fp2_neg(x.b), fp2_neg(x.c)};
+}
+
+static Fp6 fp6_mul(const Fp6 &x, const Fp6 &y) {
+  Fp2 t0 = fp2_mul(x.a, y.a);
+  Fp2 t1 = fp2_mul(x.b, y.b);
+  Fp2 t2 = fp2_mul(x.c, y.c);
+  Fp2 r0 = fp2_add(t0, fp2_mul(fp2_sub(fp2_sub(
+      fp2_mul(fp2_add(x.b, x.c), fp2_add(y.b, y.c)), t1), t2), XI2));
+  Fp2 r1 = fp2_add(fp2_sub(fp2_sub(
+      fp2_mul(fp2_add(x.a, x.b), fp2_add(y.a, y.b)), t0), t1),
+      fp2_mul(t2, XI2));
+  Fp2 r2 = fp2_add(fp2_sub(fp2_sub(
+      fp2_mul(fp2_add(x.a, x.c), fp2_add(y.a, y.c)), t0), t2), t1);
+  return {r0, r1, r2};
+}
+
+static inline Fp6 fp6_mul_by_v(const Fp6 &x) {
+  return {fp2_mul(x.c, XI2), x.a, x.b};
+}
+
+static Fp6 fp6_inv(const Fp6 &x) {
+  Fp2 c0 = fp2_sub(fp2_sqr(x.a), fp2_mul(fp2_mul(x.b, x.c), XI2));
+  Fp2 c1 = fp2_sub(fp2_mul(fp2_sqr(x.c), XI2), fp2_mul(x.a, x.b));
+  Fp2 c2 = fp2_sub(fp2_sqr(x.b), fp2_mul(x.a, x.c));
+  Fp2 t = fp2_inv(fp2_add(fp2_mul(x.a, c0),
+                          fp2_mul(fp2_add(fp2_mul(x.c, c1), fp2_mul(x.b, c2)),
+                                  XI2)));
+  return {fp2_mul(c0, t), fp2_mul(c1, t), fp2_mul(c2, t)};
+}
+
+static inline Fp12 fp12_add(const Fp12 &x, const Fp12 &y) {
+  return {fp6_add(x.a, y.a), fp6_add(x.b, y.b)};
+}
+static inline Fp12 fp12_sub(const Fp12 &x, const Fp12 &y) {
+  return {fp6_sub(x.a, y.a), fp6_sub(x.b, y.b)};
+}
+
+static Fp12 fp12_mul(const Fp12 &x, const Fp12 &y) {
+  Fp6 t0 = fp6_mul(x.a, y.a);
+  Fp6 t1 = fp6_mul(x.b, y.b);
+  Fp6 r0 = fp6_add(t0, fp6_mul_by_v(t1));
+  Fp6 r1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(x.a, x.b), fp6_add(y.a, y.b)), t0),
+                   t1);
+  return {r0, r1};
+}
+
+static inline Fp12 fp12_sqr(const Fp12 &x) { return fp12_mul(x, x); }
+
+static Fp12 fp12_inv(const Fp12 &x) {
+  Fp6 t = fp6_inv(fp6_sub(fp6_mul(x.a, x.a), fp6_mul_by_v(fp6_mul(x.b, x.b))));
+  return {fp6_mul(x.a, t), fp6_neg(fp6_mul(x.b, t))};
+}
+
+static inline Fp12 fp12_conj(const Fp12 &x) { return {x.a, fp6_neg(x.b)}; }
+
+static bool fp12_eq(const Fp12 &x, const Fp12 &y) {
+  return fp2_eq(x.a.a, y.a.a) && fp2_eq(x.a.b, y.a.b) && fp2_eq(x.a.c, y.a.c) &&
+         fp2_eq(x.b.a, y.b.a) && fp2_eq(x.b.b, y.b.b) && fp2_eq(x.b.c, y.b.c);
+}
+
+static Fp12 fp12_pow_bytes(const Fp12 &x, const uint8_t *exp, size_t n) {
+  Fp12 r = FP12_ONE;
+  for (size_t i = 0; i < n; ++i)
+    for (int bit = 7; bit >= 0; --bit) {
+      r = fp12_sqr(r);
+      if ((exp[i] >> bit) & 1) r = fp12_mul(r, x);
+    }
+  return r;
+}
+
+// ===========================================================================
+// Curves: G1 over Fp, G2 over Fp2 (affine, infinity flag)
+// ===========================================================================
+struct G1 { Fp x, y; bool inf; };
+struct G2 { Fp2 x, y; bool inf; };
+
+static G1 G1_GENERATOR;
+static G2 G2_GENERATOR;
+static Fp FP_FOUR;    // curve b = 4
+static Fp2 FP2_B2;    // twist b' = 4(u+1)
+
+template <typename P, typename F,
+          F (*Fadd)(const F &, const F &), F (*Fsub)(const F &, const F &),
+          F (*Fmul)(const F &, const F &), F (*Finv)(const F &),
+          bool (*Feq)(const F &, const F &)>
+static P ec_double_t(const P &p, const F &three) {
+  if (p.inf) return p;
+  F lam = Fmul(Fmul(Fmul(p.x, p.x), three), Finv(Fadd(p.y, p.y)));
+  F x3 = Fsub(Fsub(Fmul(lam, lam), p.x), p.x);
+  F y3 = Fsub(Fmul(lam, Fsub(p.x, x3)), p.y);
+  return {x3, y3, false};
+}
+
+template <typename P, typename F,
+          F (*Fadd)(const F &, const F &), F (*Fsub)(const F &, const F &),
+          F (*Fmul)(const F &, const F &), F (*Finv)(const F &),
+          bool (*Feq)(const F &, const F &)>
+static P ec_add_t(const P &p, const P &q, const F &three) {
+  if (p.inf) return q;
+  if (q.inf) return p;
+  if (Feq(p.x, q.x)) {
+    if (Feq(p.y, q.y))
+      return ec_double_t<P, F, Fadd, Fsub, Fmul, Finv, Feq>(p, three);
+    P r;
+    r.inf = true;
+    return r;
+  }
+  F lam = Fmul(Fsub(q.y, p.y), Finv(Fsub(q.x, p.x)));
+  F x3 = Fsub(Fsub(Fmul(lam, lam), p.x), q.x);
+  F y3 = Fsub(Fmul(lam, Fsub(p.x, x3)), p.y);
+  return {x3, y3, false};
+}
+
+static Fp FP_THREE;
+static Fp2 FP2_THREE;
+
+static G1 g1_add(const G1 &p, const G1 &q) {
+  return ec_add_t<G1, Fp, fp_add, fp_sub, fp_mul, fp_inv, fp_eq>(p, q, FP_THREE);
+}
+static G2 g2_add(const G2 &p, const G2 &q) {
+  return ec_add_t<G2, Fp2, fp2_add, fp2_sub, fp2_mul, fp2_inv, fp2_eq>(
+      p, q, FP2_THREE);
+}
+
+template <typename P, P (*Padd)(const P &, const P &)>
+static P ec_mul_bytes(const P &p, const uint8_t *k, size_t n) {
+  P r;
+  r.inf = true;
+  P add = p;
+  // LSB-first over the byte string interpreted big-endian
+  for (size_t i = n; i-- > 0;) {
+    for (int bit = 0; bit < 8; ++bit) {
+      if ((k[i] >> bit) & 1) r = Padd(r, add);
+      add = Padd(add, add);
+    }
+  }
+  return r;
+}
+
+static bool g2_subgroup_check(const G2 &p) {
+  if (p.inf) return true;
+  // on-curve
+  Fp2 lhs = fp2_sqr(p.y);
+  Fp2 rhs = fp2_add(fp2_mul(fp2_sqr(p.x), p.x), FP2_B2);
+  if (!fp2_eq(lhs, rhs)) return false;
+  G2 t = ec_mul_bytes<G2, g2_add>(p, CURVE_ORDER_BYTES, CURVE_ORDER_BYTES_len);
+  return t.inf;
+}
+
+static bool g1_subgroup_check(const G1 &p) {
+  if (p.inf) return true;
+  Fp lhs = fp_mul(p.y, p.y);
+  Fp rhs = fp_add(fp_mul(fp_mul(p.x, p.x), p.x), FP_FOUR);
+  if (!fp_eq(lhs, rhs)) return false;
+  G1 t = ec_mul_bytes<G1, g1_add>(p, CURVE_ORDER_BYTES, CURVE_ORDER_BYTES_len);
+  return t.inf;
+}
+
+// ===========================================================================
+// Pairing: untwist + generic Miller loop in Fp12 (mirrors the Python)
+// ===========================================================================
+struct P12 { Fp12 x, y; bool inf; };
+
+static Fp12 W2_INV, W3_INV, FP12_THREE;
+
+static Fp12 fp2_to_fp12(const Fp2 &x) {
+  Fp12 r = {};
+  r.a.a = x;
+  return r;
+}
+
+static P12 untwist(const G2 &q) {
+  return {fp12_mul(fp2_to_fp12(q.x), W2_INV),
+          fp12_mul(fp2_to_fp12(q.y), W3_INV), false};
+}
+
+static P12 p12_double(const P12 &p) {
+  Fp12 lam = fp12_mul(fp12_mul(fp12_mul(p.x, p.x), FP12_THREE),
+                      fp12_inv(fp12_add(p.y, p.y)));
+  Fp12 x3 = fp12_sub(fp12_sub(fp12_mul(lam, lam), p.x), p.x);
+  Fp12 y3 = fp12_sub(fp12_mul(lam, fp12_sub(p.x, x3)), p.y);
+  return {x3, y3, false};
+}
+
+static P12 p12_add(const P12 &p, const P12 &q) {
+  if (p.inf) return q;
+  if (q.inf) return p;
+  if (fp12_eq(p.x, q.x)) {
+    if (fp12_eq(p.y, q.y)) return p12_double(p);
+    P12 r;
+    r.inf = true;
+    return r;
+  }
+  Fp12 lam = fp12_mul(fp12_sub(q.y, p.y), fp12_inv(fp12_sub(q.x, p.x)));
+  Fp12 x3 = fp12_sub(fp12_sub(fp12_mul(lam, lam), p.x), q.x);
+  Fp12 y3 = fp12_sub(fp12_mul(lam, fp12_sub(p.x, x3)), p.y);
+  return {x3, y3, false};
+}
+
+// line through a,b evaluated at (px, py)
+static Fp12 line(const P12 &a, const P12 &b, const Fp12 &px, const Fp12 &py) {
+  if (!fp12_eq(a.x, b.x)) {
+    Fp12 lam = fp12_mul(fp12_sub(b.y, a.y), fp12_inv(fp12_sub(b.x, a.x)));
+    return fp12_sub(fp12_mul(fp12_sub(px, a.x), lam), fp12_sub(py, a.y));
+  }
+  if (fp12_eq(a.y, b.y)) {
+    Fp12 lam = fp12_mul(fp12_mul(fp12_mul(a.x, a.x), FP12_THREE),
+                        fp12_inv(fp12_add(a.y, a.y)));
+    return fp12_sub(fp12_mul(fp12_sub(px, a.x), lam), fp12_sub(py, a.y));
+  }
+  return fp12_sub(px, a.x);
+}
+
+static const u64 BLS_X_VAL = 0xd201000000010000ULL;
+
+static Fp12 miller_loop(const G2 &q, const G1 &p) {
+  if (q.inf || p.inf) return FP12_ONE;
+  P12 Q = untwist(q);
+  Fp12 px = fp2_to_fp12({p.x, {}});
+  Fp12 py = fp2_to_fp12({p.y, {}});
+  P12 r = Q;
+  Fp12 f = FP12_ONE;
+  for (int i = 62; i >= 0; --i) {
+    f = fp12_mul(fp12_mul(f, f), line(r, r, px, py));
+    r = p12_double(r);
+    if ((BLS_X_VAL >> i) & 1) {
+      f = fp12_mul(f, line(r, Q, px, py));
+      r = p12_add(r, Q);
+    }
+  }
+  return fp12_conj(f);  // t < 0
+}
+
+static bool pairings_equal_2(const G1 &p1, const G2 &q1, const G1 &p2,
+                             const G2 &q2) {
+  // e(p1, q1) == e(p2, q2)  <=>  ml(p1,q1) * ml(p2,-q2) final-exps to 1
+  G2 nq2 = q2;
+  if (!nq2.inf) nq2.y = fp2_neg(nq2.y);
+  Fp12 f = fp12_mul(miller_loop(q1, p1), miller_loop(nq2, p2));
+  Fp12 e = fp12_pow_bytes(f, FINAL_EXP, FINAL_EXP_len);
+  return fp12_eq(e, FP12_ONE);
+}
+
+// ===========================================================================
+// hash_to_g2 (must match the Python oracle byte-for-byte)
+// ===========================================================================
+static G2 hash_to_g2(const uint8_t *msg, size_t msg_len) {
+  uint8_t buf[4 + 64];  // "blsg2" prefix handled separately
+  (void)buf;
+  for (uint32_t ctr = 0;; ++ctr) {
+    // seed = sha256(b"blsg2" + message + ctr_le32)
+    uint8_t inbuf[5 + 256 + 4];
+    size_t off = 0;
+    std::memcpy(inbuf + off, "blsg2", 5);
+    off += 5;
+    std::memcpy(inbuf + off, msg, msg_len);
+    off += msg_len;
+    for (int i = 0; i < 4; ++i) inbuf[off + i] = uint8_t(ctr >> (8 * i));
+    off += 4;
+    uint8_t d0[32], d1[32], d2[32];
+    sha::sha256(inbuf, off, d0);
+    sha::sha256(d0, 32, d1);
+    sha::sha256(d1, 32, d2);
+    // x.a = int(d0 + d1[:16]) mod p ; x.b = int(d1[16:] + d2) mod p
+    uint8_t xa[48], xb[48];
+    std::memcpy(xa, d0, 32);
+    std::memcpy(xa + 32, d1, 16);
+    std::memcpy(xb, d1 + 16, 16);
+    std::memcpy(xb + 16, d2, 32);
+    Fp2 x = {fp_from_bytes_be(xa, 48), fp_from_bytes_be(xb, 48)};
+    Fp2 rhs = fp2_add(fp2_mul(fp2_sqr(x), x), FP2_B2);
+    Fp2 y;
+    if (!fp2_sqrt(rhs, &y)) continue;
+    if (fp_is_odd_std(y.a)) y = fp2_neg(y);
+    G2 pt = {x, y, false};
+    G2 cleared = ec_mul_bytes<G2, g2_add>(pt, G2_COFACTOR_BYTES,
+                                          G2_COFACTOR_BYTES_len);
+    if (!cleared.inf) return cleared;
+  }
+}
+
+// ===========================================================================
+// serialization (ZCash flags; mirrors the Python)
+// ===========================================================================
+static void g1_compress(const G1 &p, uint8_t out[48]) {
+  if (p.inf) {
+    std::memset(out, 0, 48);
+    out[0] = 0xc0;
+    return;
+  }
+  fp_to_bytes_be(p.x, out);
+  out[0] |= 0x80;
+  if (fp_is_large_std(p.y)) out[0] |= 0x20;
+}
+
+static bool g1_decompress(const uint8_t in[48], G1 *out) {
+  if (in[0] & 0x40) {
+    out->inf = true;
+    return true;
+  }
+  bool sign_large = in[0] & 0x20;
+  uint8_t xb[48];
+  std::memcpy(xb, in, 48);
+  xb[0] &= 0x1f;
+  Fp x = fp_from_bytes_be(xb, 48);
+  Fp y2 = fp_add(fp_mul(fp_mul(x, x), x), FP_FOUR);
+  // sqrt in Fp: y = y2^((p+1)/4); verify
+  static uint8_t P_PLUS1_DIV4[48];
+  static bool init = false;
+  if (!init) {
+    u64 t[6];
+    u128 carry = 1;
+    for (int i = 0; i < 6; ++i) {
+      u128 s = (u128)P_LIMBS[i] + (i == 0 ? carry : (carry >> 64 ? 1 : 0));
+      // simpler: add 1 then shift right twice below
+      t[i] = (u64)s;
+      carry = s >> 64 ? 1 : 0;
+      if (i > 0) carry = s >> 64;
+    }
+    // (p+1) >> 2
+    for (int shift = 0; shift < 2; ++shift) {
+      u64 c = 0;
+      for (int i = 5; i >= 0; --i) {
+        u64 cur = (t[i] >> 1) | (c << 63);
+        c = t[i] & 1;
+        t[i] = cur;
+      }
+    }
+    for (int i = 0; i < 6; ++i)
+      for (int j = 0; j < 8; ++j)
+        P_PLUS1_DIV4[47 - 8 * i - j] = uint8_t(t[i] >> (8 * j));
+    init = true;
+  }
+  Fp y = fp_pow_bytes(y2, P_PLUS1_DIV4, 48);
+  if (!fp_eq(fp_mul(y, y), y2)) return false;
+  if (fp_is_large_std(y) != sign_large) y = fp_neg(y);
+  *out = {x, y, false};
+  return true;
+}
+
+static bool fp2_y_is_large(const Fp2 &y) {
+  // (y.b, y.a) > ((p - y.b) % p, (p - y.a) % p) lexicographically
+  Fp nb = fp_neg(y.b);
+  Fp na = fp_neg(y.a);
+  uint8_t yb[48], ya[48], nbb[48], nab[48];
+  fp_to_bytes_be(y.b, yb);
+  fp_to_bytes_be(y.a, ya);
+  fp_to_bytes_be(nb, nbb);
+  fp_to_bytes_be(na, nab);
+  int c = std::memcmp(yb, nbb, 48);
+  if (c != 0) return c > 0;
+  return std::memcmp(ya, nab, 48) > 0;
+}
+
+static void g2_compress(const G2 &p, uint8_t out[96]) {
+  if (p.inf) {
+    std::memset(out, 0, 96);
+    out[0] = 0xc0;
+    return;
+  }
+  fp_to_bytes_be(p.x.b, out);
+  fp_to_bytes_be(p.x.a, out + 48);
+  out[0] |= 0x80;
+  if (fp2_y_is_large(p.y)) out[0] |= 0x20;
+}
+
+static bool g2_decompress(const uint8_t in[96], G2 *out) {
+  if (in[0] & 0x40) {
+    out->inf = true;
+    return true;
+  }
+  bool sign_large = in[0] & 0x20;
+  uint8_t hb[48];
+  std::memcpy(hb, in, 48);
+  hb[0] &= 0x1f;
+  Fp2 x = {fp_from_bytes_be(in + 48, 48), fp_from_bytes_be(hb, 48)};
+  Fp2 rhs = fp2_add(fp2_mul(fp2_sqr(x), x), FP2_B2);
+  Fp2 y;
+  if (!fp2_sqrt(rhs, &y)) return false;
+  if (fp2_y_is_large(y) != sign_large) y = fp2_neg(y);
+  *out = {x, y, false};
+  return true;
+}
+
+// ===========================================================================
+// init
+// ===========================================================================
+static bool INITIALIZED = false;
+
+static void bls_init() {
+  if (INITIALIZED) return;
+  // N0INV = -p^{-1} mod 2^64 (Newton)
+  u64 inv = 1;
+  for (int i = 0; i < 6; ++i) inv *= 2 - P_LIMBS[0] * inv;
+  N0INV = ~inv + 1;
+
+  // FP_R = 2^384 mod p by 384 modular doublings of 1
+  Fp one_std = {};
+  one_std.l[0] = 1;
+  Fp r = one_std;
+  for (int i = 0; i < 384; ++i) {
+    // r = 2r mod p
+    u64 carry = 0;
+    Fp t;
+    for (int j = 0; j < 6; ++j) {
+      t.l[j] = (r.l[j] << 1) | carry;
+      carry = r.l[j] >> 63;
+    }
+    if (carry || fp_gte_p(t.l)) fp_sub_p(t.l);
+    r = t;
+  }
+  FP_R = r;
+  // FP_R2 = R^2 mod p: double R 384 more times
+  for (int i = 0; i < 384; ++i) {
+    u64 carry = 0;
+    Fp t;
+    for (int j = 0; j < 6; ++j) {
+      t.l[j] = (r.l[j] << 1) | carry;
+      carry = r.l[j] >> 63;
+    }
+    if (carry || fp_gte_p(t.l)) fp_sub_p(t.l);
+    r = t;
+  }
+  FP_R2 = r;
+
+  // P_MINUS_2 bytes (big-endian)
+  u64 pm2[6];
+  std::memcpy(pm2, P_LIMBS, 48);
+  pm2[0] -= 2;  // p ends in ...aaab, no borrow
+  for (int i = 0; i < 6; ++i)
+    for (int j = 0; j < 8; ++j)
+      P_MINUS_2[47 - 8 * i - j] = uint8_t(pm2[i] >> (8 * j));
+
+  FP2_ZERO = {FP_ZERO, FP_ZERO};
+  FP2_ONE = {FP_R, FP_ZERO};
+  XI2 = {FP_R, FP_R};  // 1 + u
+  FP6_ZERO = {FP2_ZERO, FP2_ZERO, FP2_ZERO};
+  FP6_ONE = {FP2_ONE, FP2_ZERO, FP2_ZERO};
+  FP12_ONE = {FP6_ONE, FP6_ZERO};
+
+  uint8_t three = 3, four = 4;
+  FP_THREE = fp_from_bytes_be(&three, 1);
+  FP_FOUR = fp_from_bytes_be(&four, 1);
+  FP2_THREE = {FP_THREE, FP_ZERO};
+  FP2_B2 = {FP_FOUR, FP_FOUR};  // 4(u+1)
+  FP12_THREE = fp2_to_fp12(FP2_THREE);
+
+  // eighth roots of unity: XI^((p^2-1)/8)^k
+  Fp2 base = fp2_pow_bytes(XI2, EIGHTH_ROOT_EXP, EIGHTH_ROOT_EXP_len);
+  EIGHTH_ROOTS[0] = FP2_ONE;
+  for (int k = 1; k < 4; ++k) EIGHTH_ROOTS[k] = fp2_mul(EIGHTH_ROOTS[k - 1], base);
+
+  // untwist constants: w = (0, 1) in Fp12; W2_INV = (w^2)^-1, W3_INV = (w^3)^-1
+  Fp12 w = {FP6_ZERO, FP6_ONE};
+  Fp12 w2 = fp12_mul(w, w);
+  Fp12 w3 = fp12_mul(w2, w);
+  W2_INV = fp12_inv(w2);
+  W3_INV = fp12_inv(w3);
+
+  G1_GENERATOR = {fp_from_bytes_be(G1X_BYTES, G1X_BYTES_len),
+                  fp_from_bytes_be(G1Y_BYTES, G1Y_BYTES_len), false};
+  G2_GENERATOR = {{fp_from_bytes_be(G2X0_BYTES, G2X0_BYTES_len),
+                   fp_from_bytes_be(G2X1_BYTES, G2X1_BYTES_len)},
+                  {fp_from_bytes_be(G2Y0_BYTES, G2Y0_BYTES_len),
+                   fp_from_bytes_be(G2Y1_BYTES, G2Y1_BYTES_len)},
+                  false};
+  INITIALIZED = true;
+}
+
+// ===========================================================================
+// C ABI
+// ===========================================================================
+extern "C" {
+
+// sk (32 bytes big-endian) -> compressed G1 pubkey (48 bytes)
+void bls_sk_to_pk(const uint8_t *sk, uint8_t *out48) {
+  bls_init();
+  G1 pk = ec_mul_bytes<G1, g1_add>(G1_GENERATOR, sk, 32);
+  g1_compress(pk, out48);
+}
+
+// sign: sk (32 BE) x message -> compressed G2 signature (96 bytes)
+void bls_sign(const uint8_t *sk, const uint8_t *msg, uint64_t msg_len,
+              uint8_t *out96) {
+  bls_init();
+  G2 h = hash_to_g2(msg, msg_len);
+  G2 sig = ec_mul_bytes<G2, g2_add>(h, sk, 32);
+  g2_compress(sig, out96);
+}
+
+// verify: e(pk, H(m)) == e(g1, sig); returns 1/0
+int bls_verify(const uint8_t *pk48, const uint8_t *msg, uint64_t msg_len,
+               const uint8_t *sig96) {
+  bls_init();
+  G1 pk;
+  G2 sig;
+  if (!g1_decompress(pk48, &pk) || !g2_decompress(sig96, &sig)) return 0;
+  if (pk.inf || sig.inf) return 0;
+  if (!g2_subgroup_check(sig)) return 0;
+  G2 h = hash_to_g2(msg, msg_len);
+  return pairings_equal_2(pk, h, G1_GENERATOR, sig) ? 1 : 0;
+}
+
+// aggregate n compressed G2 signatures; returns 1 on success
+int bls_aggregate(const uint8_t *sigs, uint64_t n, uint8_t *out96) {
+  bls_init();
+  if (n == 0) return 0;
+  G2 acc;
+  acc.inf = true;
+  for (uint64_t i = 0; i < n; ++i) {
+    G2 s;
+    if (!g2_decompress(sigs + 96 * i, &s)) return 0;
+    acc = g2_add(acc, s);
+  }
+  g2_compress(acc, out96);
+  return 1;
+}
+
+// aggregate n compressed G1 pubkeys
+int bls_aggregate_pks(const uint8_t *pks, uint64_t n, uint8_t *out48) {
+  bls_init();
+  if (n == 0) return 0;
+  G1 acc;
+  acc.inf = true;
+  for (uint64_t i = 0; i < n; ++i) {
+    G1 p;
+    if (!g1_decompress(pks + 48 * i, &p)) return 0;
+    acc = g1_add(acc, p);
+  }
+  g1_compress(acc, out48);
+  return 1;
+}
+
+// FastAggregateVerify: all pks signed the same message
+int bls_fast_aggregate_verify(const uint8_t *pks, uint64_t n,
+                              const uint8_t *msg, uint64_t msg_len,
+                              const uint8_t *sig96) {
+  bls_init();
+  if (n == 0) return 0;
+  uint8_t agg[48];
+  if (!bls_aggregate_pks(pks, n, agg)) return 0;
+  return bls_verify(agg, msg, msg_len, sig96);
+}
+
+int bls_subgroup_check_g1(const uint8_t *pk48) {
+  bls_init();
+  G1 p;
+  if (!g1_decompress(pk48, &p)) return 0;
+  return g1_subgroup_check(p) ? 1 : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,116 @@
+"""ctypes bindings for the native C++ BLS12-381 (component N1).
+
+``NativeBLS`` implements the same interface as ``FakeBLS``/``PyBLS``
+(crypto/bls.py) over ``native/build/libbls12381.so`` — differential tests
+pin it byte-identical to the pure-Python oracle. Use via
+``set_bls_backend(NativeBLS)``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from functools import lru_cache
+from typing import Sequence
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), os.pardir, "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "build", "libbls12381.so"))
+
+
+@lru_cache(maxsize=1)
+def _load():
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", os.path.abspath(_NATIVE_DIR)], check=True,
+                           capture_output=True, timeout=180)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.bls_sk_to_pk.argtypes = [u8p, u8p]
+    lib.bls_sign.argtypes = [u8p, u8p, ctypes.c_uint64, u8p]
+    lib.bls_verify.argtypes = [u8p, u8p, ctypes.c_uint64, u8p]
+    lib.bls_verify.restype = ctypes.c_int
+    lib.bls_aggregate.argtypes = [u8p, ctypes.c_uint64, u8p]
+    lib.bls_aggregate.restype = ctypes.c_int
+    lib.bls_aggregate_pks.argtypes = [u8p, ctypes.c_uint64, u8p]
+    lib.bls_aggregate_pks.restype = ctypes.c_int
+    lib.bls_fast_aggregate_verify.argtypes = [
+        u8p, ctypes.c_uint64, u8p, ctypes.c_uint64, u8p]
+    lib.bls_fast_aggregate_verify.restype = ctypes.c_int
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _buf(b: bytes):
+    return (ctypes.c_uint8 * len(b)).from_buffer_copy(bytes(b))
+
+
+class NativeBLS:
+    """Real BLS12-381 via the C++ core; byte-identical to crypto/bls12_381."""
+
+    name = "bls12_381_native"
+
+    @staticmethod
+    def SkToPk(sk: int) -> bytes:
+        out = (ctypes.c_uint8 * 48)()
+        _load().bls_sk_to_pk(_buf((sk % _R).to_bytes(32, "big")), out)
+        return bytes(out)
+
+    @staticmethod
+    def Sign(sk: int, message: bytes) -> bytes:
+        out = (ctypes.c_uint8 * 96)()
+        m = bytes(message)
+        _load().bls_sign(_buf((sk % _R).to_bytes(32, "big")), _buf(m), len(m), out)
+        return bytes(out)
+
+    @staticmethod
+    def Verify(pubkey: bytes, message: bytes, signature: bytes) -> bool:
+        m = bytes(message)
+        return bool(_load().bls_verify(_buf(bytes(pubkey)), _buf(m), len(m),
+                                       _buf(bytes(signature))))
+
+    @staticmethod
+    def Aggregate(signatures: Sequence[bytes]) -> bytes:
+        if not signatures:
+            raise ValueError("cannot aggregate zero signatures")
+        out = (ctypes.c_uint8 * 96)()
+        flat = b"".join(bytes(s) for s in signatures)
+        if not _load().bls_aggregate(_buf(flat), len(signatures), out):
+            raise ValueError("invalid signature in aggregate")
+        return bytes(out)
+
+    @staticmethod
+    def AggregatePKs(pubkeys: Sequence[bytes]) -> bytes:
+        out = (ctypes.c_uint8 * 48)()
+        flat = b"".join(bytes(p) for p in pubkeys)
+        if not _load().bls_aggregate_pks(_buf(flat), len(pubkeys), out):
+            raise ValueError("invalid pubkey in aggregate")
+        return bytes(out)
+
+    @staticmethod
+    def FastAggregateVerify(pubkeys: Sequence[bytes], message: bytes,
+                            signature: bytes) -> bool:
+        if not pubkeys:
+            return False
+        flat = b"".join(bytes(p) for p in pubkeys)
+        m = bytes(message)
+        return bool(_load().bls_fast_aggregate_verify(
+            _buf(flat), len(pubkeys), _buf(m), len(m), _buf(bytes(signature))))
+
+    @classmethod
+    def AggregateVerify(cls, pubkeys, messages, signature: bytes) -> bool:
+        # distinct-message verify stays on the Python oracle (rarely used)
+        from pos_evolution_tpu.crypto.bls12_381 import PyBLS
+        return PyBLS.AggregateVerify(pubkeys, messages, signature)
+
+
+_R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001

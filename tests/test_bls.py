@@ -77,6 +77,57 @@ class TestSignatures:
         assert not B.PyBLS.FastAggregateVerify([], msg, agg)
 
 
+class TestNativeBLS:
+    """C++ core (native/bls12_381.cpp) must be byte-identical to the
+    Python oracle."""
+
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        from pos_evolution_tpu.crypto import native_bls
+        if not native_bls.available():
+            pytest.skip("native BLS library not built")
+
+    def test_keys_and_signatures_match_oracle(self):
+        from pos_evolution_tpu.crypto.native_bls import NativeBLS
+        msg = b"\x11" * 32
+        for sk in (1, 99, 2**200):
+            assert NativeBLS.SkToPk(sk) == B.PyBLS.SkToPk(sk)
+        assert NativeBLS.Sign(99, msg) == B.PyBLS.Sign(99, msg)
+
+    def test_cross_verification(self):
+        from pos_evolution_tpu.crypto.native_bls import NativeBLS
+        msg = b"\x22" * 32
+        pk = NativeBLS.SkToPk(7)
+        sig_py = B.PyBLS.Sign(7, msg)
+        assert NativeBLS.Verify(pk, msg, sig_py)
+        assert not NativeBLS.Verify(pk, b"\x23" * 32, sig_py)
+        sig_c = NativeBLS.Sign(7, msg)
+        assert B.PyBLS.Verify(pk, msg, sig_c)
+
+    def test_fast_aggregate_verify(self):
+        from pos_evolution_tpu.crypto.native_bls import NativeBLS
+        msg = b"\x33" * 32
+        pks = [NativeBLS.SkToPk(k) for k in (1, 2, 3)]
+        agg = NativeBLS.Aggregate([NativeBLS.Sign(k, msg) for k in (1, 2, 3)])
+        assert agg == B.PyBLS.Aggregate([B.PyBLS.Sign(k, msg) for k in (1, 2, 3)])
+        assert NativeBLS.FastAggregateVerify(pks, msg, agg)
+        assert not NativeBLS.FastAggregateVerify(pks[:2], msg, agg)
+
+    def test_spec_transition_on_native_bls(self, minimal_cfg):
+        from pos_evolution_tpu.crypto.native_bls import NativeBLS
+        set_bls_backend(NativeBLS)
+        try:
+            from pos_evolution_tpu.specs.genesis import make_genesis
+            from pos_evolution_tpu.specs.transition import state_transition
+            from pos_evolution_tpu.specs.validator import build_block
+            state, _ = make_genesis(4)
+            sb = build_block(state, 1)
+            state_transition(state, sb, True)
+            assert int(state.slot) == 1
+        finally:
+            set_bls_backend(FakeBLS)
+
+
 class TestSpecOnRealBLS:
     def test_block_transition_with_real_crypto(self, minimal_cfg):
         """The spec layer is crypto-agnostic: a block with a real-BLS
