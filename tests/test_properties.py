@@ -1,0 +1,109 @@
+"""Property tests (SURVEY.md §4.3): accountable safety, plausible liveness,
+ebb-and-flow prefix — the reference's security properties as executable
+checks (pos-evolution.md:240-243, 1174-1196).
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config, use_config
+from pos_evolution_tpu.specs.containers import AttestationData, Checkpoint
+from pos_evolution_tpu.specs.helpers import is_slashable_attestation_data
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+def _ffg_vote(source_epoch, target_epoch, chain: int):
+    """An FFG vote on one of two conflicting chains."""
+    return AttestationData(
+        slot=target_epoch * 8, index=0,
+        beacon_block_root=bytes([chain]) * 32,
+        source=Checkpoint(epoch=source_epoch, root=bytes([chain, source_epoch]) * 16),
+        target=Checkpoint(epoch=target_epoch, root=bytes([chain, target_epoch]) * 16),
+    )
+
+
+class TestAccountableSafety:
+    """pos-evolution.md:242: two conflicting finalized checkpoints imply
+    more than 1/3 of stake is provably slashable."""
+
+    def test_conflicting_finalization_yields_one_third_slashable(self):
+        n = 90
+        validators = np.arange(n)
+        # chain A finalizes (epoch 4 -> 5): needs 2/3 of votes
+        set_a = set(range(0, 60))                     # 60/90 = 2/3
+        # chain B finalizes the conflicting (epoch 4 -> 5) pair
+        set_b = set(range(30, 90))                    # 60/90 = 2/3
+        votes: dict[int, list] = {v: [] for v in validators}
+        for v in set_a:
+            votes[v].append(_ffg_vote(4, 5, chain=0xA0))
+        for v in set_b:
+            votes[v].append(_ffg_vote(4, 5, chain=0xB0))
+
+        slashable = {
+            v for v, vs in votes.items()
+            if any(is_slashable_attestation_data(d1, d2)
+                   for i, d1 in enumerate(vs) for d2 in vs[i + 1:])
+        }
+        assert slashable == set_a & set_b
+        assert len(slashable) * 3 >= n, "fewer than 1/3 provably slashable"
+
+    def test_surround_finalization_also_accountable(self):
+        """The second violation mode: a finalization surrounded by a
+        wider vote span."""
+        n = 90
+        set_a = set(range(0, 60))
+        set_b = set(range(30, 90))
+        votes = {v: [] for v in range(n)}
+        for v in set_a:
+            votes[v].append(_ffg_vote(4, 5, chain=0xA0))   # finalize (4,5)
+        for v in set_b:
+            votes[v].append(_ffg_vote(3, 6, chain=0xB0))   # surrounds it
+        slashable = {
+            v for v, vs in votes.items()
+            if any(is_slashable_attestation_data(d1, d2)
+                   or is_slashable_attestation_data(d2, d1)
+                   for i, d1 in enumerate(vs) for d2 in vs[i + 1:])
+        }
+        assert len(slashable) * 3 >= n
+
+
+class TestPlausibleLiveness:
+    """pos-evolution.md:243: with > 2/3 honest stake online, new
+    checkpoints keep finalizing from any reachable state."""
+
+    def test_finality_resumes_after_stall(self):
+        from pos_evolution_tpu.sim import Schedule, Simulation
+        c = minimal_config()
+        stall_end = 3 * c.slots_per_epoch * c.intervals_per_slot
+        sched = Schedule(
+            n_validators=64,
+            # 30/64 asleep during epochs 0-2 (no finality), then all awake
+            awake=lambda r, v: (v >= 30) or (r >= stall_end))
+        sim = Simulation(64, schedule=sched)
+        sim.run_epochs(7)
+        assert sim.finalized_epoch() >= 4, \
+            "finality did not resume once 2/3 honest stake returned"
+
+
+class TestEbbAndFlowPrefix:
+    """pos-evolution.md:1188: LOG_fin is a prefix of LOG_da at all times."""
+
+    def test_finalized_chain_is_prefix_of_head_chain(self):
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.specs import forkchoice as fc
+        sim = Simulation(64)
+        sim.run_epochs(5)
+        store = sim.store()
+        head = fc.get_head(store)
+        finalized_root = bytes(store.finalized_checkpoint.root)
+        # walk the canonical chain; the finalized block must be on it
+        cur = head
+        seen = set()
+        while True:
+            seen.add(cur)
+            blk = store.blocks[cur]
+            if bytes(blk.parent_root) == cur or bytes(blk.parent_root) not in store.blocks:
+                break
+            cur = bytes(blk.parent_root)
+        assert finalized_root in seen
