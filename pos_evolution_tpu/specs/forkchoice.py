@@ -362,6 +362,37 @@ def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
         store.justified_checkpoint = state.current_justified_checkpoint
 
 
+def prune_store(store: Store) -> int:
+    """Drop blocks/states that cannot affect fork choice anymore: everything
+    not descending from (or equal to) the finalized checkpoint block.
+
+    The reference guarantees the fork-choice never walks behind the
+    finalized checkpoint (pos-evolution.md:407: "the fork-choice rule does
+    not need to go back more than this checkpoint"), so pruned entries are
+    unreachable. Returns the number of blocks removed.
+    """
+    finalized_root = bytes(store.finalized_checkpoint.root)
+    if finalized_root not in store.blocks:
+        return 0
+    finalized_slot = int(store.blocks[finalized_root].slot)
+    keep = set()
+    for root in store.blocks:
+        try:
+            if get_ancestor(store, root, finalized_slot) == finalized_root:
+                keep.add(root)
+        except KeyError:
+            continue
+    keep.add(finalized_root)
+    dropped = [r for r in store.blocks if r not in keep]
+    for r in dropped:
+        del store.blocks[r]
+        store.block_states.pop(r, None)
+    for key in [k for k in store.checkpoint_states
+                if k[0] < int(store.finalized_checkpoint.epoch)]:
+        del store.checkpoint_states[key]
+    return len(dropped)
+
+
 def on_attester_slashing(store: Store, attester_slashing: AttesterSlashing) -> None:
     """Equivocation evidence feeds the discounting set (pos-evolution.md:1447-1461)."""
     a1, a2 = attester_slashing.attestation_1, attester_slashing.attestation_2

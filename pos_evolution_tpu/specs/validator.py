@@ -49,6 +49,24 @@ from pos_evolution_tpu.ssz.core import uint64
 from pos_evolution_tpu.config import DOMAIN_BEACON_PROPOSER
 
 
+def get_committee_assignment(state: BeaconState, epoch: int,
+                             validator_index: int):
+    """Duty lookup: (committee, committee_index, slot) for the validator's
+    attestation duty in ``epoch``, or None (pos-evolution.md:450-455: one
+    committee per validator per epoch)."""
+    from pos_evolution_tpu.specs.helpers import get_committee_count_per_slot
+    next_epoch = get_current_epoch(state) + 1
+    assert epoch <= next_epoch
+    start_slot = compute_start_slot_at_epoch(epoch)
+    committees_per_slot = get_committee_count_per_slot(state, epoch)
+    for slot in range(start_slot, start_slot + cfg().slots_per_epoch):
+        for index in range(committees_per_slot):
+            committee = get_beacon_committee(state, slot, index)
+            if validator_index in committee:
+                return committee, index, slot
+    return None
+
+
 def advance_state_to_slot(state: BeaconState, slot: int) -> BeaconState:
     """Copy of ``state`` advanced through empty slots to ``slot``."""
     out = state.copy()

@@ -235,6 +235,43 @@ class TestEquivocationDiscounting:
         assert 5 not in store.latest_messages
 
 
+class TestPruning:
+    def test_prune_keeps_canonical_chain(self):
+        from pos_evolution_tpu.sim import Simulation
+        sim = Simulation(64)
+        sim.run_epochs(5)
+        store = sim.store()
+        assert sim.finalized_epoch() >= 3
+        head_before = fc.get_head(store)
+        n_before = len(store.blocks)
+        dropped = fc.prune_store(store)
+        assert dropped > 0
+        assert len(store.blocks) == n_before - dropped
+        assert fc.get_head(store) == head_before
+        # the store still processes new blocks after pruning
+        slot = fc.get_current_slot(store) + 1
+        fc.on_tick(store, store.genesis_time + slot * cfg().seconds_per_slot)
+        sb = build_block(store.block_states[head_before], slot)
+        fc.on_block(store, sb)
+        assert fc.get_head(store) == hash_tree_root(sb.message)
+
+
+class TestCommitteeAssignment:
+    def test_every_validator_has_exactly_one_duty(self):
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import get_committee_assignment
+        state, _ = make_genesis(32)
+        seen_slots = {}
+        for v in range(32):
+            duty = get_committee_assignment(state, 0, v)
+            assert duty is not None, f"validator {v} has no duty"
+            committee, index, slot = duty
+            assert v in committee
+            seen_slots[v] = slot
+        # committees partition the epoch: 32 validators over 8 slots
+        assert len(set(seen_slots.values())) == cfg().slots_per_epoch
+
+
 class TestOnTick:
     def test_best_justified_promoted_at_epoch_boundary(self):
         store, state, _ = new_store(32)
