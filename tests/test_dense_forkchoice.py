@@ -114,6 +114,23 @@ class TestDenseHeadDifferential:
         h1, _ = head_and_weights(dense, capacity, min_vote_epoch=jnp.int64(1))
         assert roots[int(h1)] == winner
 
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_fuzz_random_schedules(self, seed):
+        """Random sleepy schedules produce random fork patterns; spec and
+        dense heads must agree on every view at every slot."""
+        from pos_evolution_tpu.sim import Schedule, Simulation
+        rng = np.random.default_rng(seed)
+        sleep_table = rng.random((200, 64)) < 0.25
+        sched = Schedule(
+            n_validators=64,
+            awake=lambda r, v: not sleep_table[min(r, 199), v])
+        sim = Simulation(64, schedule=sched)
+        for _ in range(2 * cfg().slots_per_epoch):
+            sim.run_slot()
+            store = sim.store()
+            assert get_head_dense(store) == fc.get_head(store), \
+                f"divergence at slot {sim.slot - 1} (seed {seed})"
+
     def test_deep_chain_with_skips(self):
         state, anchor = make_genesis(32)
         store = fc.get_forkchoice_store(state, anchor)
