@@ -52,6 +52,47 @@ class TestMerkleLevelKernel:
             hashlib.sha256(block).digest()
 
 
+class TestPallasAggregation:
+    def test_matches_fakebls_and_xla(self):
+        from pos_evolution_tpu.crypto.bls import FakeBLS
+        from pos_evolution_tpu.ops.aggregation import (
+            aggregate_verify_batch, messages_to_words, pack_signature_words,
+            precompute_pk_states,
+        )
+        from pos_evolution_tpu.ops.pallas_aggregation import (
+            aggregate_verify_batch_pallas,
+        )
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        N, A, C = 32, 3, 8
+        pubkeys = np.stack([np.frombuffer(FakeBLS.SkToPk(i + 1), np.uint8)
+                            for i in range(N)])
+        pk_states = precompute_pk_states(pubkeys)
+        committees = rng.permutation(N)[:A * C].reshape(A, C).astype(np.int32)
+        bits = rng.random((A, C)) < 0.7
+        bits[:, 0] = True
+        messages = rng.integers(0, 255, (A, 32)).astype(np.uint8)
+        sigs = []
+        for a in range(A):
+            parts = [FakeBLS._sig_for(pubkeys[v].tobytes(), messages[a].tobytes())
+                     for v, b in zip(committees[a], bits[a]) if b]
+            sigs.append(FakeBLS.Aggregate(parts))
+        sw = jnp.asarray(pack_signature_words(sigs))
+        mw = jnp.asarray(messages_to_words(messages))
+        ok_xla = np.asarray(aggregate_verify_batch(
+            pk_states, jnp.asarray(committees), jnp.asarray(bits), mw, sw))
+        ok_pl = np.asarray(aggregate_verify_batch_pallas(
+            pk_states, jnp.asarray(committees), jnp.asarray(bits), mw, sw,
+            interpret=True))
+        assert ok_xla.all() and ok_pl.all()
+        bad_sw = np.asarray(sw).copy()
+        bad_sw[1, 3] ^= 4
+        bad = np.asarray(aggregate_verify_batch_pallas(
+            pk_states, jnp.asarray(committees), jnp.asarray(bits), mw,
+            jnp.asarray(bad_sw), interpret=True))
+        assert not bad[1] and bad[0] and bad[2]
+
+
 class TestDeviceMerkleize:
     @pytest.mark.parametrize("n,depth", [(8, 3), (8, 6), (1024, 10)])
     def test_matches_host_merkleize(self, n, depth):
