@@ -25,56 +25,9 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
+from pos_evolution_tpu.ops.aggregation import _msg_block2  # noqa: E402
+from pos_evolution_tpu.ops.pallas_sha256 import _rounds, _schedule  # noqa: E402
 from pos_evolution_tpu.ops.sha256 import _K, H0  # noqa: E402
-
-
-def _rotr(x, n: int):
-    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
-
-
-def _rounds_shared_w(state_words, w_ref, k_ref):
-    """64 rounds where the schedule is a per-attestation scalar row
-    (w_ref: (1, 64)) broadcast over the signer lanes."""
-
-    def body(t, carry):
-        a, b, c, d, e, f, g, h = carry
-        wt = w_ref[0, t]
-        kt = k_ref[0, t]
-        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + kt + wt
-        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
-        return (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
-
-    return jax.lax.fori_loop(0, 64, body, tuple(state_words))
-
-
-def _rounds_lane_w(state_words, w_stack, k_ref):
-    """64 rounds with a per-lane schedule stack w_stack: (64, C)."""
-
-    def body(t, carry):
-        a, b, c, d, e, f, g, h = carry
-        wt = jax.lax.dynamic_index_in_dim(w_stack, t, axis=0, keepdims=False)
-        kt = k_ref[0, t]
-        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + kt + wt
-        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
-        return (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
-
-    return jax.lax.fori_loop(0, 64, body, tuple(state_words))
-
-
-def _lane_schedule(w16: list):
-    """Expand 16 per-lane words to the (64, C) stack (unrolled, in-VMEM)."""
-    w = list(w16)
-    for t in range(16, 64):
-        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
-        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
-        w.append(w[t - 16] + s0 + w[t - 7] + s1)
-    return jnp.stack(w, axis=0)
 
 
 def _chain_words(h_words: list):
@@ -92,16 +45,23 @@ def _chain_words(h_words: list):
 
 def _agg_sig_kernel(k_ref, w2_ref, states_ref, out_ref):
     """One committee: states (1, 8, C) midstates; w2 (1, 64) the
-    attestation's second-block schedule; out (1, 24, C) signature words."""
+    attestation's second-block schedule; out (1, 24, C) signature words.
+
+    Refs are loaded once up front (the hoist-then-index pattern of
+    pallas_sha256) and the shared ``_rounds``/``_schedule`` helpers do the
+    compression: the per-attestation w2 row is a (64,) stack whose entries
+    broadcast over the signer lanes."""
     c = states_ref.shape[2]
+    k_stack = k_ref[0, :]
+    w2_stack = w2_ref[0, :]
     init = tuple(states_ref[0, i, :] for i in range(8))
-    mid = _rounds_shared_w(init, w2_ref, k_ref)
+    mid = _rounds(init, w2_stack, k_stack)
     h1 = tuple(mid[i] + init[i] for i in range(8))
 
     h0c = tuple(jnp.full((c,), np.uint32(H0[i])) for i in range(8))
-    f2 = _rounds_lane_w(h0c, _lane_schedule(_chain_words(list(h1))), k_ref)
+    f2 = _rounds(h0c, _schedule(_chain_words(list(h1))), k_stack)
     h2 = tuple(f2[i] + h0c[i] for i in range(8))
-    f3 = _rounds_lane_w(h0c, _lane_schedule(_chain_words(list(h2))), k_ref)
+    f3 = _rounds(h0c, _schedule(_chain_words(list(h2))), k_stack)
     h3 = tuple(f3[i] + h0c[i] for i in range(8))
 
     for i in range(8):
@@ -112,21 +72,7 @@ def _agg_sig_kernel(k_ref, w2_ref, states_ref, out_ref):
 
 def _schedule_host(w16_words):
     """(A, 16) u32 message blocks -> (A, 64) schedule stacks (XLA, cheap)."""
-    w = [w16_words[:, t] for t in range(16)]
-    for t in range(16, 64):
-        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
-        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
-        w.append(w[t - 16] + s0 + w[t - 7] + s1)
-    return jnp.stack(w, axis=1)  # (A, 64)
-
-
-def _msg_block2_words(msg_words):
-    a = msg_words.shape[0]
-    blk = jnp.zeros((a, 16), dtype=jnp.uint32)
-    blk = blk.at[:, 0:8].set(msg_words)
-    blk = blk.at[:, 8].set(np.uint32(0x80000000))
-    blk = blk.at[:, 15].set(np.uint32(96 * 8))
-    return blk
+    return _schedule([w16_words[:, t] for t in range(16)]).T
 
 
 def _pallas_sigs(pk_states, committees, msg_words, interpret: bool):
@@ -135,7 +81,7 @@ def _pallas_sigs(pk_states, committees, msg_words, interpret: bool):
     a, c = committees.shape
     gathered = pk_states[committees]                       # (A, C, 8)
     states_t = jnp.swapaxes(gathered, 1, 2)                # (A, 8, C)
-    w2 = _schedule_host(_msg_block2_words(msg_words))      # (A, 64)
+    w2 = _schedule_host(_msg_block2(msg_words))            # (A, 64)
     k = jnp.asarray(_K)[None, :]                           # (1, 64)
 
     out = pl.pallas_call(
