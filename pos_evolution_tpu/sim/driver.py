@@ -62,12 +62,15 @@ class ViewGroup:
         heapq.heappush(self.queue, _QueuedMessage(time, self._seq, kind, payload))
         self._seq += 1
 
-    def deliver_due(self, now: float) -> None:
+    def deliver_due(self, now: float, timer=None) -> None:
+        from contextlib import nullcontext
+        track = timer.track if timer is not None else (lambda name: nullcontext())
         while self.queue and self.queue[0].time <= now:
             msg = heapq.heappop(self.queue)
             try:
                 if msg.kind == "block":
-                    fc.on_block(self.store, msg.payload)
+                    with track("on_block"):
+                        fc.on_block(self.store, msg.payload)
                     # process the block's own attestations for fork choice
                     for att in msg.payload.message.body.attestations:
                         try:
@@ -75,7 +78,8 @@ class ViewGroup:
                         except AssertionError:
                             pass
                 elif msg.kind == "attestation":
-                    fc.on_attestation(self.store, msg.payload)
+                    with track("on_attestation"):
+                        fc.on_attestation(self.store, msg.payload)
                     self.pool[hash_tree_root(msg.payload)] = msg.payload
                 elif msg.kind == "slashing":
                     fc.on_attester_slashing(self.store, msg.payload)
@@ -107,12 +111,21 @@ class Simulation:
         # dense segment-sum + reachability pass instead of the spec walk —
         # differential-equal by test_dense_forkchoice.py.
         self.accelerated_forkchoice = accelerated_forkchoice
+        # Per-handler tracing (SURVEY.md §5): wall-clock p50/p95 for
+        # get_head / on_block / on_attestation via utils.metrics.
+        from pos_evolution_tpu.utils.metrics import HandlerTimer
+        self.timer = HandlerTimer()
 
     def _get_head(self, store: fc.Store) -> bytes:
-        if self.accelerated_forkchoice:
-            from pos_evolution_tpu.ops.forkchoice import get_head_dense
-            return get_head_dense(store)
-        return fc.get_head(store)
+        with self.timer.track("get_head"):
+            if self.accelerated_forkchoice:
+                from pos_evolution_tpu.ops.forkchoice import get_head_dense
+                return get_head_dense(store)
+            return fc.get_head(store)
+
+    def trace_summary(self) -> dict:
+        """Per-handler timing percentiles for this run."""
+        return self.timer.summary()
 
     # -- time helpers --
     def slot_start(self, slot: int) -> int:
@@ -125,7 +138,7 @@ class Simulation:
     def _tick_all(self, time: float) -> None:
         for g in self.groups:
             fc.on_tick(g.store, int(time))
-            g.deliver_due(time)
+            g.deliver_due(time, timer=self.timer)
 
     # -- duties --
     def _head_state(self, group: ViewGroup, slot: int):

@@ -28,6 +28,16 @@ class TestHonestRun:
         assert [m["slot"] for m in sim.metrics] == [0, 1, 2, 3, 4]
         assert all("head" in m and "finalized_epoch" in m for m in sim.metrics)
 
+    def test_handler_tracing(self):
+        """SURVEY.md §5: per-handler timing (on_block/on_attestation/
+        get_head) collected during the run."""
+        sim = Simulation(32)
+        sim.run_until_slot(6)
+        s = sim.trace_summary()
+        for handler in ("get_head", "on_block", "on_attestation"):
+            assert handler in s and s[handler]["count"] > 0
+            assert s[handler]["p50_ms"] >= 0
+
 
 class TestAcceleratedForkChoice:
     def test_accelerated_run_matches_spec_run(self):

@@ -156,6 +156,39 @@ class TestGoldfish:
         assert len(sim.chain_of(0)) >= 10
 
 
+class TestRLMDAsynchronyTolerance:
+    """pos-evolution.md:1600: RLMD-GHOST tolerates asynchronous periods
+    shorter than eta - 1 slots; Goldfish (eta = 1) cannot tolerate even one
+    (:1579-1583)."""
+
+    def _fork_after_async(self, eta, async_slots):
+        """Honest votes anchor chain A at slot 5; then `async_slots` slots
+        with no honest votes; the adversary proposes chain B and one fresh
+        vote. Does A survive the fork choice at the end?"""
+        view = View()
+        a1 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=0)
+        b1 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=1)
+        view.add_block(a1)
+        view.add_block(b1)
+        for v in range(8):  # strong honest support for A at slot 5
+            view.add_vote(HeadVote(slot=5, block_root=a1.root, validator=v))
+        # asynchronous gap: slots 6 .. 5+async_slots produce nothing honest;
+        # at the end the adversary votes once for B
+        decision_slot = 6 + async_slots
+        view.add_vote(HeadVote(slot=decision_slot - 1, block_root=b1.root,
+                               validator=99))
+        return ghost_head(view, decision_slot, eta) == a1.root
+
+    def test_eta_window_bounds_tolerance(self):
+        # eta = 6: a 3-slot async gap (< eta - 1) keeps chain A canonical
+        assert self._fork_after_async(eta=6, async_slots=3)
+        # the same gap kills Goldfish (eta = 1): old votes expired,
+        # the single adversarial fresh vote wins
+        assert not self._fork_after_async(eta=1, async_slots=3)
+        # and RLMD with a gap >= eta also loses the anchor
+        assert not self._fork_after_async(eta=3, async_slots=4)
+
+
 class TestSSF:
     def test_single_slot_finality_under_synchrony(self):
         """pos-evolution.md:1637: honest proposer + synchrony + honest
