@@ -469,19 +469,107 @@ static G2 g2_add(const G2 &p, const G2 &q) {
       p, q, FP2_THREE);
 }
 
-template <typename P, P (*Padd)(const P &, const P &)>
-static P ec_mul_bytes(const P &p, const uint8_t *k, size_t n) {
-  P r;
-  r.inf = true;
-  P add = p;
+// --- Jacobian-coordinate scalar multiplication (a = 0 curves) --------------
+// Affine add/double need a field inversion per step (~500 muls); Jacobian
+// formulas (dbl-2009-l / add-2007-bl) use ~10-16 muls per step with one
+// inversion at the end, making scalar mults ~30x cheaper. Outputs are
+// converted back to canonical affine, so results are unchanged.
+
+template <typename F> struct Jac { F X, Y, Z; bool inf; };
+
+template <typename F, F (*Fadd)(const F &, const F &),
+          F (*Fsub)(const F &, const F &), F (*Fmul)(const F &, const F &)>
+static Jac<F> jac_double(const Jac<F> &p) {
+  if (p.inf) return p;
+  F A = Fmul(p.X, p.X);
+  F B = Fmul(p.Y, p.Y);
+  F C = Fmul(B, B);
+  F xb = Fadd(p.X, B);
+  F D = Fsub(Fsub(Fmul(xb, xb), A), C);
+  D = Fadd(D, D);
+  F E = Fadd(Fadd(A, A), A);
+  F Fq = Fmul(E, E);
+  F X3 = Fsub(Fq, Fadd(D, D));
+  F C8 = Fadd(C, C); C8 = Fadd(C8, C8); C8 = Fadd(C8, C8);
+  F Y3 = Fsub(Fmul(E, Fsub(D, X3)), C8);
+  F Z3 = Fmul(p.Y, p.Z);
+  Z3 = Fadd(Z3, Z3);
+  return {X3, Y3, Z3, false};
+}
+
+template <typename F, F (*Fadd)(const F &, const F &),
+          F (*Fsub)(const F &, const F &), F (*Fmul)(const F &, const F &),
+          bool (*Fzero)(const F &)>
+static Jac<F> jac_add(const Jac<F> &p, const Jac<F> &q) {
+  if (p.inf) return q;
+  if (q.inf) return p;
+  F Z1Z1 = Fmul(p.Z, p.Z);
+  F Z2Z2 = Fmul(q.Z, q.Z);
+  F U1 = Fmul(p.X, Z2Z2);
+  F U2 = Fmul(q.X, Z1Z1);
+  F S1 = Fmul(Fmul(p.Y, q.Z), Z2Z2);
+  F S2 = Fmul(Fmul(q.Y, p.Z), Z1Z1);
+  F H = Fsub(U2, U1);
+  F rr = Fsub(S2, S1);
+  if (Fzero(H)) {
+    if (Fzero(rr)) return jac_double<F, Fadd, Fsub, Fmul>(p);
+    Jac<F> r;
+    r.inf = true;
+    return r;
+  }
+  rr = Fadd(rr, rr);
+  F H2 = Fadd(H, H);
+  F I = Fmul(H2, H2);
+  F J = Fmul(H, I);
+  F V = Fmul(U1, I);
+  F X3 = Fsub(Fsub(Fmul(rr, rr), J), Fadd(V, V));
+  F SJ = Fmul(S1, J);
+  F Y3 = Fsub(Fmul(rr, Fsub(V, X3)), Fadd(SJ, SJ));
+  F Z12 = Fadd(p.Z, q.Z);
+  F Z3 = Fmul(Fsub(Fsub(Fmul(Z12, Z12), Z1Z1), Z2Z2), H);
+  return {X3, Y3, Z3, false};
+}
+
+template <typename P, typename F, F (*Fadd)(const F &, const F &),
+          F (*Fsub)(const F &, const F &), F (*Fmul)(const F &, const F &),
+          F (*Finv)(const F &), bool (*Fzero)(const F &)>
+static P jac_mul_bytes(const P &p, const uint8_t *k, size_t n, const F &one) {
+  if (p.inf) return p;
+  Jac<F> acc;
+  acc.inf = true;
+  Jac<F> base = {p.x, p.y, one, false};
   // LSB-first over the byte string interpreted big-endian
   for (size_t i = n; i-- > 0;) {
     for (int bit = 0; bit < 8; ++bit) {
-      if ((k[i] >> bit) & 1) r = Padd(r, add);
-      add = Padd(add, add);
+      if ((k[i] >> bit) & 1)
+        acc = jac_add<F, Fadd, Fsub, Fmul, Fzero>(acc, base);
+      base = jac_double<F, Fadd, Fsub, Fmul>(base);
     }
   }
-  return r;
+  P out;
+  if (acc.inf || Fzero(acc.Z)) {
+    out.inf = true;
+    return out;
+  }
+  F zinv = Finv(acc.Z);
+  F zinv2 = Fmul(zinv, zinv);
+  out.x = Fmul(acc.X, zinv2);
+  out.y = Fmul(acc.Y, Fmul(zinv2, zinv));
+  out.inf = false;
+  return out;
+}
+
+static bool fp_is_zero_f(const Fp &a) { return fp_is_zero(a); }
+static bool fp2_is_zero_f(const Fp2 &a) { return fp2_is_zero(a); }
+
+static G1 ec_mul_bytes(const G1 &p, const uint8_t *k, size_t n) {
+  return jac_mul_bytes<G1, Fp, fp_add, fp_sub, fp_mul, fp_inv, fp_is_zero_f>(
+      p, k, n, FP_R);
+}
+
+static G2 ec_mul_bytes(const G2 &p, const uint8_t *k, size_t n) {
+  return jac_mul_bytes<G2, Fp2, fp2_add, fp2_sub, fp2_mul, fp2_inv,
+                       fp2_is_zero_f>(p, k, n, FP2_ONE);
 }
 
 static bool g2_subgroup_check(const G2 &p) {
@@ -490,7 +578,7 @@ static bool g2_subgroup_check(const G2 &p) {
   Fp2 lhs = fp2_sqr(p.y);
   Fp2 rhs = fp2_add(fp2_mul(fp2_sqr(p.x), p.x), FP2_B2);
   if (!fp2_eq(lhs, rhs)) return false;
-  G2 t = ec_mul_bytes<G2, g2_add>(p, CURVE_ORDER_BYTES, CURVE_ORDER_BYTES_len);
+  G2 t = ec_mul_bytes(p, CURVE_ORDER_BYTES, CURVE_ORDER_BYTES_len);
   return t.inf;
 }
 
@@ -499,7 +587,7 @@ static bool g1_subgroup_check(const G1 &p) {
   Fp lhs = fp_mul(p.y, p.y);
   Fp rhs = fp_add(fp_mul(fp_mul(p.x, p.x), p.x), FP_FOUR);
   if (!fp_eq(lhs, rhs)) return false;
-  G1 t = ec_mul_bytes<G1, g1_add>(p, CURVE_ORDER_BYTES, CURVE_ORDER_BYTES_len);
+  G1 t = ec_mul_bytes(p, CURVE_ORDER_BYTES, CURVE_ORDER_BYTES_len);
   return t.inf;
 }
 
@@ -620,7 +708,7 @@ static G2 hash_to_g2(const uint8_t *msg, size_t msg_len) {
     if (!fp2_sqrt(rhs, &y)) continue;
     if (fp_is_odd_std(y.a)) y = fp2_neg(y);
     G2 pt = {x, y, false};
-    G2 cleared = ec_mul_bytes<G2, g2_add>(pt, G2_COFACTOR_BYTES,
+    G2 cleared = ec_mul_bytes(pt, G2_COFACTOR_BYTES,
                                           G2_COFACTOR_BYTES_len);
     if (!cleared.inf) return cleared;
   }
@@ -822,7 +910,7 @@ extern "C" {
 // sk (32 bytes big-endian) -> compressed G1 pubkey (48 bytes)
 void bls_sk_to_pk(const uint8_t *sk, uint8_t *out48) {
   bls_init();
-  G1 pk = ec_mul_bytes<G1, g1_add>(G1_GENERATOR, sk, 32);
+  G1 pk = ec_mul_bytes(G1_GENERATOR, sk, 32);
   g1_compress(pk, out48);
 }
 
@@ -831,7 +919,7 @@ void bls_sign(const uint8_t *sk, const uint8_t *msg, uint64_t msg_len,
               uint8_t *out96) {
   bls_init();
   G2 h = hash_to_g2(msg, msg_len);
-  G2 sig = ec_mul_bytes<G2, g2_add>(h, sk, 32);
+  G2 sig = ec_mul_bytes(h, sk, 32);
   g2_compress(sig, out96);
 }
 

@@ -62,27 +62,28 @@ class ViewGroup:
         heapq.heappush(self.queue, _QueuedMessage(time, self._seq, kind, payload))
         self._seq += 1
 
-    def deliver_due(self, now: float, timer=None) -> None:
-        from contextlib import nullcontext
-        track = timer.track if timer is not None else (lambda name: nullcontext())
+    def deliver_due(self, now: float, timer) -> None:
+        track = timer.track
         while self.queue and self.queue[0].time <= now:
             msg = heapq.heappop(self.queue)
             try:
                 if msg.kind == "block":
+                    # block-carried attestations are part of on_block cost
                     with track("on_block"):
                         fc.on_block(self.store, msg.payload)
-                    # process the block's own attestations for fork choice
-                    for att in msg.payload.message.body.attestations:
-                        try:
-                            fc.on_attestation(self.store, att, is_from_block=True)
-                        except AssertionError:
-                            pass
+                        for att in msg.payload.message.body.attestations:
+                            try:
+                                fc.on_attestation(self.store, att,
+                                                  is_from_block=True)
+                            except AssertionError:
+                                pass
                 elif msg.kind == "attestation":
                     with track("on_attestation"):
                         fc.on_attestation(self.store, msg.payload)
                     self.pool[hash_tree_root(msg.payload)] = msg.payload
                 elif msg.kind == "slashing":
-                    fc.on_attester_slashing(self.store, msg.payload)
+                    with track("on_attester_slashing"):
+                        fc.on_attester_slashing(self.store, msg.payload)
             except AssertionError:
                 # Invalid-at-this-time messages are dropped (the reference
                 # permits re-queueing, pos-evolution.md:967-968; the driver
