@@ -150,3 +150,30 @@ def load_dense(path: str):
     import jax.numpy as jnp
     with np.load(path) as z:
         return DenseRegistry(**{f: jnp.asarray(z[f]) for f in DenseRegistry._fields})
+
+
+def save_dense_orbax(path: str, registry) -> None:
+    """Checkpoint the dense registry pytree with orbax (device->host
+    offload of possibly mesh-sharded arrays)."""
+    import orbax.checkpoint as ocp
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, registry._asdict(), force=True)
+
+
+def load_dense_orbax(path: str, mesh=None):
+    """Restore a DenseRegistry checkpoint.
+
+    With ``mesh``, arrays are re-placed sharded over the validator axes of
+    the *current* topology (safe across topology changes); otherwise they
+    come back as single-device jnp arrays (matching ``load_dense``).
+    """
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+    from pos_evolution_tpu.ops.epoch import DenseRegistry
+    with ocp.PyTreeCheckpointer() as ckptr:
+        tree = ckptr.restore(path)
+    reg = DenseRegistry(**{f: jnp.asarray(tree[f]) for f in DenseRegistry._fields})
+    if mesh is not None:
+        from pos_evolution_tpu.parallel.sharded import shard_registry
+        reg = shard_registry(mesh, reg)
+    return reg
