@@ -34,6 +34,7 @@ from pos_evolution_tpu.specs.helpers import (
     get_beacon_committee,
     get_committee_count_per_slot,
 )
+from pos_evolution_tpu.specs.transition import state_transition
 from pos_evolution_tpu.specs.validator import (
     advance_state_to_slot,
     build_block,
@@ -228,6 +229,143 @@ def run_ex_ante_reorg_with_boost(n_validators: int = 800) -> dict:
         "b3_reorged": not _chain_contains(store, head, r3),
         "b4_canonical": _chain_contains(store, head, r4),
         "b2_canonical": _chain_contains(store, head, r2),
+    }
+
+
+# --- LMD balancing despite proposer boost (pos-evolution.md:1379-1403) --------
+
+def run_lmd_balancing_attack(n_validators: int = 800) -> dict:
+    """The balancing attack that survives proposer boost, using the LMD
+    first-received rule (pos-evolution.md:1383: equal-epoch votes never
+    replace the table entry).
+
+    Reference numbers (:1385): W = 100 validators per slot, 20% Byzantine
+    (20 per slot), five consecutive Byzantine proposers. Slots 1-4 build
+    two private chains with equivocating votes on each; at slot 5 two
+    equivocating blocks carrying the 80 votes per chain are released to the
+    two honest halves. Each half's LMD table permanently credits its chain
+    80:0 (:1394), so honest votes split every slot thereafter despite the
+    boost flipping temporarily (:1396-1399).
+    """
+    c = cfg()
+    state, anchor = make_genesis(n_validators)
+    store_A = fc.get_forkchoice_store(state, anchor)
+    store_B = fc.get_forkchoice_store(state, anchor)
+    stores = (store_A, store_B)
+
+    def committee_of(slot):
+        view = advance_state_to_slot(state, slot)
+        count = get_committee_count_per_slot(view, compute_epoch_at_slot(slot))
+        return [get_beacon_committee(view, slot, i) for i in range(count)]
+
+    # Adaptive corruption: the proposers of slots 1-5 (they equivocate) +
+    # 20 members of each slot committee (the adversary picks whom to
+    # corrupt, :183-185).
+    from pos_evolution_tpu.specs.helpers import get_beacon_proposer_index
+    corrupted: set[int] = set()
+    per_slot_byz: dict[int, list[int]] = {}
+    for slot in range(1, 6):
+        flat = [int(v) for com in committee_of(slot) for v in com]
+        per_slot_byz[slot] = flat[:20]
+        corrupted.update(per_slot_byz[slot])
+        corrupted.add(int(get_beacon_proposer_index(
+            advance_state_to_slot(state, slot))))
+
+    # --- slots 1-4: two private chains, equivocating votes on both ---
+    chain_states = {"L": state, "R": state}
+    chain_blocks = {"L": [], "R": []}
+    chain_votes = {"L": [], "R": []}
+    for slot in range(1, 5):
+        for side, graffiti in (("L", b"\x1f" * 32), ("R", b"\xf1" * 32)):
+            sb = build_block(chain_states[side], slot, graffiti=graffiti)
+            chain_blocks[side].append(sb)
+            post = chain_states[side].copy()
+            state_transition(post, sb, True)
+            chain_states[side] = post
+            head_root = hash_tree_root(sb.message)
+            head_state = advance_state_to_slot(post, slot)
+            # the slot's 20 Byzantine attesters vote this chain's head too
+            # (equivocation across chains)
+            votes = _committee_attestations(
+                head_state, slot, head_root,
+                participants=np.array(per_slot_byz[slot], dtype=np.int64))
+            chain_votes[side].extend(votes)
+
+    # --- slot 5: equivocating blocks carry each chain's 80 votes ---
+    release_blocks = {}
+    for side in ("L", "R"):
+        assert len(chain_votes[side]) <= c.max_attestations, \
+            "equivocating votes exceed the block's attestation capacity"
+        sb5 = build_block(chain_states[side], 5,
+                          attestations=chain_votes[side],
+                          graffiti=(b"\x55" if side == "L" else b"\xaa") * 32)
+        release_blocks[side] = sb5
+
+    def deliver(store, side):
+        for sb in chain_blocks[side] + [release_blocks[side]]:
+            fc.on_block(store, sb)
+            for att in sb.message.body.attestations:
+                try:
+                    fc.on_attestation(store, att, is_from_block=True)
+                except AssertionError:
+                    pass
+
+    # deliver: each view gets "its" chain timely at slot 5 (boost applies),
+    # the other chain only after the attesting interval (no boost, and the
+    # equal-epoch LMD entries keep the first-received chain, :1383, :1394)
+    for s in stores:
+        _tick(s, 5)
+    deliver(store_A, "L")
+    deliver(store_B, "R")
+    for s in stores:
+        _tick(s, 5, offset=_attest_interval(c) + 1)
+    deliver(store_A, "R")
+    deliver(store_B, "L")
+
+    gwei32 = 32 * 10**9
+    firstL = hash_tree_root(chain_blocks["L"][0].message)
+    firstR = hash_tree_root(chain_blocks["R"][0].message)
+    wA_L = fc.get_latest_attesting_balance(store_A, firstL)
+    wA_R = fc.get_latest_attesting_balance(store_A, firstR)
+    wB_L = fc.get_latest_attesting_balance(store_B, firstL)
+    wB_R = fc.get_latest_attesting_balance(store_B, firstR)
+
+    # --- slots 6+: honest halves keep voting their own side ---
+    heads_disagree = []
+    honest = [v for v in range(n_validators) if v not in corrupted]
+    halves = (set(honest[0::2]), set(honest[1::2]))
+    pending_cross: list[tuple[int, object]] = []  # (dst_store_idx, att)
+    for slot in range(6, 11):
+        for s in stores:
+            _tick(s, slot)
+        # last slot's cross-view votes arrive now (gossip delay Delta; they
+        # never displace equal-epoch LMD entries, :1383)
+        for dst, a in pending_cross:
+            try:
+                fc.on_attestation(stores[dst], a, is_from_block=True)
+            except AssertionError:
+                pass
+        pending_cross = []
+        for idx, (store, half) in enumerate(zip(stores, halves)):
+            head = fc.get_head(store)
+            head_state = advance_state_to_slot(store.block_states[head], slot)
+            atts = _committee_attestations(
+                head_state, slot, head,
+                participants=np.array(sorted(half), dtype=np.int64))
+            for a in atts:
+                try:
+                    fc.on_attestation(store, a, is_from_block=True)
+                except AssertionError:
+                    pass
+                pending_cross.append((1 - idx, a))
+        heads_disagree.append(fc.get_head(store_A) != fc.get_head(store_B))
+
+    return {
+        "viewA_L_votes": wA_L // gwei32, "viewA_R_votes": wA_R // gwei32,
+        "viewB_L_votes": wB_L // gwei32, "viewB_R_votes": wB_R // gwei32,
+        "heads_disagree": heads_disagree,
+        "justified_A": int(store_A.justified_checkpoint.epoch),
+        "justified_B": int(store_B.justified_checkpoint.epoch),
     }
 
 

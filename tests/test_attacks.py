@@ -12,6 +12,7 @@ from pos_evolution_tpu.sim.attacks import (
     run_balancing_attack,
     run_ex_ante_reorg,
     run_ex_ante_reorg_with_boost,
+    run_lmd_balancing_attack,
 )
 
 
@@ -39,6 +40,22 @@ class TestExAnteReorg:
         assert r["per_slot_committee"] == 100
         assert r["b3_reorged"]
         assert r["b4_canonical"] and r["b2_canonical"]
+
+
+class TestLMDBalancingDespiteBoost:
+    def test_views_split_80_0_and_heads_never_converge(self):
+        """pos-evolution.md:1379-1403 with the reference's numbers: W=100
+        per slot, 20 Byzantine per slot, W_p = 0.7W. After the slot-5
+        release each half's LMD table credits its chain 80:0 (:1394; with
+        the boost the leading view shows 150, :1396), and honest votes keep
+        splitting every slot despite boost."""
+        with use_config(minimal_config().replace(proposer_score_boost_percent=70)):
+            r = run_lmd_balancing_attack(800)
+        # 80 equivocating votes + 70 boost on the released block (:1396)
+        assert r["viewA_L_votes"] == 150 and r["viewA_R_votes"] == 0
+        assert r["viewB_R_votes"] == 150 and r["viewB_L_votes"] == 0
+        assert all(r["heads_disagree"]), r["heads_disagree"]
+        assert r["justified_A"] == 0 and r["justified_B"] == 0
 
 
 class TestBalancingAttack:
