@@ -9,8 +9,8 @@ update, plus the participation-flag rotation.
 
 All integer arithmetic is int64 (exact Gwei semantics; differential tests
 assert bit-identical results against the NumPy spec oracle). Registry
-*churn* (activation queue/ejections, O(changes) per epoch) stays in the
-spec layer — the O(n) work is here.
+churn (eligibility marking, churn-limited ejections, the activation
+dequeue) is also available on device via ``registry_churn_dense``.
 
 The sharded multi-chip version in ``parallel/sharded.py`` wraps these same
 functions in ``shard_map`` with ``psum`` over the validator axis.
@@ -68,14 +68,17 @@ class EpochResult(NamedTuple):
     finalize_epoch: jax.Array            # int64 scalar (-1 = no finalization)
 
 
+def _epochs_to_i64(a: np.ndarray) -> jax.Array:
+    """uint64 epoch column -> int64 with FAR_FUTURE mapped to the sentinel."""
+    a = a.astype(np.uint64)
+    out = np.where(a == np.uint64(2**64 - 1), np.uint64(FAR_FUTURE_I64), a)
+    return jnp.asarray(out.astype(np.int64))
+
+
 def densify(state) -> DenseRegistry:
     """Extract the dense arrays from a spec-level BeaconState (host)."""
     reg = state.validators
-
-    def epochs(a):
-        a = a.astype(np.uint64)
-        out = np.where(a == np.uint64(2**64 - 1), np.uint64(FAR_FUTURE_I64), a)
-        return jnp.asarray(out.astype(np.int64))
+    epochs = _epochs_to_i64
 
     return DenseRegistry(
         effective_balance=jnp.asarray(reg.effective_balance.astype(np.int64)),
@@ -277,3 +280,89 @@ def process_epoch_dense(reg: DenseRegistry,
     return epoch_core(reg, current_epoch, finalized_epoch, justification_bits,
                       prev_justified_epoch, cur_justified_epoch, slashings_sum,
                       cfg)
+
+
+# --- registry churn on device (activation queue + ejections) -----------------
+
+class ChurnResult(NamedTuple):
+    activation_eligibility_epoch: jax.Array
+    activation_epoch: jax.Array
+    exit_epoch: jax.Array
+    withdrawable_epoch: jax.Array
+
+
+def densify_eligibility(state) -> jax.Array:
+    """activation_eligibility_epoch column (not part of DenseRegistry's
+    sweep pytree; only the churn kernel needs it)."""
+    return _epochs_to_i64(state.validators.activation_eligibility_epoch)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def registry_churn_dense(reg: DenseRegistry,
+                         activation_eligibility_epoch,
+                         current_epoch,
+                         finalized_epoch,
+                         cfg: Config) -> ChurnResult:
+    """Device form of ``process_registry_updates`` (SURVEY.md §2.6):
+    eligibility marking, balance ejections through the churn-limited exit
+    queue, and the activation dequeue — bit-identical to the spec loop.
+
+    The spec assigns exit epochs sequentially (each ejection re-reads the
+    queue tail); the closed form below reproduces that exactly: the k-th
+    ejection (index order) lands at
+      base + (existing + k) // limit          if existing < limit
+      base + 1 + k // limit                   otherwise
+    where base = max(max existing exit epoch, activation_exit_epoch(cur)).
+    """
+    current_epoch = jnp.asarray(current_epoch, dtype=jnp.int64)
+    far = FAR_FUTURE_I64
+
+    # churn limit from the current active count
+    active = _active(reg, current_epoch)
+    n_active = jnp.sum(active)
+    limit = jnp.maximum(np.int64(cfg.min_per_epoch_churn_limit),
+                        n_active // np.int64(cfg.churn_limit_quotient))
+
+    # 1) eligibility marking
+    newly_eligible = ((activation_eligibility_epoch == far)
+                      & (reg.effective_balance == np.int64(cfg.max_effective_balance)))
+    eligibility = jnp.where(newly_eligible, current_epoch + 1,
+                            activation_eligibility_epoch)
+
+    # 2) ejections through the exit queue
+    ejectable = (active
+                 & (reg.effective_balance <= np.int64(cfg.ejection_balance))
+                 & (reg.exit_epoch == far))
+    exiting = reg.exit_epoch != far
+    max_exit = jnp.max(jnp.where(exiting, reg.exit_epoch, 0))  # 0 if none
+    act_exit = current_epoch + 1 + np.int64(cfg.max_seed_lookahead)
+    base = jnp.maximum(max_exit, act_exit)
+    existing = jnp.sum(exiting & (reg.exit_epoch == base))
+    k = jnp.cumsum(ejectable) - 1  # rank among ejectable, index order
+    epoch_lt = base + (existing + k) // limit
+    epoch_ge = base + 1 + k // limit
+    assigned = jnp.where(existing < limit, epoch_lt, epoch_ge)
+    exit_epoch = jnp.where(ejectable, assigned, reg.exit_epoch)
+    withdrawable = jnp.where(
+        ejectable,
+        assigned + np.int64(cfg.min_validator_withdrawability_delay),
+        reg.withdrawable_epoch)
+
+    # 3) activation dequeue: (eligibility, index) order, up to the limit
+    queued = ((eligibility <= finalized_epoch) & (reg.activation_epoch == far))
+    n = reg.activation_epoch.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    # single sortable key: eligibility * n + index (eligibility < 2^62 / n
+    # for any realistic registry; non-queued pushed to the end)
+    key = jnp.where(queued, eligibility * np.int64(n) + idx, np.int64(2**63 - 1))
+    order = jnp.argsort(key)
+    rank = jnp.zeros(n, dtype=jnp.int64).at[order].set(idx)
+    dequeued = queued & (rank < limit)
+    activation = jnp.where(dequeued, act_exit, reg.activation_epoch)
+
+    return ChurnResult(
+        activation_eligibility_epoch=eligibility,
+        activation_epoch=activation,
+        exit_epoch=exit_epoch,
+        withdrawable_epoch=withdrawable,
+    )

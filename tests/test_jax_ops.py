@@ -139,6 +139,56 @@ class TestDenseEpochDifferential:
         else:
             assert expect_fin == fin_before
 
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_registry_churn_matches_spec(self, minimal_cfg, seed):
+        """Device churn (eligibility/ejection/dequeue) must be bit-identical
+        to the spec's sequential process_registry_updates loop."""
+        from pos_evolution_tpu.ops.epoch import (densify, densify_eligibility,
+                                                 registry_churn_dense)
+        from pos_evolution_tpu.specs.epoch import process_registry_updates
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.containers import Checkpoint
+
+        rng = np.random.default_rng(seed)
+        n = 96
+        state, _ = make_genesis(n)
+        c = minimal_cfg
+        reg = state.validators
+        # ejectable validators (low effective balance)
+        reg.effective_balance[rng.random(n) < 0.15] = c.ejection_balance
+        # fresh deposits waiting for eligibility marking
+        fresh = rng.random(n) < 0.1
+        reg.activation_eligibility_epoch[fresh] = 2**64 - 1
+        reg.activation_epoch[fresh] = 2**64 - 1
+        # a queue of validators already eligible, awaiting activation
+        queued = rng.random(n) < 0.2
+        reg.activation_eligibility_epoch[queued] = rng.integers(1, 4, queued.sum())
+        reg.activation_epoch[queued] = 2**64 - 1
+        # some validators already exiting (occupying the exit queue)
+        exiting = rng.random(n) < 0.1
+        reg.exit_epoch[exiting] = rng.integers(12, 15, exiting.sum())
+        state.slot = 10 * c.slots_per_epoch - 1
+        state.finalized_checkpoint = Checkpoint(epoch=5, root=b"\x05" * 32)
+
+        dense = densify(state)
+        elig = densify_eligibility(state)
+        out = registry_churn_dense(dense, elig, 9, 5, c)
+        process_registry_updates(state)
+
+        def far_to_sentinel(a):
+            a = a.astype(np.uint64)
+            return np.where(a == np.uint64(2**64 - 1), np.uint64(2**62),
+                            a).astype(np.int64)
+
+        for field, col in (("activation_eligibility_epoch", out.activation_eligibility_epoch),
+                           ("activation_epoch", out.activation_epoch),
+                           ("exit_epoch", out.exit_epoch),
+                           ("withdrawable_epoch", out.withdrawable_epoch)):
+            want = far_to_sentinel(getattr(state.validators, field))
+            got = np.asarray(col)
+            assert np.array_equal(got, want), \
+                f"{field} diverges (seed {seed}): {got[:12]} vs {want[:12]}"
+
     def test_justification_thresholds(self, minimal_cfg):
         """2/3 boundary must behave identically at the exact threshold."""
         from pos_evolution_tpu.ops.epoch import densify, process_epoch_dense
