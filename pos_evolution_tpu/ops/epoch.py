@@ -75,6 +75,12 @@ def _epochs_to_i64(a: np.ndarray) -> jax.Array:
     return jnp.asarray(out.astype(np.int64))
 
 
+def i64_to_epochs(col) -> np.ndarray:
+    """Inverse of ``_epochs_to_i64``: sentinel back to FAR_FUTURE uint64."""
+    a = np.array(col).astype(np.uint64)
+    return np.where(a == np.uint64(FAR_FUTURE_I64), np.uint64(2**64 - 1), a)
+
+
 def densify(state) -> DenseRegistry:
     """Extract the dense arrays from a spec-level BeaconState (host)."""
     reg = state.validators

@@ -106,7 +106,26 @@ def _process_epoch_accelerated(state: BeaconState) -> None:
     state.inactivity_scores = np.array(reg.inactivity_scores).astype(np.uint64)
     new_eff = np.array(reg.effective_balance).astype(np.uint64)
 
-    process_registry_updates(state)  # reads pre-hysteresis effective balances
+    # Registry churn on device too (reads pre-hysteresis effective balances
+    # and the *post-sweep* finalized checkpoint, matching the spec order).
+    # ``out.registry`` already holds the staged device columns the churn
+    # kernel needs (epoch columns unchanged by the sweep; effective balances
+    # pre-hysteresis in ``reg`` is the *new* one, so pass the pre-sweep
+    # registry still on device from the sweep input) — reuse the sweep's
+    # input arrays instead of re-densifying the whole registry.
+    from pos_evolution_tpu.ops.epoch import (
+        densify_eligibility, i64_to_epochs, registry_churn_dense,
+    )
+    pre_sweep = get_backend().last_dense_registry(state)
+    churn = registry_churn_dense(
+        pre_sweep, densify_eligibility(state), current_epoch,
+        int(state.finalized_checkpoint.epoch), cfg())
+
+    v = state.validators
+    v.activation_eligibility_epoch = i64_to_epochs(churn.activation_eligibility_epoch)
+    v.activation_epoch = i64_to_epochs(churn.activation_epoch)
+    v.exit_epoch = i64_to_epochs(churn.exit_epoch)
+    v.withdrawable_epoch = i64_to_epochs(churn.withdrawable_epoch)
     process_eth1_data_reset(state)
     state.validators.effective_balance = new_eff
     state.previous_epoch_participation = np.array(reg.prev_flags)

@@ -57,6 +57,46 @@ class TestBackendParity:
         p_jax = np.asarray(get_shuffled_permutation(seed, 500))
         assert np.array_equal(p_np, p_jax)
 
+    def test_churn_state_identical_across_backends(self):
+        """Full process_epoch on a state with ejections, fresh deposits, a
+        waiting activation queue, and an occupied exit queue must be
+        bit-identical under both backends."""
+        from pos_evolution_tpu.specs.containers import Checkpoint
+        from pos_evolution_tpu.specs.epoch import process_epoch
+
+        def churny_state():
+            rng = np.random.default_rng(11)
+            state, _ = make_genesis(96)
+            c = minimal_config()
+            reg = state.validators
+            reg.effective_balance[rng.random(96) < 0.15] = c.ejection_balance
+            fresh = rng.random(96) < 0.1
+            reg.activation_eligibility_epoch[fresh] = 2**64 - 1
+            reg.activation_epoch[fresh] = 2**64 - 1
+            queued = rng.random(96) < 0.2
+            reg.activation_eligibility_epoch[queued] = rng.integers(1, 4, queued.sum())
+            reg.activation_epoch[queued] = 2**64 - 1
+            exiting = rng.random(96) < 0.1
+            reg.exit_epoch[exiting] = rng.integers(12, 15, exiting.sum())
+            state.slot = 10 * c.slots_per_epoch - 1
+            state.finalized_checkpoint = Checkpoint(epoch=5, root=b"\x05" * 32)
+            state.block_roots = rng.integers(
+                0, 255, state.block_roots.shape).astype(np.uint8)
+            return state
+
+        from pos_evolution_tpu.config import minimal_config
+        set_backend("numpy")
+        s_np = churny_state()
+        process_epoch(s_np)
+        set_backend("jax")
+        s_jax = churny_state()
+        process_epoch(s_jax)
+        for col in ("activation_eligibility_epoch", "activation_epoch",
+                    "exit_epoch", "withdrawable_epoch", "effective_balance"):
+            assert np.array_equal(getattr(s_np.validators, col),
+                                  getattr(s_jax.validators, col)), col
+        assert hash_tree_root(s_np) == hash_tree_root(s_jax)
+
     def test_accelerated_epoch_flag(self):
         import pos_evolution_tpu.backend.jax_backend as jb
         import pos_evolution_tpu.backend.numpy_backend as nb
