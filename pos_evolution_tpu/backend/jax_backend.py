@@ -44,25 +44,21 @@ def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
     return w
 
 
-# Most recent pre-sweep dense registry, reused by the churn kernel in the
-# same epoch boundary (saves a second full host->device densify).
-_LAST_DENSE = None
-
-
-def epoch_sweep(state, cfg):
+def epoch_sweep(state, cfg, dense=None):
     """Run the fused device epoch sweep for a spec-level BeaconState.
 
-    Returns the EpochResult; the caller (specs/epoch.py) performs the exact
-    host write-back and the O(changes) bookkeeping.
+    ``dense`` lets the caller stage the registry once and reuse it for the
+    churn kernel in the same boundary. Returns the EpochResult; the caller
+    (specs/epoch.py) performs the exact host write-back and the O(changes)
+    bookkeeping.
     """
-    global _LAST_DENSE
     import jax.numpy as jnp
 
     from pos_evolution_tpu.ops.epoch import densify, process_epoch_dense
     from pos_evolution_tpu.specs.helpers import get_current_epoch
 
-    dense = densify(state)
-    _LAST_DENSE = dense
+    if dense is None:
+        dense = densify(state)
     return process_epoch_dense(
         dense,
         get_current_epoch(state),
@@ -74,13 +70,3 @@ def epoch_sweep(state, cfg):
         cfg,
     )
 
-
-def last_dense_registry(state):
-    """The registry staged for the most recent epoch_sweep call (epoch
-    columns and pre-hysteresis effective balances — exactly what the churn
-    kernel reads); falls back to a fresh densify."""
-    if _LAST_DENSE is not None and int(_LAST_DENSE.balance.shape[0]) == len(
-            state.validators):
-        return _LAST_DENSE
-    from pos_evolution_tpu.ops.epoch import densify
-    return densify(state)

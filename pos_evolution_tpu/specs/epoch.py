@@ -78,7 +78,12 @@ def _process_epoch_accelerated(state: BeaconState) -> None:
     old_prev_justified = state.previous_justified_checkpoint
     old_cur_justified = state.current_justified_checkpoint
 
-    out = get_backend().epoch_sweep(state, cfg())
+    # Stage the registry on device once; both the sweep and the churn
+    # kernel read it (epoch columns and pre-hysteresis effective balances
+    # are unchanged between the two).
+    from pos_evolution_tpu.ops.epoch import densify
+    dense_pre = densify(state)
+    out = get_backend().epoch_sweep(state, cfg(), dense=dense_pre)
 
     # --- justification / finalization bookkeeping (roots live host-side) ---
     if current_epoch > GENESIS_EPOCH + 1:
@@ -108,17 +113,11 @@ def _process_epoch_accelerated(state: BeaconState) -> None:
 
     # Registry churn on device too (reads pre-hysteresis effective balances
     # and the *post-sweep* finalized checkpoint, matching the spec order).
-    # ``out.registry`` already holds the staged device columns the churn
-    # kernel needs (epoch columns unchanged by the sweep; effective balances
-    # pre-hysteresis in ``reg`` is the *new* one, so pass the pre-sweep
-    # registry still on device from the sweep input) — reuse the sweep's
-    # input arrays instead of re-densifying the whole registry.
     from pos_evolution_tpu.ops.epoch import (
         densify_eligibility, i64_to_epochs, registry_churn_dense,
     )
-    pre_sweep = get_backend().last_dense_registry(state)
     churn = registry_churn_dense(
-        pre_sweep, densify_eligibility(state), current_epoch,
+        dense_pre, densify_eligibility(state), current_epoch,
         int(state.finalized_checkpoint.epoch), cfg())
 
     v = state.validators

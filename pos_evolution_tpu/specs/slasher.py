@@ -1,0 +1,103 @@
+"""Slasher (watchtower): accountability made operational (SURVEY.md §2.5).
+
+The reference defines the violations — double votes and surround votes
+(pos-evolution.md:233-238, 1128-1143) and equivocating proposals
+(:1154-1156) — and notes "the evidence of the violation can be observed"
+(:238, 1148). This component does the observing: it ingests indexed
+attestations and signed block headers, maintains per-validator vote
+histories, and emits ready-to-include ``AttesterSlashing`` /
+``ProposerSlashing`` evidence, closing the accountable-safety loop
+(detected evidence -> ``process_attester_slashing`` /
+``on_attester_slashing`` -> stake slashed + fork-choice discounting).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+from pos_evolution_tpu.specs.containers import (
+    AttesterSlashing,
+    IndexedAttestation,
+    ProposerSlashing,
+    SignedBeaconBlockHeader,
+)
+from pos_evolution_tpu.specs.helpers import is_slashable_attestation_data
+
+
+class Slasher:
+    """Ingests consensus messages, emits slashing evidence."""
+
+    def __init__(self):
+        # validator -> list of IndexedAttestation they participated in
+        # (bucketed by target epoch for the double-vote check)
+        self._by_validator: dict[int, dict[int, list[IndexedAttestation]]] = \
+            defaultdict(lambda: defaultdict(list))
+        # full history per validator for the surround scan
+        self._spans: dict[int, list[tuple[int, int, IndexedAttestation]]] = \
+            defaultdict(list)
+        # (proposer, slot) -> first signed header seen
+        self._headers: dict[tuple[int, int], SignedBeaconBlockHeader] = {}
+        self._emitted: set = set()
+
+    # -- attestations ---------------------------------------------------------
+    def on_attestation(self, indexed: IndexedAttestation) -> list[AttesterSlashing]:
+        """Record an indexed attestation; return any new evidence."""
+        out: list[AttesterSlashing] = []
+        data = indexed.data
+        src, tgt = int(data.source.epoch), int(data.target.epoch)
+        data_root_new = self._root(data)
+
+        for v in (int(i) for i in np.asarray(indexed.attesting_indices)):
+            # double vote: same target epoch, different data
+            for prior in self._by_validator[v][tgt]:
+                if bytes(self._root(prior.data)) != data_root_new \
+                        and is_slashable_attestation_data(prior.data, data):
+                    out.extend(self._emit(prior, indexed))
+                    break
+            # surround in either direction
+            for (ps, pt, prior) in self._spans[v]:
+                if (ps < src and tgt < pt) or (src < ps and pt < tgt):
+                    out.extend(self._emit(prior, indexed))
+                    break
+            self._by_validator[v][tgt].append(indexed)
+            self._spans[v].append((src, tgt, indexed))
+        return out
+
+    @staticmethod
+    def _root(data) -> bytes:
+        from pos_evolution_tpu.ssz import hash_tree_root
+        return hash_tree_root(data)
+
+    def _emit(self, a1: IndexedAttestation,
+              a2: IndexedAttestation) -> list[AttesterSlashing]:
+        key = (self._root(a1.data), self._root(a2.data))
+        if key in self._emitted or (key[1], key[0]) in self._emitted:
+            return []
+        self._emitted.add(key)
+        # order so attestation_1 is the surrounding/earlier vote
+        if is_slashable_attestation_data(a1.data, a2.data):
+            return [AttesterSlashing(attestation_1=a1, attestation_2=a2)]
+        return [AttesterSlashing(attestation_1=a2, attestation_2=a1)]
+
+    # -- block headers --------------------------------------------------------
+    def on_block_header(self, signed: SignedBeaconBlockHeader) -> ProposerSlashing | None:
+        """Record a signed header; equivocating proposals yield evidence."""
+        h = signed.message
+        key = (int(h.proposer_index), int(h.slot))
+        prior = self._headers.get(key)
+        if prior is None:
+            self._headers[key] = signed
+            return None
+        if prior.message == h:
+            return None
+        ekey = ("hdr", key)
+        if ekey in self._emitted:
+            return None
+        self._emitted.add(ekey)
+        return ProposerSlashing(signed_header_1=prior, signed_header_2=signed)
+
+    # -- introspection --------------------------------------------------------
+    def tracked_validators(self) -> int:
+        return len(self._spans)
