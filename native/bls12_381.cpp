@@ -663,12 +663,35 @@ static Fp12 miller_loop(const G2 &q, const G1 &p) {
   return fp12_conj(f);  // t < 0
 }
 
+// p^2-Frobenius: basis element w^k (v = w^2) scales by omega^k with
+// omega = xi^((p^2-1)/6) in Fq2 (Fq2 itself is fixed by pi^2 since
+// (p^2-1)/2 is even). Precomputed powers omega^0..omega^5.
+static Fp2 OMEGA_POW[6];
+
+static void init_frob2() {
+  OMEGA_POW[0] = FP2_ONE;
+  Fp2 omega = fp2_pow_bytes(XI2, OMEGA_EXP, OMEGA_EXP_len);
+  for (int k = 1; k < 6; ++k) OMEGA_POW[k] = fp2_mul(OMEGA_POW[k - 1], omega);
+}
+
+static Fp12 fp12_frob2(const Fp12 &f) {
+  // coefficient of v^i w^j is w^(2i+j)
+  return {{f.a.a,
+           fp2_mul(f.a.b, OMEGA_POW[2]),
+           fp2_mul(f.a.c, OMEGA_POW[4])},
+          {fp2_mul(f.b.a, OMEGA_POW[1]),
+           fp2_mul(f.b.b, OMEGA_POW[3]),
+           fp2_mul(f.b.c, OMEGA_POW[5])}};
+}
+
 static Fp12 final_exponentiation(const Fp12 &f) {
-  // easy part: f^(p^6 - 1) = conj(f) * f^-1 (one inversion); the remaining
-  // exponent (p^6 + 1)/r is exact since r | p^4 - p^2 + 1 | p^6 + 1 —
-  // halving the pow length vs the monolithic (p^12-1)/r exponent.
+  // easy part: f^(p^6 - 1) = conj(f) * f^-1 (one inversion). The remaining
+  // (p^6 + 1)/r = (p^2 + 1) * (p^4 - p^2 + 1)/r: pow by the ~1268-bit
+  // quotient, then apply (p^2 + 1) as one Frobenius + one multiply —
+  // ~1.6x fewer Fp12 ops than the direct ~2027-bit exponent.
   Fp12 g = fp12_mul(fp12_conj(f), fp12_inv(f));
-  return fp12_pow_bytes(g, HARD_EXP, HARD_EXP_len);
+  Fp12 h = fp12_pow_bytes(g, HARDER_EXP, HARDER_EXP_len);
+  return fp12_mul(fp12_frob2(h), h);
 }
 
 static bool pairings_equal_2(const G1 &p1, const G2 &q1, const G1 &p2,
@@ -896,6 +919,7 @@ static void bls_init() {
   W2_INV = fp12_inv(w2);
   W3_INV = fp12_inv(w3);
 
+  init_frob2();
   G1_GENERATOR = {fp_from_bytes_be(G1X_BYTES, G1X_BYTES_len),
                   fp_from_bytes_be(G1Y_BYTES, G1Y_BYTES_len), false};
   G2_GENERATOR = {{fp_from_bytes_be(G2X0_BYTES, G2X0_BYTES_len),

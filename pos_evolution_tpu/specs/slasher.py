@@ -30,39 +30,50 @@ class Slasher:
     """Ingests consensus messages, emits slashing evidence."""
 
     def __init__(self):
-        # validator -> list of IndexedAttestation they participated in
-        # (bucketed by target epoch for the double-vote check)
-        self._by_validator: dict[int, dict[int, list[IndexedAttestation]]] = \
+        # validator -> target epoch -> [(data_root, IndexedAttestation)]
+        self._by_validator: dict[int, dict[int, list[tuple[bytes, IndexedAttestation]]]] = \
             defaultdict(lambda: defaultdict(list))
         # full history per validator for the surround scan
-        self._spans: dict[int, list[tuple[int, int, IndexedAttestation]]] = \
+        self._spans: dict[int, list[tuple[int, int, bytes, IndexedAttestation]]] = \
             defaultdict(list)
+        # (data_root, validator) pairs already ingested (replay dedup)
+        self._seen: set[tuple[bytes, int]] = set()
         # (proposer, slot) -> first signed header seen
         self._headers: dict[tuple[int, int], SignedBeaconBlockHeader] = {}
         self._emitted: set = set()
 
     # -- attestations ---------------------------------------------------------
     def on_attestation(self, indexed: IndexedAttestation) -> list[AttesterSlashing]:
-        """Record an indexed attestation; return any new evidence."""
+        """Record an indexed attestation; return any new evidence.
+
+        Data roots are hashed once per ingest and cached with the history;
+        replayed (data, validator) pairs are skipped outright.
+        """
         out: list[AttesterSlashing] = []
+        call_pairs: set = set()
         data = indexed.data
         src, tgt = int(data.source.epoch), int(data.target.epoch)
         data_root_new = self._root(data)
 
         for v in (int(i) for i in np.asarray(indexed.attesting_indices)):
+            if (data_root_new, v) in self._seen:
+                continue
+            self._seen.add((data_root_new, v))
             # double vote: same target epoch, different data
-            for prior in self._by_validator[v][tgt]:
-                if bytes(self._root(prior.data)) != data_root_new \
+            for prior_root, prior in self._by_validator[v][tgt]:
+                if prior_root != data_root_new \
                         and is_slashable_attestation_data(prior.data, data):
-                    out.extend(self._emit(prior, indexed))
+                    out.extend(self._emit(v, prior_root, prior,
+                                          data_root_new, indexed, call_pairs))
                     break
             # surround in either direction
-            for (ps, pt, prior) in self._spans[v]:
+            for (ps, pt, prior_root, prior) in self._spans[v]:
                 if (ps < src and tgt < pt) or (src < ps and pt < tgt):
-                    out.extend(self._emit(prior, indexed))
+                    out.extend(self._emit(v, prior_root, prior,
+                                          data_root_new, indexed, call_pairs))
                     break
-            self._by_validator[v][tgt].append(indexed)
-            self._spans[v].append((src, tgt, indexed))
+            self._by_validator[v][tgt].append((data_root_new, indexed))
+            self._spans[v].append((src, tgt, data_root_new, indexed))
         return out
 
     @staticmethod
@@ -70,12 +81,21 @@ class Slasher:
         from pos_evolution_tpu.ssz import hash_tree_root
         return hash_tree_root(data)
 
-    def _emit(self, a1: IndexedAttestation,
-              a2: IndexedAttestation) -> list[AttesterSlashing]:
-        key = (self._root(a1.data), self._root(a2.data))
-        if key in self._emitted or (key[1], key[0]) in self._emitted:
+    def _emit(self, validator: int, root1: bytes, a1: IndexedAttestation,
+              root2: bytes, a2: IndexedAttestation,
+              call_pairs: set) -> list[AttesterSlashing]:
+        # Keyed per implicated validator, so a *later* equivocator covered
+        # by an already-reported data pair still yields evidence — but one
+        # ingest emits each (pair) at most once (its intersection already
+        # covers every implicated validator in the message).
+        key = (validator,) + tuple(sorted((root1, root2)))
+        if key in self._emitted:
             return []
         self._emitted.add(key)
+        pair = tuple(sorted((root1, root2)))
+        if pair in call_pairs:
+            return []
+        call_pairs.add(pair)
         # order so attestation_1 is the surrounding/earlier vote
         if is_slashable_attestation_data(a1.data, a2.data):
             return [AttesterSlashing(attestation_1=a1, attestation_2=a2)]

@@ -30,7 +30,7 @@ def _indexed(validators, source, target, tag=0):
         data=AttestationData(
             slot=target * 8, index=0, beacon_block_root=bytes([tag]) * 32,
             source=Checkpoint(epoch=source, root=bytes([source]) * 32),
-            target=Checkpoint(epoch=target, root=bytes([target, tag]) * 32)),
+            target=Checkpoint(epoch=target, root=bytes([(target * 7 + tag) % 256]) * 32)),
         signature=b"\x00" * 96)
 
 
@@ -59,6 +59,20 @@ class TestAttesterDetection:
         from pos_evolution_tpu.specs.helpers import is_slashable_attestation_data
         assert is_slashable_attestation_data(ev2[0].attestation_1.data,
                                              ev2[0].attestation_2.data)
+
+    def test_late_equivocator_same_pair_still_reported(self):
+        """A validator whose equivocation is covered by a data pair that
+        already produced evidence (for someone else) must still be
+        reported when their aggregate arrives later."""
+        s = Slasher()
+        s.on_attestation(_indexed([1, 2], 2, 5, tag=0))
+        ev1 = s.on_attestation(_indexed([1], 2, 5, tag=7))      # implicates 1
+        assert len(ev1) == 1
+        ev2 = s.on_attestation(_indexed([2], 2, 5, tag=7))      # now 2 too
+        assert len(ev2) == 1
+        common = set(int(i) for i in np.asarray(ev2[0].attestation_1.attesting_indices)) \
+            & set(int(i) for i in np.asarray(ev2[0].attestation_2.attesting_indices))
+        assert 2 in common
 
     def test_benign_history_no_evidence(self):
         s = Slasher()

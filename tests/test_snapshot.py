@@ -153,6 +153,22 @@ class TestObservability:
         assert 0 <= rec["participation"] <= 1
         assert rec["n_latest_messages"] > 0
 
+    def test_invariant_checker_catches_violations(self):
+        """Negative path: a handler that mutates before failing must be
+        reported (the pos-evolution.md:1041 contract enforcement works)."""
+        state, anchor = make_genesis(16)
+        store = fc.get_forkchoice_store(state, anchor)
+        checker = StoreInvariantChecker(store)
+
+        def bad_handler(store_arg):
+            store_arg.equivocating_indices.add(99)  # mutate...
+            raise AssertionError("then fail")
+
+        with pytest.raises(AssertionError):
+            checker.call(bad_handler)
+        assert len(checker.violations) == 1
+        assert "mutated the store" in checker.violations[0]
+
     def test_invariant_checker_passes_on_honest_handlers(self):
         state, anchor = make_genesis(16)
         store = fc.get_forkchoice_store(state, anchor)
