@@ -18,8 +18,6 @@ pipeline. The BLS12-381 pairing kernel (N1) drops into the same interface.
 
 from __future__ import annotations
 
-from functools import partial
-
 import numpy as np
 
 import jax

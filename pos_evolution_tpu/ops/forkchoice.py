@@ -159,7 +159,6 @@ def build_dense_store(store, capacity: int | None = None):
 
     Returns (dense, roots) where roots[i] is the block root at index i.
     """
-    from pos_evolution_tpu.config import GENESIS_EPOCH, cfg
     from pos_evolution_tpu.specs.forkchoice import (
         _leaf_is_viable, get_current_slot, get_proposer_boost,
     )

@@ -22,7 +22,7 @@ from pos_evolution_tpu.ssz.core import (
     Bitlist, Bitvector, ByteList, ByteVector, Bytes4, Bytes20, Bytes32, Bytes48,
     Bytes96, Container, List, Sedes, Vector, _UInt, boolean, uint8, uint64,
 )
-from pos_evolution_tpu.ssz.hash import sha256_batch, sha256_pairs
+from pos_evolution_tpu.ssz.hash import sha256_pairs
 from pos_evolution_tpu.ssz.merkle import merkleize_chunks, mix_in_length
 
 uint256 = _UInt(32)

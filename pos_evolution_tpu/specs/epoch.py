@@ -37,8 +37,6 @@ from pos_evolution_tpu.specs.helpers import (
     initiate_validator_exit,
     is_in_inactivity_leak,
 )
-from pos_evolution_tpu.ssz import hash_tree_root
-from pos_evolution_tpu.ssz.core import Container
 from pos_evolution_tpu.ssz.merkle import merkleize_chunks
 
 

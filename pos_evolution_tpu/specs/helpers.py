@@ -21,7 +21,6 @@ from pos_evolution_tpu.config import (
     DOMAIN_SYNC_COMMITTEE,
     FAR_FUTURE_EPOCH,
     GENESIS_EPOCH,
-    PARTICIPATION_FLAG_WEIGHTS,
     PROPOSER_WEIGHT,
     TIMELY_HEAD_FLAG_INDEX,
     TIMELY_SOURCE_FLAG_INDEX,
@@ -34,7 +33,6 @@ from pos_evolution_tpu.specs.containers import (
     Attestation,
     AttestationData,
     BeaconState,
-    Checkpoint,
     DepositData,
     IndexedAttestation,
     Validator,

@@ -85,14 +85,17 @@ class Slasher:
               root2: bytes, a2: IndexedAttestation,
               call_pairs: set) -> list[AttesterSlashing]:
         # Keyed per implicated validator, so a *later* equivocator covered
-        # by an already-reported data pair still yields evidence — but one
-        # ingest emits each (pair) at most once (its intersection already
-        # covers every implicated validator in the message).
+        # by an already-reported data pair still yields evidence. Within one
+        # ingest, the *exact aggregate pair* is emitted at most once: a
+        # suppressed validator is then necessarily in the emitted pair's
+        # intersection (it sits in both aggregates), so no evidence is lost
+        # — aggregates that merely share a data root get their own emission.
         key = (validator,) + tuple(sorted((root1, root2)))
         if key in self._emitted:
             return []
         self._emitted.add(key)
-        pair = tuple(sorted((root1, root2)))
+        from pos_evolution_tpu.ssz import hash_tree_root
+        pair = tuple(sorted((hash_tree_root(a1), hash_tree_root(a2))))
         if pair in call_pairs:
             return []
         call_pairs.add(pair)

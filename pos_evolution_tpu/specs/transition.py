@@ -364,7 +364,6 @@ def process_execution_payload(state: BeaconState, body) -> None:
     payload header; consensus-only simulation performs no EL validation."""
     payload = body.execution_payload
     from pos_evolution_tpu.specs.containers import ExecutionPayloadHeader
-    from pos_evolution_tpu.ssz.core import List as SSZList, ByteList
     tx_sedes = type(payload)._fields["transactions"]
     state.latest_execution_payload_header = ExecutionPayloadHeader(
         parent_hash=payload.parent_hash,

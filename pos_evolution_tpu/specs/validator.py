@@ -33,7 +33,6 @@ from pos_evolution_tpu.specs.helpers import (
     compute_start_slot_at_epoch,
     get_beacon_committee,
     get_beacon_proposer_index,
-    get_block_root,
     get_block_root_at_slot,
     get_committee_count_per_slot,
     get_current_epoch,

@@ -1,0 +1,64 @@
+"""Config generality: the protocol machinery must not hard-code either
+preset — chains run and finalize under varied slot/committee/shuffle
+parameters (the reference's constants are knobs, SURVEY.md §5 config).
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config, use_config
+from pos_evolution_tpu.ssz import hash_tree_root
+
+
+VARIANTS = {
+    "wide-slots": dict(slots_per_epoch=4, target_committee_size=8,
+                       max_committees_per_slot=2),
+    "many-rounds": dict(shuffle_round_count=30),
+    "small-history": dict(slots_per_historical_root=32,
+                          epochs_per_historical_vector=32,
+                          epochs_per_slashings_vector=32),
+    "odd-boost": dict(proposer_score_boost_percent=33,
+                      safe_slots_to_update_justified=1),
+}
+
+
+@pytest.mark.parametrize("name", sorted(VARIANTS))
+def test_chain_finalizes_under_config_variant(name):
+    cfg = minimal_config().replace(name=name, **VARIANTS[name])
+    with use_config(cfg):
+        from pos_evolution_tpu.sim import Simulation
+        sim = Simulation(48)
+        sim.run_epochs(5)
+        m = sim.metrics[-1]
+        assert m["head_slot"] == 5 * cfg.slots_per_epoch
+        assert m["justified_epoch"] >= 3, (name, m)
+        assert m["finalized_epoch"] >= 2, (name, m)
+
+
+@pytest.mark.parametrize("name", sorted(VARIANTS))
+def test_backends_agree_under_config_variant(name):
+    jax = pytest.importorskip("jax")
+    cfg = minimal_config().replace(name=name, **VARIANTS[name])
+    with use_config(cfg):
+        from pos_evolution_tpu.backend import set_backend
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.transition import state_transition
+        from pos_evolution_tpu.specs.validator import (
+            attest_all_committees, build_block,
+        )
+
+        def run(backend):
+            set_backend(backend)
+            try:
+                state, _ = make_genesis(48)
+                atts = []
+                for slot in range(1, 3 * cfg.slots_per_epoch + 1):
+                    sb = build_block(state, slot, attestations=atts)
+                    state_transition(state, sb, True)
+                    atts = attest_all_committees(
+                        state, slot, hash_tree_root(sb.message))
+                return hash_tree_root(state)
+            finally:
+                set_backend("numpy")
+
+        assert run("numpy") == run("jax"), name

@@ -74,6 +74,21 @@ class TestAttesterDetection:
             & set(int(i) for i in np.asarray(ev2[0].attestation_2.attesting_indices))
         assert 2 in common
 
+    def test_distinct_aggregates_same_data_all_covered(self):
+        """Priors that are different aggregates of the SAME data must each
+        produce evidence covering their validator (regression for the
+        aggregate-pair dedup)."""
+        s = Slasher()
+        s.on_attestation(_indexed([1], 2, 5, tag=0))   # data X, agg {1}
+        s.on_attestation(_indexed([2], 2, 5, tag=0))   # data X, agg {2}
+        ev = s.on_attestation(_indexed([1, 2], 2, 5, tag=9))  # conflict Y
+        covered = set()
+        for e in ev:
+            covered |= (
+                set(int(i) for i in np.asarray(e.attestation_1.attesting_indices))
+                & set(int(i) for i in np.asarray(e.attestation_2.attesting_indices)))
+        assert covered == {1, 2}
+
     def test_benign_history_no_evidence(self):
         s = Slasher()
         for e in range(2, 8):
