@@ -42,9 +42,38 @@ def ssf():
           f"(finalized within the proposing slot)")
 
 
+def slasher_demo():
+    print("\n== 4. Slasher: equivocation -> evidence -> discounted stake ==")
+    from pos_evolution_tpu.specs import forkchoice as fc
+    from pos_evolution_tpu.specs.genesis import make_genesis
+    from pos_evolution_tpu.specs.helpers import get_indexed_attestation
+    from pos_evolution_tpu.specs.slasher import Slasher
+    from pos_evolution_tpu.specs.validator import (
+        build_block, make_committee_attestation,
+    )
+    from pos_evolution_tpu.ssz import hash_tree_root
+    state, anchor = make_genesis(64)
+    store = fc.get_forkchoice_store(state, anchor)
+    fc.on_tick(store, store.genesis_time + 24)
+    sb_a = build_block(state, 1, graffiti=b"\x0a" * 32)
+    sb_b = build_block(state, 1, graffiti=b"\x0b" * 32)
+    fc.on_block(store, sb_a)
+    fc.on_block(store, sb_b)
+    ra, rb = hash_tree_root(sb_a.message), hash_tree_root(sb_b.message)
+    a1 = make_committee_attestation(store.block_states[ra], 1, 0, ra)
+    a2 = make_committee_attestation(store.block_states[rb], 1, 0, rb)
+    watch = Slasher()
+    watch.on_attestation(get_indexed_attestation(store.block_states[ra], a1))
+    evidence = watch.on_attestation(
+        get_indexed_attestation(store.block_states[rb], a2))
+    fc.on_attester_slashing(store, evidence[0])
+    print(f"  committee equivocated across two blocks -> {len(evidence)} "
+          f"AttesterSlashing emitted -> {len(store.equivocating_indices)} "
+          f"validators discounted from fork choice")
+
+
 def array_level():
-    print("\n== 4. Array level: fused epoch sweep + dense fork choice ==")
-    import numpy as np
+    print("\n== 5. Array level: fused epoch sweep + dense fork choice ==")
     import jax
     from pos_evolution_tpu.backend import set_backend
     from pos_evolution_tpu.sim import Simulation
@@ -65,5 +94,6 @@ if __name__ == "__main__":
         honest_finality()
         balancing_attack()
         ssf()
+        slasher_demo()
         array_level()
     print("\nAll demos completed.")
