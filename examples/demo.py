@@ -1,5 +1,5 @@
 """Tour of the framework: honest finality, an attack, a variant, the
-TPU array level. Run: python examples/demo.py
+slasher, the TPU array level. Run: python examples/demo.py
 """
 
 import os

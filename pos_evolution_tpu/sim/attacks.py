@@ -232,6 +232,100 @@ def run_ex_ante_reorg_with_boost(n_validators: int = 800) -> dict:
     }
 
 
+# --- bouncing attack step (pos-evolution.md:1065-1072) ------------------------
+
+def run_bouncing_attack_step(n_validators: int = 64) -> dict:
+    """One full bounce step with real states, and the mitigation in action.
+
+    The bounce (pos-evolution.md:1067-1071): the store follows chain A with
+    justified checkpoint (2, A); the adversary releases a chain-B block
+    whose post-state carries a *higher, conflicting* justification (3, B).
+    Released mid-epoch this would flip every validator's fork choice; the
+    mitigation (:1054, :1072) defers the conflicting update to
+    ``best_justified_checkpoint`` when it arrives past
+    SAFE_SLOTS_TO_UPDATE_JUSTIFIED, promoting only at the epoch boundary
+    (:950-955).
+
+    Two forks diverge at genesis (identical committees — the RANDAO mixes
+    match, so seeds do too). Chain A withholds its epoch-2 target
+    attestations from blocks until slot 2C+0' and crosses the 3->4 boundary
+    to justify (2, A-EBB2); chain B does the same one epoch later to
+    justify (3, B-EBB3). Honest validators voted target epoch 2 on A and
+    target epoch 3 on B — different target epochs, NOT slashable, exactly
+    the chain-switching behavior the bounce exploits.
+    """
+    c = cfg()
+    spe = c.slots_per_epoch
+    state, anchor = make_genesis(n_validators)
+    store = fc.get_forkchoice_store(state, anchor)
+    everyone = np.arange(n_validators, dtype=np.int64)
+
+    def extend(parent_state, slot, atts=(), tag=0):
+        sb = build_block(parent_state, slot, attestations=list(atts),
+                         graffiti=bytes([tag]) * 32)
+        post = parent_state.copy()
+        state_transition(post, sb, True)
+        return sb, post
+
+    # --- chain A: justifies epoch 2 in its slot-4C block ---
+    a1, sa1 = extend(state, 1, tag=0xA1)
+    a16, sa16 = extend(sa1, 2 * spe, tag=0xA2)           # A's epoch-2 EBB
+    atts_a = []
+    for slot in range(2 * spe, 3 * spe):                  # epoch-2 votes
+        view = advance_state_to_slot(sa16, slot)
+        atts_a.extend(_committee_attestations(
+            view, slot, hash_tree_root(a16.message), participants=everyone))
+    a24, sa24 = extend(sa16, 3 * spe, atts=atts_a[: c.max_attestations], tag=0xA3)
+    a32, sa32 = extend(sa24, 4 * spe, tag=0xA4)           # crosses 3->4: justifies 2
+    assert int(sa32.current_justified_checkpoint.epoch) == 2
+
+    # --- chain B: justifies epoch 3 in its slot-5C block ---
+    b1, sb1 = extend(state, 1, tag=0xB1)
+    b24, sb24 = extend(sb1, 3 * spe, tag=0xB2)            # B's epoch-3 EBB
+    atts_b = []
+    for slot in range(3 * spe, 4 * spe):                  # epoch-3 votes
+        view = advance_state_to_slot(sb24, slot)
+        atts_b.extend(_committee_attestations(
+            view, slot, hash_tree_root(b24.message), participants=everyone))
+    b32, sb32 = extend(sb24, 4 * spe, atts=atts_b[: c.max_attestations], tag=0xB3)
+    b40, sb40 = extend(sb32, 5 * spe, tag=0xB4)           # crosses 4->5: justifies 3
+    assert int(sb40.current_justified_checkpoint.epoch) == 3
+
+    # Phase 1: chain A delivered early in epoch 4 -> store adopts (2, A).
+    early = 4 * spe + 1
+    assert early % spe < c.safe_slots_to_update_justified
+    _tick(store, early)
+    for sb in (a1, a16, a24, a32):
+        fc.on_block(store, sb)
+    justified_a = int(store.justified_checkpoint.epoch)
+    root_a = bytes(store.justified_checkpoint.root)
+
+    # Phase 2: chain B (with the conflicting higher justification) released
+    # LATE in epoch 5 -> mitigation defers it.
+    late = 5 * spe + c.safe_slots_to_update_justified + 1
+    _tick(store, late)
+    for sb in (b1, b24, b32, b40):
+        fc.on_block(store, sb)
+    deferred_justified = int(store.justified_checkpoint.epoch)
+    deferred_root = bytes(store.justified_checkpoint.root)
+    best = int(store.best_justified_checkpoint.epoch)
+
+    # Phase 3: the next epoch boundary promotes best_justified.
+    _tick(store, 6 * spe)
+    promoted = int(store.justified_checkpoint.epoch)
+    promoted_root = bytes(store.justified_checkpoint.root)
+
+    return {
+        "phase1_justified": justified_a,
+        "phase1_is_chain_a": root_a == hash_tree_root(a16.message),
+        "deferred_justified": deferred_justified,
+        "deferral_held": deferred_root == root_a and deferred_justified == justified_a,
+        "best_after_release": best,
+        "promoted_at_boundary": promoted,
+        "promoted_is_chain_b": promoted_root == hash_tree_root(b24.message),
+    }
+
+
 # --- LMD balancing despite proposer boost (pos-evolution.md:1379-1403) --------
 
 def run_lmd_balancing_attack(n_validators: int = 800) -> dict:

@@ -10,6 +10,7 @@ import pytest
 from pos_evolution_tpu.config import minimal_config, use_config
 from pos_evolution_tpu.sim.attacks import (
     run_balancing_attack,
+    run_bouncing_attack_step,
     run_ex_ante_reorg,
     run_ex_ante_reorg_with_boost,
     run_lmd_balancing_attack,
@@ -40,6 +41,20 @@ class TestExAnteReorg:
         assert r["per_slot_committee"] == 100
         assert r["b3_reorged"]
         assert r["b4_canonical"] and r["b2_canonical"]
+
+
+class TestBouncingAttack:
+    def test_conflicting_justification_deferred_then_promoted(self):
+        """pos-evolution.md:1065-1072: a conflicting higher justification
+        released past SAFE_SLOTS_TO_UPDATE_JUSTIFIED must NOT flip the
+        store's justified checkpoint mid-epoch (the bounce), only
+        best_justified; the epoch boundary promotes it (:950-955)."""
+        with use_config(minimal_config()):
+            r = run_bouncing_attack_step(64)
+        assert r["phase1_justified"] == 2 and r["phase1_is_chain_a"]
+        assert r["deferral_held"], "mid-epoch bounce was not prevented"
+        assert r["best_after_release"] == 3
+        assert r["promoted_at_boundary"] == 3 and r["promoted_is_chain_b"]
 
 
 class TestLMDBalancingDespiteBoost:
