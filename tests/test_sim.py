@@ -50,6 +50,22 @@ class TestAcceleratedForkChoice:
         ref.run_epochs(2)
         assert [m["head"] for m in fast.metrics] == [m["head"] for m in ref.metrics]
 
+    def test_fully_accelerated_driver_matches_numpy_driver(self):
+        """The whole driver under the jax backend (device epoch sweeps +
+        device churn + device fork choice) reproduces the numpy run."""
+        pytest.importorskip("jax")
+        from pos_evolution_tpu.backend import set_backend
+        ref = Simulation(64)
+        ref.run_epochs(3)
+        set_backend("jax")
+        try:
+            fast = Simulation(64, accelerated_forkchoice=True)
+            fast.run_epochs(3)
+        finally:
+            set_backend("numpy")
+        assert [m["head"] for m in fast.metrics] == [m["head"] for m in ref.metrics]
+        assert fast.metrics[-1]["finalized_epoch"] == ref.metrics[-1]["finalized_epoch"]
+
 
 class TestSleepyValidators:
     def test_minority_asleep_still_finalizes(self):
