@@ -118,6 +118,42 @@ def ssf_supermajority_tally(mesh: Mesh):
     return tally
 
 
+def ring_allreduce_tally(mesh: Mesh):
+    """Epoch tally via an explicit ``ppermute`` ring instead of ``psum``.
+
+    The ring form of the validator-shard reduction (the ring-collective
+    analogue this framework has instead of ring attention, SURVEY.md §5):
+    each step every shard passes its partial sum to its ICI ring neighbor
+    and accumulates, completing the allreduce in |shard|-1 hops; the pod
+    axis then folds with one DCN psum. Numerically identical to the fused
+    ``psum`` path (int64 addition is associative/commutative) — XLA's psum
+    is normally the right choice; this exists to exercise and document the
+    explicit-ring pattern.
+    """
+    both = (POD_AXIS, SHARD_AXIS)
+    vspec = P(both)
+
+    # check_rep off: the ring leaves every shard holding the same total,
+    # but that replication is not statically inferable from ppermute.
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(vspec, vspec), out_specs=P(),
+             check_rep=False)
+    def tally(mask, values):
+        local = jnp.sum(jnp.where(mask, values, 0))
+        n_shard = mesh.shape[SHARD_AXIS]
+        perm = [(i, (i + 1) % n_shard) for i in range(n_shard)]
+
+        def hop(_, carry):
+            acc, moving = carry
+            moving = jax.lax.ppermute(moving, SHARD_AXIS, perm)
+            return acc + moving, moving
+
+        acc, _ = jax.lax.fori_loop(0, n_shard - 1, hop, (local, local))
+        return jax.lax.psum(acc, POD_AXIS)  # fold pods over DCN
+
+    return tally
+
+
 def gossip_all_gather(mesh: Mesh):
     """Simulated gossip round (pos-evolution.md:187-189): every shard's
     message vector is gathered everywhere (the broadcast primitive), then

@@ -83,6 +83,27 @@ class TestSSFTally:
         assert not bool(ok)
 
 
+class TestRingAllreduce:
+    def test_ring_matches_psum(self, mesh):
+        """The explicit ppermute ring must equal the fused psum tally."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.parallel.sharded import (
+            ring_allreduce_tally, ssf_supermajority_tally,
+        )
+        n = 128
+        gwei = 10**9
+        rng = np.random.default_rng(5)
+        eff = jnp.asarray(rng.integers(16, 33, n).astype(np.int64) * gwei)
+        votes = jnp.asarray(rng.random(n) < 0.6)
+        ring = ring_allreduce_tally(mesh)
+        psum_tally = ssf_supermajority_tally(mesh)
+        total = jnp.int64(int(np.asarray(eff).sum()))
+        s_ring = int(ring(votes, eff))
+        s_psum, _ = psum_tally(votes, eff, total)
+        assert s_ring == int(s_psum)
+        assert s_ring == int(np.asarray(eff)[np.asarray(votes)].sum())
+
+
 class TestGossip:
     def test_masked_all_gather(self, mesh):
         import jax.numpy as jnp
