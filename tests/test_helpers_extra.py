@@ -109,3 +109,40 @@ class TestChurn:
     def test_churn_floor(self):
         state, _ = make_genesis(8)
         assert get_validator_churn_limit(state) == cfg().min_per_epoch_churn_limit
+
+
+class TestMainnetCommitteeScale:
+    def test_reference_example_numbers(self):
+        """pos-evolution.md:472-475: at 262,144 active validators there are
+        64 committees per slot of 128 validators each."""
+        from pos_evolution_tpu.config import mainnet_config, use_config
+        with use_config(mainnet_config()):
+            from pos_evolution_tpu.specs.containers import ValidatorRegistry
+            from pos_evolution_tpu.specs.genesis import make_genesis as mg
+            from pos_evolution_tpu.specs.helpers import (
+                get_beacon_committee, get_committee_count_per_slot,
+            )
+            state, _ = mg(0)
+            n = 262_144
+            reg = ValidatorRegistry(n)
+            reg.effective_balance[:] = cfg().max_effective_balance
+            reg.activation_epoch[:] = 0
+            state.validators = reg
+            state.balances = np.full(n, cfg().max_effective_balance,
+                                     dtype=np.uint64)
+            assert get_committee_count_per_slot(state, 0) == 64
+            committee = get_beacon_committee(state, 0, 0)
+            assert committee.shape[0] == 128
+
+    def test_committees_partition_the_slot(self):
+        """All committees of one slot are disjoint (pos-evolution.md:455)."""
+        state, _ = make_genesis(64)
+        from pos_evolution_tpu.specs.helpers import (
+            get_beacon_committee, get_committee_count_per_slot,
+        )
+        count = get_committee_count_per_slot(state, 0)
+        seen = set()
+        for i in range(count):
+            members = set(int(v) for v in get_beacon_committee(state, 2, i))
+            assert not (members & seen)
+            seen |= members
