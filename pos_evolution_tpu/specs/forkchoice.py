@@ -177,30 +177,38 @@ def _leaf_is_viable(store: Store, root: bytes) -> bool:
 
 def get_filtered_block_tree(store: Store) -> dict:
     """Subtree rooted at the justified checkpoint, pruned to branches whose
-    leaves carry the store's justified/finalized view."""
+    leaves carry the store's justified/finalized view.
+
+    Iterative post-order traversal: long-running simulations grow chains
+    past Python's recursion limit (~1000 frames), so no recursion here.
+    """
     base = bytes(store.justified_checkpoint.root)
     children: dict[bytes, list[bytes]] = {}
     for root, block in store.blocks.items():
         children.setdefault(bytes(block.parent_root), []).append(root)
 
     blocks: dict[bytes, BeaconBlock] = {}
-
-    def visit(root: bytes) -> bool:
+    keep: dict[bytes, bool] = {}
+    stack: list[tuple[bytes, bool]] = [(base, False)]
+    while stack:
+        root, expanded = stack.pop()
         kids = children.get(root, [])
-        if kids:
-            keep = False
-            for k in kids:
-                if visit(k):
-                    keep = True
-            if keep:
+        if not kids:
+            if _leaf_is_viable(store, root):
                 blocks[root] = store.blocks[root]
-            return keep
-        if _leaf_is_viable(store, root):
-            blocks[root] = store.blocks[root]
-            return True
-        return False
-
-    visit(base)
+                keep[root] = True
+            else:
+                keep[root] = False
+            continue
+        if not expanded:
+            stack.append((root, True))
+            for k in kids:
+                stack.append((k, False))
+        else:
+            kept = any(keep.get(k, False) for k in kids)
+            keep[root] = kept
+            if kept:
+                blocks[root] = store.blocks[root]
     return blocks
 
 

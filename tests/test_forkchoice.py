@@ -235,6 +235,31 @@ class TestEquivocationDiscounting:
         assert 5 not in store.latest_messages
 
 
+class TestDeepChains:
+    def test_filtered_tree_beyond_recursion_limit(self):
+        """get_filtered_block_tree must survive chains far longer than
+        Python's recursion limit (long-running simulations)."""
+        from pos_evolution_tpu.specs.containers import BeaconBlock
+        state, anchor = make_genesis(16)
+        store = fc.get_forkchoice_store(state, anchor)
+        anchor_root = hash_tree_root(anchor)
+        # synthetic 5000-block chain: headers only; leaf viability needs a
+        # state just for the tip
+        parent = anchor_root
+        leaf_state = store.block_states[anchor_root]
+        for slot in range(1, 5001):
+            blk = BeaconBlock(slot=slot, proposer_index=0, parent_root=parent,
+                              state_root=bytes(8) + slot.to_bytes(8, "little") + bytes(16))
+            root = hash_tree_root(blk)
+            store.blocks[root] = blk
+            parent = root
+        store.block_states[parent] = leaf_state
+        tree = fc.get_filtered_block_tree(store)
+        assert len(tree) == 5001
+        head = fc.get_head(store)
+        assert int(store.blocks[head].slot) == 5000
+
+
 class TestPruning:
     def test_prune_keeps_canonical_chain(self):
         from pos_evolution_tpu.sim import Simulation
