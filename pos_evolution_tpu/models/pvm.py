@@ -182,18 +182,21 @@ def ghost_head(view: View, slot: int, expiry: int | None,
 def vanilla_ghost_head(view: View) -> bytes:
     """Pre-LMD GHOST: subtree weight = number of blocks, equivocations NOT
     discounted — the rule the avalanche attack defeats
-    (pos-evolution.md:1469-1473)."""
-    children = view.children()
+    (pos-evolution.md:1469-1473). Iterative (no recursion-depth limit)."""
+    from pos_evolution_tpu.utils.traversal import postorder
 
-    def subtree_size(root: bytes) -> int:
-        return 1 + sum(subtree_size(c) for c in children.get(root, []))
+    children = view.children()
+    # all subtree sizes in one post-order pass
+    size: dict[bytes, int] = {}
+    for root in postorder(children, GENESIS_ROOT):
+        size[root] = 1 + sum(size[c] for c in children.get(root, ()))
 
     head = GENESIS_ROOT
     while True:
         kids = children.get(head, [])
         if not kids:
             return head
-        head = max(kids, key=lambda r: (subtree_size(r), r))
+        head = max(kids, key=lambda r: (size[r], r))
 
 
 def vrf_output(validator: int, slot: int) -> bytes:

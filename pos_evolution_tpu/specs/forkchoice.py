@@ -187,28 +187,18 @@ def get_filtered_block_tree(store: Store) -> dict:
     for root, block in store.blocks.items():
         children.setdefault(bytes(block.parent_root), []).append(root)
 
+    from pos_evolution_tpu.utils.traversal import postorder
+
     blocks: dict[bytes, BeaconBlock] = {}
     keep: dict[bytes, bool] = {}
-    stack: list[tuple[bytes, bool]] = [(base, False)]
-    while stack:
-        root, expanded = stack.pop()
+    for root in postorder(children, base):
         kids = children.get(root, [])
-        if not kids:
-            if _leaf_is_viable(store, root):
-                blocks[root] = store.blocks[root]
-                keep[root] = True
-            else:
-                keep[root] = False
-            continue
-        if not expanded:
-            stack.append((root, True))
-            for k in kids:
-                stack.append((k, False))
+        if kids:
+            keep[root] = any(keep[k] for k in kids)
         else:
-            kept = any(keep.get(k, False) for k in kids)
-            keep[root] = kept
-            if kept:
-                blocks[root] = store.blocks[root]
+            keep[root] = _leaf_is_viable(store, root)
+        if keep[root]:
+            blocks[root] = store.blocks[root]
     return blocks
 
 
