@@ -95,19 +95,13 @@ class View:
         self.votes[key] = vote.block_root
 
     def merge(self, other: "View") -> None:
-        # blocks must go in parent-first; iterate until fixpoint
-        pending = list(other.blocks.values())
-        progress = True
-        while pending and progress:
-            progress = False
-            rest = []
-            for b in pending:
-                if b.parent in self.blocks or b.root == GENESIS_ROOT:
-                    self.add_block(b)
-                    progress = True
-                else:
-                    rest.append(b)
-            pending = rest
+        # parents always have strictly lower slots, so one slot-ordered pass
+        # inserts parent-first (no quadratic fixpoint iteration); the
+        # genesis marker is skipped (re-adding it would duplicate it under
+        # its computed hash root)
+        for root, b in sorted(other.blocks.items(), key=lambda kv: kv[1].slot):
+            if root != GENESIS_ROOT:
+                self.add_block(b)
         for (v, s), root in other.votes.items():
             self.add_vote(HeadVote(slot=s, block_root=root, validator=v))
         self.equivocators |= other.equivocators

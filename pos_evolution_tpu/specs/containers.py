@@ -310,9 +310,10 @@ class ValidatorRegistry:
 
     __slots__ = ("pubkeys", "withdrawal_credentials", "effective_balance", "slashed",
                  "activation_eligibility_epoch", "activation_epoch", "exit_epoch",
-                 "withdrawable_epoch")
+                 "withdrawable_epoch", "_pubkey_index")
 
     def __init__(self, n: int = 0):
+        self._pubkey_index = None
         self.pubkeys = np.zeros((n, 48), dtype=np.uint8)
         self.withdrawal_credentials = np.zeros((n, 32), dtype=np.uint8)
         self.effective_balance = np.zeros(n, dtype=np.uint64)
@@ -341,7 +342,15 @@ class ValidatorRegistry:
         for i in range(len(self)):
             yield self[i]
 
+    def set_pubkeys(self, pubkeys: np.ndarray) -> None:
+        """Bulk-write the pubkey column (invalidates the lookup index).
+        Use this (or set_validator) rather than writing ``.pubkeys`` rows
+        directly — direct writes would leave ``find_pubkey`` stale."""
+        self._pubkey_index = None
+        self.pubkeys[:] = pubkeys
+
     def set_validator(self, i: int, v: Validator) -> None:
+        self._pubkey_index = None
         self.pubkeys[i] = np.frombuffer(bytes(v.pubkey), dtype=np.uint8)
         self.withdrawal_credentials[i] = np.frombuffer(
             bytes(v.withdrawal_credentials), dtype=np.uint8)
@@ -364,14 +373,23 @@ class ValidatorRegistry:
         self.set_validator(n, v)
 
     def find_pubkey(self, pubkey: bytes) -> int | None:
-        """Index of ``pubkey`` in the registry, or None (pos-evolution.md:154-155)."""
-        pk = np.frombuffer(bytes(pubkey), dtype=np.uint8)
-        matches = np.nonzero((self.pubkeys == pk).all(axis=1))[0]
-        return int(matches[0]) if matches.size else None
+        """Index of ``pubkey`` in the registry, or None (pos-evolution.md:154-155).
+
+        Backed by a lazily built dict (invalidated on registry growth):
+        sync-aggregate processing does hundreds of lookups per block, and a
+        linear scan is O(n) each at mainnet registry sizes.
+        """
+        cache = getattr(self, "_pubkey_index", None)
+        if cache is None or len(cache) != len(self):
+            cache = {self.pubkeys[i].tobytes(): i for i in range(len(self))}
+            self._pubkey_index = cache
+        return cache.get(bytes(pubkey))
 
     def copy(self) -> "ValidatorRegistry":
         out = ValidatorRegistry(0)
         for f in self.__slots__:
+            if f == "_pubkey_index":
+                continue
             setattr(out, f, getattr(self, f).copy())
         return out
 

@@ -38,10 +38,12 @@ def make_genesis_state(n_validators: int, genesis_time: int = 0) -> BeaconState:
     """Build a genesis BeaconState with ``n_validators`` active at epoch 0."""
     c = cfg()
     reg = ValidatorRegistry(n_validators)
+    all_pks = np.zeros((n_validators, 48), dtype=np.uint8)
     for i in range(n_validators):
-        reg.pubkeys[i] = np.frombuffer(validator_pubkey(i), dtype=np.uint8)
-        wc = bytes([0x00]) + bytes(31)  # placeholder withdrawal credentials
-        reg.withdrawal_credentials[i] = np.frombuffer(wc, dtype=np.uint8)
+        all_pks[i] = np.frombuffer(validator_pubkey(i), dtype=np.uint8)
+    reg.set_pubkeys(all_pks)
+    wc = bytes([0x00]) + bytes(31)  # placeholder withdrawal credentials
+    reg.withdrawal_credentials[:] = np.frombuffer(wc, dtype=np.uint8)
     reg.effective_balance[:] = c.max_effective_balance
     reg.activation_eligibility_epoch[:] = 0
     reg.activation_epoch[:] = 0
