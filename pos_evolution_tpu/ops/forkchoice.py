@@ -7,14 +7,23 @@ depth). The array level computes ALL subtree weights in one pass
 
 1. per-validator latest messages -> per-block vote weight via
    ``segment_sum`` over the registry (equivocators/inactive masked out,
-   pos-evolution.md:1438);
-2. a boolean reachability matrix R (R[i,j] = j is i or an ancestor of i)
-   built by log2(B) boolean matrix squarings — MXU-friendly matmuls;
-3. subtree weights = R^T @ votes (+ proposer boost on the boosted block's
-   ancestor row, pos-evolution.md:916, 1355);
-4. viable-branch filtering (pos-evolution.md:874-880): keep blocks with a
-   viable leaf descendant, computed from the same R;
-5. greedy descent as a ``lax.while_loop`` with exact (weight,
+   pos-evolution.md:1438) — or, on the persistent/incremental path, a
+   resident per-block bucket table updated by scatter deltas as messages
+   arrive, so head queries never rescan the registry;
+2. subtree weights by **binary-lifting accumulation** over the parent-index
+   array: log2(B) rounds of ``segment_sum`` into 2^k-th-ancestor buckets.
+   Round k folds every node's partial subtree sum (descendants at depth
+   < 2^k) into its 2^k-th ancestor, then composes the ancestor pointers
+   (anc <- anc[anc]); after ceil(log2(B)) rounds each node holds its full
+   subtree sum. O(B log B) work, no B x B matrix — scales to capacity
+   1024+ where the round-1 reachability-matrix design was O(B^2) memory
+   and tripped XLA's algebraic-simplifier loop detector;
+3. proposer boost and the viable-branch filter (pos-evolution.md:874-880)
+   ride the same lifted pass as extra columns (one-hot of the boosted
+   block; viable-leaf indicators) — ancestors-or-self of the boost block
+   and blocks-with-viable-leaf-descendants drop out of the identical
+   recursion;
+4. greedy descent as a ``lax.while_loop`` with exact (weight,
    lexicographic-rank) tie-breaking (pos-evolution.md:1114-1116).
 
 The fixed-capacity layout (blocks padded to ``capacity``) keeps every shape
@@ -55,35 +64,79 @@ class DenseStore(NamedTuple):
     boost_amount: jax.Array    # int64 scalar
 
 
-def _reachability(parent, real, capacity: int):
-    """R[i, j] = block j is i or an ancestor of i (within real blocks).
+def _subtree_accumulate(parent, real, values, capacity: int):
+    """Per-node subtree sums over a parent-index forest by binary lifting.
 
-    Boolean matrix squaring as f32 matmuls: path counts per entry are
-    bounded by ``capacity`` (< 2^24), so f32 accumulation is exact and the
-    squarings run on the MXU (s64 dots are not TPU-lowerable).
+    ``values`` is ``[B]`` or ``[B, C]`` (any summable dtype). Returns the
+    same shape where entry j = Σ values[i] over every i in j's subtree
+    (including j). Round k folds each node's partial sum (its descendants
+    at depth < 2^k) into its 2^k-th ancestor via ``segment_sum``, then
+    squares the ancestor pointer (anc <- anc[anc]); ceil(log2(B)) rounds
+    cover the maximum possible depth. Padded/unreal slots point at a null
+    bucket and never contribute.
     """
-    eye = jnp.eye(capacity, dtype=bool)
-    has_parent = (parent >= 0) & real
-    p = jnp.where(has_parent, parent, 0)
-    step = jnp.zeros((capacity, capacity), dtype=bool)
-    step = step.at[jnp.arange(capacity), p].set(has_parent)
-    r = eye | step
+    null = capacity
+    anc = jnp.where((parent >= 0) & real, parent, null).astype(jnp.int32)
+    w = values
     hops = max(int(np.ceil(np.log2(max(capacity, 2)))), 1)
     for _ in range(hops):
-        rf = r.astype(jnp.float32)
-        r = jnp.dot(rf, rf, preferred_element_type=jnp.float32) > 0.5
-    return r
+        w = w + jax.ops.segment_sum(w, anc, num_segments=capacity + 1)[:capacity]
+        anc_ext = jnp.concatenate([anc, jnp.full((1,), null, jnp.int32)])
+        anc = anc_ext[anc]
+    return w
 
 
-def _exact_matvec_i64(r_bool, values_i64, capacity: int):
-    """Exact Σ_i R[i,j] * v[i] for int64 increment counts via hi/lo-split
-    f32 matmuls (both halves stay < 2^24 per output, so f32 is exact)."""
-    lo = (values_i64 & np.int64(0xFFF)).astype(jnp.float32)
-    hi = (values_i64 >> np.int64(12)).astype(jnp.float32)
-    rf = r_bool.astype(jnp.float32)
-    lo_sum = jnp.dot(rf.T, lo, preferred_element_type=jnp.float32)
-    hi_sum = jnp.dot(rf.T, hi, preferred_element_type=jnp.float32)
-    return hi_sum.astype(jnp.int64) * np.int64(4096) + lo_sum.astype(jnp.int64)
+def _descend(parent, real, rank, keep, subtree, justified_idx):
+    """Greedy HLMD-GHOST descent with exact (weight, lexicographic-rank)
+    tie-break (pos-evolution.md:1114-1116)."""
+
+    def descend(carry):
+        head, _ = carry
+        children = (parent == head) & keep & real
+        any_child = children.any()
+        w = jnp.where(children, subtree, -1)
+        best_w = w.max()
+        rank_key = jnp.where(children & (w == best_w), rank, -1)
+        best = jnp.argmax(rank_key).astype(jnp.int32)
+        new_head = jnp.where(any_child, best, head)
+        return new_head, any_child
+
+    def cond(carry):
+        return carry[1]
+
+    head0 = justified_idx
+    children0 = (parent == head0) & keep & real
+    head, _ = jax.lax.while_loop(cond, descend, (head0, children0.any()))
+    return head
+
+
+def _head_from_buckets(parent, real, rank, leaf_viable, justified_idx,
+                       vote_weight, boost_idx, boost_amount, capacity: int):
+    """Shared core: per-block vote buckets -> (head, subtree weights).
+
+    One lifted pass carries three columns: vote weight, a one-hot of the
+    boosted block (its accumulation marks exactly the boost block's
+    ancestors-or-self, pos-evolution.md:916, 1355), and viable-leaf
+    indicators (their accumulation marks blocks with a viable leaf
+    descendant — the filtered block tree, pos-evolution.md:874-880).
+    """
+    has_boost = boost_idx >= 0
+    boost_onehot = (
+        (jnp.arange(capacity, dtype=jnp.int32) == boost_idx) & has_boost
+    ).astype(jnp.int64)
+
+    is_parent = jnp.zeros(capacity, dtype=bool).at[
+        jnp.where(parent >= 0, parent, 0)].max((parent >= 0) & real)
+    leaf = real & ~is_parent
+    ok_leaf = (leaf & leaf_viable).astype(jnp.int64)
+
+    cols = jnp.stack([vote_weight, boost_onehot, ok_leaf], axis=1)
+    acc = _subtree_accumulate(parent, real, cols, capacity)
+    subtree = acc[:, 0] + acc[:, 1] * boost_amount
+    keep = acc[:, 2] > 0
+
+    head = _descend(parent, real, rank, keep, subtree, justified_idx)
+    return head, subtree
 
 
 @partial(jax.jit, static_argnames=("capacity", "increment"))
@@ -92,16 +145,17 @@ def head_and_weights(store: DenseStore, capacity: int,
                      min_vote_epoch=None):
     """Returns (head_idx, subtree_weights[B] in Gwei) — one fused pass.
 
-    Effective balances are always multiples of ``increment`` (hysteresis,
-    pos-evolution.md:122-133), so subtree sums run as exact hi/lo-split f32
-    matmuls over increment counts; the (not increment-aligned) proposer
-    boost is added afterwards in int64.
+    Scans the full latest-message table (O(N) ``segment_sum``) then runs
+    the O(B log B) lifted tree pass. For repeated head queries between
+    small message deltas, use the incremental bucket path
+    (``apply_latest_messages`` + ``head_from_buckets``) instead.
 
     ``min_vote_epoch`` applies the RLMD-GHOST vote-expiry window
     (pos-evolution.md:1585, 1596): latest messages with target epoch below
     it carry no weight (eta = window size; None = LMD's eta = inf; the
     Goldfish limit keeps only the most recent slot's votes).
     """
+    del increment  # weights accumulate exactly in int64; kept for API compat
     votes_valid = store.msg_block >= 0
     if min_vote_epoch is not None:
         votes_valid = votes_valid & (store.msg_epoch >= min_vote_epoch)
@@ -110,46 +164,87 @@ def head_and_weights(store: DenseStore, capacity: int,
         jnp.where(votes_valid, store.weight, 0), seg_ids,
         num_segments=capacity + 1)[:capacity]
 
-    r = _reachability(store.parent, store.real, capacity)
+    return _head_from_buckets(
+        store.parent, store.real, store.rank, store.leaf_viable,
+        store.justified_idx, vote_weight, store.boost_idx,
+        store.boost_amount, capacity)
 
-    vote_incr = vote_weight // np.int64(increment)
-    subtree = _exact_matvec_i64(r, vote_incr, capacity) * np.int64(increment)
-    # proposer boost rides the boosted block's ancestor chain
-    has_boost = store.boost_idx >= 0
-    boost_row = jnp.where(
-        has_boost,
-        r[jnp.maximum(store.boost_idx, 0)],
-        jnp.zeros(capacity, dtype=bool))
-    subtree = subtree + boost_row.astype(jnp.int64) * store.boost_amount
 
-    # viable-branch filter: block kept iff some viable leaf descends from it
-    is_parent = jnp.zeros(capacity, dtype=bool).at[
-        jnp.where(store.parent >= 0, store.parent, 0)].max(
-        (store.parent >= 0) & store.real)
-    leaf = store.real & ~is_parent
-    ok_leaf = leaf & store.leaf_viable
-    keep = jnp.dot(r.astype(jnp.float32).T, ok_leaf.astype(jnp.float32),
-                   preferred_element_type=jnp.float32) > 0.5
+@partial(jax.jit, static_argnames=("capacity",))
+def head_from_buckets(parent, real, rank, leaf_viable, justified_idx,
+                      vote_weight, boost_idx, boost_amount, capacity: int):
+    """Head query from resident per-block vote buckets: O(B log B), no
+    registry scan — the fast path for per-slot ``get_head`` on a
+    persistent device store (pos-evolution.md:298,762 run this on every
+    propose/attest decision).
 
-    def descend(carry):
-        head, _ = carry
-        children = (store.parent == head) & keep & store.real
-        any_child = children.any()
-        w = jnp.where(children, subtree, -1)
-        best_w = w.max()
-        # exact (weight, lexicographic root) tie-break
-        rank_key = jnp.where(children & (w == best_w), store.rank, -1)
-        best = jnp.argmax(rank_key).astype(jnp.int32)
-        new_head = jnp.where(any_child, best, head)
-        return new_head, any_child
+    LMD-only (eta = inf): buckets destroy per-vote epochs, so RLMD/
+    Goldfish expiry windows (pos-evolution.md:1585) cannot be applied
+    here — windowed variants use ``head_and_weights`` with
+    ``min_vote_epoch``, which rescans the message table."""
+    return _head_from_buckets(parent, real, rank, leaf_viable, justified_idx,
+                              vote_weight, boost_idx, boost_amount, capacity)
 
-    def cond(carry):
-        return carry[1]
 
-    head0 = store.justified_idx
-    children0 = (store.parent == head0) & keep & store.real
-    head, _ = jax.lax.while_loop(cond, descend, (head0, children0.any()))
-    return head, subtree
+@jax.jit
+def apply_latest_messages(msg_block, msg_epoch, vote_weight,
+                          val_idx, new_block, new_epoch, weight, active):
+    """Incremental LMD table update (pos-evolution.md:1435-1441) on device.
+
+    Batched: ``val_idx[K]`` validators vote for ``new_block[K]`` with
+    target ``new_epoch[K]``. A vote lands if the validator has no current
+    latest message or its target epoch exceeds it (:1440), and the
+    validator is ``active`` (not equivocating/slashed — equivocation
+    discounting, :1438; use ``remove_latest_messages`` to discount a
+    validator whose vote already landed). Returns updated (msg_block,
+    msg_epoch, vote_weight) with the per-block buckets adjusted by
+    scatter deltas: O(K) instead of the O(N) rescan. Duplicate
+    ``val_idx`` entries in one batch are not supported (callers batch one
+    attestation per validator per slot). ``weight`` must stay consistent
+    with what previously landed for the same validator — on effective-
+    balance changes (epoch boundaries) rebuild the buckets wholesale.
+    """
+    old_block = msg_block[val_idx]
+    old_epoch = msg_epoch[val_idx]
+    lands = (active & (new_block >= 0)
+             & ((old_block < 0) | (new_epoch > old_epoch)))
+
+    nb = vote_weight.shape[0]
+    # subtract old weight where a previous message existed
+    sub_seg = jnp.where(lands & (old_block >= 0), old_block, nb)
+    add_seg = jnp.where(lands, new_block, nb)
+    w = weight.astype(vote_weight.dtype)
+    vote_weight = vote_weight.at[sub_seg].add(
+        -jnp.where(lands & (old_block >= 0), w, 0), mode="drop")
+    vote_weight = vote_weight.at[add_seg].add(
+        jnp.where(lands, w, 0), mode="drop")
+
+    msg_block = msg_block.at[val_idx].set(
+        jnp.where(lands, new_block, old_block))
+    msg_epoch = msg_epoch.at[val_idx].set(
+        jnp.where(lands, new_epoch, old_epoch))
+    return msg_block, msg_epoch, vote_weight
+
+
+@jax.jit
+def remove_latest_messages(msg_block, msg_epoch, vote_weight, val_idx, weight):
+    """Discount validators whose vote already landed — the incremental
+    form of dropping ``store.equivocating_indices`` from LMD weight
+    (pos-evolution.md:1438, 1447-1461): subtract their bucketed weight
+    and clear their table entries so no future vote from them lands via
+    the normal path (callers also mark them inactive).
+
+    ``weight`` must match what landed for each validator (the effective
+    balance used at ``apply_latest_messages`` time)."""
+    old_block = msg_block[val_idx]
+    had = old_block >= 0
+    nb = vote_weight.shape[0]
+    sub_seg = jnp.where(had, old_block, nb)
+    vote_weight = vote_weight.at[sub_seg].add(
+        -jnp.where(had, weight.astype(vote_weight.dtype), 0), mode="drop")
+    msg_block = msg_block.at[val_idx].set(-1)
+    msg_epoch = msg_epoch.at[val_idx].set(0)
+    return msg_block, msg_epoch, vote_weight
 
 
 # --- host-side densification --------------------------------------------------

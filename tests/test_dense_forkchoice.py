@@ -141,3 +141,116 @@ class TestDenseHeadDifferential:
             fc.on_block(store, sb)
             parent_state = store.block_states[hash_tree_root(sb.message)]
             assert get_head_dense(store) == fc.get_head(store)
+
+
+class TestIncrementalBuckets:
+    """The persistent-store fast path: per-block vote buckets updated by
+    scatter deltas must agree with the full message-table rescan."""
+
+    def _random_store(self, rng, capacity=32, n=256):
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.forkchoice import DenseStore
+        parent = np.full(capacity, -1, np.int32)
+        for i in range(1, capacity):
+            parent[i] = rng.integers(0, i)
+        msg_block = rng.integers(-1, capacity, n).astype(np.int32)
+        msg_epoch = np.where(msg_block >= 0,
+                             rng.integers(0, 4, n), 0).astype(np.int64)
+        weight = rng.integers(1, 5, n).astype(np.int64) * 10**9
+        return DenseStore(
+            parent=jnp.asarray(parent),
+            slot=jnp.arange(capacity, dtype=jnp.int32),
+            rank=jnp.asarray(rng.permutation(capacity).astype(np.int32)),
+            real=jnp.ones(capacity, bool),
+            leaf_viable=jnp.ones(capacity, bool),
+            justified_idx=jnp.int32(0),
+            msg_block=jnp.asarray(msg_block),
+            msg_epoch=jnp.asarray(msg_epoch),
+            weight=jnp.asarray(weight),
+            boost_idx=jnp.int32(rng.integers(-1, capacity)),
+            boost_amount=jnp.int64(7 * 10**8),
+        )
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_apply_matches_rescan(self, seed):
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.forkchoice import (
+            apply_latest_messages, head_and_weights, head_from_buckets)
+        rng = np.random.default_rng(seed)
+        capacity, n = 32, 256
+        st = self._random_store(rng, capacity, n)
+        # initial buckets from a rescan
+        votes_valid = st.msg_block >= 0
+        seg = jnp.where(votes_valid, st.msg_block, capacity)
+        buckets = jax.ops.segment_sum(
+            jnp.where(votes_valid, st.weight, 0), seg,
+            num_segments=capacity + 1)[:capacity]
+        msg_block, msg_epoch = st.msg_block, st.msg_epoch
+        # three batches of incremental votes (incl. first-ever voters at
+        # epoch 0: validators with msg_block == -1 must land)
+        for b in range(3):
+            k = 64
+            val_idx = jnp.asarray(rng.choice(n, size=k, replace=False)
+                                  .astype(np.int32))
+            new_block = jnp.asarray(rng.integers(0, capacity, k).astype(np.int32))
+            new_epoch = jnp.asarray(rng.integers(0, 6, k).astype(np.int64))
+            active = jnp.asarray(rng.random(k) < 0.9)
+            msg_block, msg_epoch, buckets = apply_latest_messages(
+                msg_block, msg_epoch, buckets, val_idx, new_block,
+                new_epoch, st.weight[val_idx], active)
+        # rescan oracle over the updated table
+        st2 = st._replace(msg_block=msg_block, msg_epoch=msg_epoch)
+        h_ref, w_ref = head_and_weights(st2, capacity)
+        h_inc, w_inc = head_from_buckets(
+            st.parent, st.real, st.rank, st.leaf_viable, st.justified_idx,
+            buckets, st.boost_idx, st.boost_amount, capacity)
+        assert int(h_ref) == int(h_inc)
+        assert np.array_equal(np.asarray(w_ref), np.asarray(w_inc))
+
+    def test_remove_discounts_landed_votes(self):
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.forkchoice import (
+            head_and_weights, remove_latest_messages, head_from_buckets)
+        rng = np.random.default_rng(7)
+        capacity, n = 32, 256
+        st = self._random_store(rng, capacity, n)
+        votes_valid = st.msg_block >= 0
+        seg = jnp.where(votes_valid, st.msg_block, capacity)
+        buckets = jax.ops.segment_sum(
+            jnp.where(votes_valid, st.weight, 0), seg,
+            num_segments=capacity + 1)[:capacity]
+        evil = jnp.asarray(np.array([3, 10, 17], dtype=np.int32))
+        msg_block, msg_epoch, buckets = remove_latest_messages(
+            st.msg_block, st.msg_epoch, buckets, evil, st.weight[evil])
+        # oracle: equivocators dropped from the table entirely
+        st2 = st._replace(msg_block=msg_block, msg_epoch=msg_epoch)
+        h_ref, w_ref = head_and_weights(st2, capacity)
+        h_inc, w_inc = head_from_buckets(
+            st.parent, st.real, st.rank, st.leaf_viable, st.justified_idx,
+            buckets, st.boost_idx, st.boost_amount, capacity)
+        assert int(h_ref) == int(h_inc)
+        assert np.array_equal(np.asarray(w_ref), np.asarray(w_inc))
+
+    def test_large_capacity_chain(self):
+        """Capacity 1024 (the round-1 reachability design was O(B^2) here):
+        a deep chain plus forks must still match the spec-shaped oracle."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.forkchoice import head_and_weights
+        rng = np.random.default_rng(11)
+        capacity, n = 1024, 2048
+        st = self._random_store(rng, capacity, n)
+        # deep chain: parent[i] = i - 1 for the first half, forks after
+        parent = np.arange(-1, capacity - 1, dtype=np.int32)
+        st = st._replace(parent=jnp.asarray(parent))
+        h, w = head_and_weights(st, capacity)
+        # chain subtree weights are suffix sums of per-block votes
+        mb = np.asarray(st.msg_block)
+        wt = np.asarray(st.weight)
+        per_block = np.zeros(capacity, np.int64)
+        np.add.at(per_block, mb[mb >= 0], wt[mb >= 0])
+        expect = per_block[::-1].cumsum()[::-1]
+        bi = int(st.boost_idx)
+        if bi >= 0:
+            expect[: bi + 1] += int(st.boost_amount)
+        assert np.array_equal(np.asarray(w), expect)
+        assert int(h) == capacity - 1  # chain head = tip
