@@ -139,9 +139,8 @@ def _head_from_buckets(parent, real, rank, leaf_viable, justified_idx,
     return head, subtree
 
 
-@partial(jax.jit, static_argnames=("capacity", "increment"))
+@partial(jax.jit, static_argnames=("capacity",))
 def head_and_weights(store: DenseStore, capacity: int,
-                     increment: int = 10**9,
                      min_vote_epoch=None):
     """Returns (head_idx, subtree_weights[B] in Gwei) — one fused pass.
 
@@ -155,7 +154,6 @@ def head_and_weights(store: DenseStore, capacity: int,
     it carry no weight (eta = window size; None = LMD's eta = inf; the
     Goldfish limit keeps only the most recent slot's votes).
     """
-    del increment  # weights accumulate exactly in int64; kept for API compat
     votes_valid = store.msg_block >= 0
     if min_vote_epoch is not None:
         votes_valid = votes_valid & (store.msg_epoch >= min_vote_epoch)

@@ -43,36 +43,36 @@ def _chain_words(h_words: list):
     return w16
 
 
-def _agg_sig_kernel(k_ref, w2_ref, states_ref, out_ref):
-    """One committee: states (1, 8, C) midstates; w2 (1, 64) the
+def _agg_sig_kernel(k_ref, w2_ref, states_ref, out_ref, *, unroll: bool):
+    """One committee: states (1, 8, C) midstates; w2 (1, 1, 64) the
     attestation's second-block schedule; out (1, 24, C) signature words.
+    k_ref: (1, 64) round constants, consulted by the loop form only.
 
-    Refs are loaded once up front (the hoist-then-index pattern of
-    pallas_sha256) and the shared ``_rounds``/``_schedule`` helpers do the
-    compression: the per-attestation w2 row is a (64,) stack whose entries
-    broadcast over the signer lanes."""
+    The per-attestation schedule words are read as (1,) static slices so
+    they broadcast over the signer lanes without a scalar extract (which
+    Mosaic does not lower from VMEM vectors)."""
     c = states_ref.shape[2]
-    k_stack = k_ref[0, :]
-    w2_stack = w2_ref[0, :]
-    init = tuple(states_ref[0, i, :] for i in range(8))
-    mid = _rounds(init, w2_stack, k_stack)
+    k_stack = None if unroll else k_ref[0, :]
+    w2_stack = [w2_ref[0, 0:1, t:t + 1] for t in range(64)]   # (1, 1) each
+    init = tuple(states_ref[0, i:i + 1, :] for i in range(8))  # (1, C) each
+    mid = _rounds(init, w2_stack, unroll, k_stack)
     h1 = tuple(mid[i] + init[i] for i in range(8))
 
-    h0c = tuple(jnp.full((c,), np.uint32(H0[i])) for i in range(8))
-    f2 = _rounds(h0c, _schedule(_chain_words(list(h1))), k_stack)
+    h0c = tuple(jnp.full((1, c), np.uint32(H0[i])) for i in range(8))
+    f2 = _rounds(h0c, _schedule(_chain_words(list(h1))), unroll, k_stack)
     h2 = tuple(f2[i] + h0c[i] for i in range(8))
-    f3 = _rounds(h0c, _schedule(_chain_words(list(h2))), k_stack)
+    f3 = _rounds(h0c, _schedule(_chain_words(list(h2))), unroll, k_stack)
     h3 = tuple(f3[i] + h0c[i] for i in range(8))
 
     for i in range(8):
-        out_ref[0, i, :] = h1[i]
-        out_ref[0, 8 + i, :] = h2[i]
-        out_ref[0, 16 + i, :] = h3[i]
+        out_ref[0, i:i + 1, :] = h1[i]
+        out_ref[0, 8 + i:9 + i, :] = h2[i]
+        out_ref[0, 16 + i:17 + i, :] = h3[i]
 
 
 def _schedule_host(w16_words):
     """(A, 16) u32 message blocks -> (A, 64) schedule stacks (XLA, cheap)."""
-    return _schedule([w16_words[:, t] for t in range(16)]).T
+    return jnp.stack(_schedule([w16_words[:, t] for t in range(16)]), 0).T
 
 
 def _pallas_sigs(pk_states, committees, msg_words, interpret: bool):
@@ -81,21 +81,22 @@ def _pallas_sigs(pk_states, committees, msg_words, interpret: bool):
     a, c = committees.shape
     gathered = pk_states[committees]                       # (A, C, 8)
     states_t = jnp.swapaxes(gathered, 1, 2)                # (A, 8, C)
-    w2 = _schedule_host(_msg_block2(msg_words))            # (A, 64)
-    k = jnp.asarray(_K)[None, :]                           # (1, 64)
+    w2 = _schedule_host(_msg_block2(msg_words))[:, None, :]  # (A, 1, 64)
 
     out = pl.pallas_call(
-        _agg_sig_kernel,
+        partial(_agg_sig_kernel, unroll=not interpret),
         out_shape=jax.ShapeDtypeStruct((a, 24, c), jnp.uint32),
         grid=(a,),
+        # i*0 not literal 0 in index maps: x64 mode makes literals i64,
+        # which Mosaic cannot mix with the i32 grid index
         in_specs=[
-            pl.BlockSpec((1, 64), lambda i: (0, 0)),
-            pl.BlockSpec((1, 64), lambda i: (i, 0)),
-            pl.BlockSpec((1, 8, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 64), lambda i: (i * 0, i * 0)),
+            pl.BlockSpec((1, 1, 64), lambda i: (i, i * 0, i * 0)),
+            pl.BlockSpec((1, 8, c), lambda i: (i, i * 0, i * 0)),
         ],
-        out_specs=pl.BlockSpec((1, 24, c), lambda i: (i, 0, 0)),
+        out_specs=pl.BlockSpec((1, 24, c), lambda i: (i, i * 0, i * 0)),
         interpret=interpret,
-    )(k, w2, states_t)
+    )(jnp.asarray(_K)[None, :], w2, states_t)
     return out  # (A, 24, C)
 
 

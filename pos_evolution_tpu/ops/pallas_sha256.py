@@ -33,19 +33,44 @@ def _rotr(x, n: int):
     return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
 
 
-def _schedule(w16: list) -> jax.Array:
-    """Expand 16 message words to the (64, TILE) schedule stack."""
+def _schedule(w16: list) -> list:
+    """Expand 16 message words to the 64-entry schedule (list of per-round
+    words; callers needing an array stack it themselves)."""
     w = list(w16)
     for t in range(16, 64):
         s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
         s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
         w.append(w[t - 16] + s0 + w[t - 7] + s1)
-    return jnp.stack(w, axis=0)
+    return w
 
 
-def _rounds(state_words, w_stack, k_stack):
-    """64 compression rounds as a fori_loop over the schedule stack —
-    bounded graph size for both Mosaic and interpret-mode lowering."""
+def _rounds(state_words, w_list, unroll: bool = True, k_stack=None):
+    """64 compression rounds.
+
+    ``unroll=True`` (Mosaic-compiled path): statically unrolled with the
+    round constants baked in as compile-time scalars — Mosaic has no
+    dynamic_slice. ``unroll=False`` (interpret / CPU path): a fori_loop
+    over the stacked schedule — fully-unrolled SHA graphs compile
+    superlinearly on XLA:CPU (minutes), the loop form stays bounded.
+
+    ``w_list`` is a list of 64 per-round words (entries may broadcast
+    against the state lanes) or an equivalent (64, ...) stacked array.
+    ``k_stack`` (loop form only) is the (64,) round-constant array, which
+    must be a kernel *input* — Pallas kernels cannot capture materialized
+    constant arrays."""
+    if unroll:
+        a, b, c, d, e, f, g, h = state_words
+        for t in range(64):
+            wt = w_list[t]
+            s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+            ch = (e & f) ^ (~e & g)
+            t1 = h + s1 + ch + np.uint32(_K[t]) + wt
+            s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+            maj = (a & b) ^ (a & c) ^ (b & c)
+            a, b, c, d, e, f, g, h = t1 + s0 + maj, a, b, c, d + t1, e, f, g
+        return (a, b, c, d, e, f, g, h)
+
+    w_stack = jnp.stack(w_list, 0) if isinstance(w_list, list) else w_list
 
     def body(t, carry):
         a, b, c, d, e, f, g, h = carry
@@ -60,25 +85,29 @@ def _rounds(state_words, w_stack, k_stack):
     return jax.lax.fori_loop(0, 64, body, tuple(state_words))
 
 
-def _merkle_level_kernel(k_ref, in_ref, out_ref):
-    """k_ref: (64,) u32 round constants; in_ref: (16, TILE) u32 — the
+def _merkle_level_kernel(k_ref, in_ref, out_ref, *, unroll: bool):
+    """k_ref: (1, 64) u32 round constants (loop form only — the unrolled
+    Mosaic path bakes them in as scalars); in_ref: (16, TILE) u32 — the
     64-byte message block of each pair, transposed; out_ref: (8, TILE) u32
-    digests (includes the fixed padding block)."""
+    digests (includes the fixed padding block).
+
+    Every value is kept 2-D ((1, TILE) rows) — Mosaic legalizes 2-D
+    sublane×lane vectors, not 1-D ops."""
     lanes = in_ref.shape[1]
-    k_stack = k_ref[:]
-    w_stack = _schedule([in_ref[t, :] for t in range(16)])
-    init = tuple(jnp.full((lanes,), np.uint32(H0[i])) for i in range(8))
-    mid = _rounds(init, w_stack, k_stack)
+    k_stack = None if unroll else k_ref[0, :]
+    w_stack = _schedule([in_ref[t:t + 1, :] for t in range(16)])
+    init = tuple(jnp.full((1, lanes), np.uint32(H0[i])) for i in range(8))
+    mid = _rounds(init, w_stack, unroll, k_stack)
     state1 = tuple(mid[i] + init[i] for i in range(8))
 
     # second block: fixed SHA-256 padding for a 64-byte message
-    zero = jnp.zeros((lanes,), dtype=jnp.uint32)
+    zero = jnp.zeros((1, lanes), dtype=jnp.uint32)
     pad16 = [zero] * 16
-    pad16[0] = jnp.full((lanes,), np.uint32(0x80000000))
-    pad16[15] = jnp.full((lanes,), np.uint32(512))
-    fin = _rounds(state1, _schedule(pad16), k_stack)
+    pad16[0] = jnp.full((1, lanes), np.uint32(0x80000000))
+    pad16[15] = jnp.full((1, lanes), np.uint32(512))
+    fin = _rounds(state1, _schedule(pad16), unroll, k_stack)
     for i in range(8):
-        out_ref[i, :] = fin[i] + state1[i]
+        out_ref[i:i + 1, :] = fin[i] + state1[i]
 
 
 def _pallas_level_call(pairs_t: jax.Array, interpret: bool) -> jax.Array:
@@ -86,14 +115,17 @@ def _pallas_level_call(pairs_t: jax.Array, interpret: bool) -> jax.Array:
 
     n = pairs_t.shape[1]
     return pl.pallas_call(
-        _merkle_level_kernel,
+        partial(_merkle_level_kernel, unroll=not interpret),
         out_shape=jax.ShapeDtypeStruct((8, n), jnp.uint32),
         grid=(n // TILE,),
-        in_specs=[pl.BlockSpec((64,), lambda i: (0,)),
-                  pl.BlockSpec((16, TILE), lambda i: (0, i))],
-        out_specs=pl.BlockSpec((8, TILE), lambda i: (0, i)),
+        # index maps use i*0 (not literal 0): under jax_enable_x64 a literal
+        # becomes i64 next to the i32 grid index, which Mosaic cannot
+        # legalize (mixed-type index-map return)
+        in_specs=[pl.BlockSpec((1, 64), lambda i: (i * 0, i * 0)),
+                  pl.BlockSpec((16, TILE), lambda i: (i * 0, i))],
+        out_specs=pl.BlockSpec((8, TILE), lambda i: (i * 0, i)),
         interpret=interpret,
-    )(jnp.asarray(_K), pairs_t)
+    )(jnp.asarray(_K)[None, :], pairs_t)
 
 
 _jitted_level = jax.jit(partial(_pallas_level_call, interpret=False))
