@@ -197,15 +197,31 @@ def apply_latest_messages(msg_block, msg_epoch, vote_weight,
     validator whose vote already landed). Returns updated (msg_block,
     msg_epoch, vote_weight) with the per-block buckets adjusted by
     scatter deltas: O(K) instead of the O(N) rescan. Duplicate
-    ``val_idx`` entries in one batch are not supported (callers batch one
-    attestation per validator per slot). ``weight`` must stay consistent
-    with what previously landed for the same validator — on effective-
-    balance changes (epoch boundaries) rebuild the buckets wholesale.
+    ``val_idx`` entries in one batch are deduplicated in-kernel (an O(K^2)
+    pairwise tournament — highest target epoch wins, earliest batch
+    position on ties, matching sequential application); batches are
+    per-slot deliveries, so K stays far below the registry size.
+    ``weight`` must stay consistent with what previously landed for the
+    same validator — on effective-balance changes (epoch boundaries) call
+    ``rebuild_buckets``.
     """
     old_block = msg_block[val_idx]
     old_epoch = msg_epoch[val_idx]
     lands = (active & (new_block >= 0)
              & ((old_block < 0) | (new_epoch > old_epoch)))
+
+    # In-batch dedup: for equal val_idx, only the sequential winner lands —
+    # the first entry carrying the maximum target epoch among entries that
+    # could land at all (later equal-epoch votes would not land against
+    # it, :1440; inactive or padded entries never land sequentially, so
+    # they must not knock out a live lower-epoch vote either).
+    k = val_idx.shape[0]
+    pos = jnp.arange(k, dtype=jnp.int64)
+    key = new_epoch.astype(jnp.int64) * (2 * k) + (k - pos)
+    competitor = active & (new_block >= 0)
+    same = (val_idx[:, None] == val_idx[None, :]) & ~jnp.eye(k, dtype=bool)
+    loses = (same & (key[None, :] > key[:, None]) & competitor[None, :]).any(axis=1)
+    lands = lands & ~loses
 
     nb = vote_weight.shape[0]
     # subtract old weight where a previous message existed
@@ -217,11 +233,27 @@ def apply_latest_messages(msg_block, msg_epoch, vote_weight,
     vote_weight = vote_weight.at[add_seg].add(
         jnp.where(lands, w, 0), mode="drop")
 
-    msg_block = msg_block.at[val_idx].set(
-        jnp.where(lands, new_block, old_block))
-    msg_epoch = msg_epoch.at[val_idx].set(
-        jnp.where(lands, new_epoch, old_epoch))
+    # Non-landing entries must not write at all (a write-back of the old
+    # value could race a duplicate winner's write under scatter ordering):
+    # route them to an out-of-range slot and drop.
+    tgt = jnp.where(lands, val_idx, msg_block.shape[0])
+    msg_block = msg_block.at[tgt].set(new_block, mode="drop")
+    msg_epoch = msg_epoch.at[tgt].set(new_epoch, mode="drop")
     return msg_block, msg_epoch, vote_weight
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def rebuild_buckets(msg_block, weight, capacity: int):
+    """Wholesale per-block vote-bucket rebuild: one O(N) ``segment_sum``
+    over the resident message table. The epoch-boundary hook — effective
+    balances change only at epoch processing (pos-evolution.md:122-133),
+    so callers refresh ``weight`` then rebuild here instead of trusting
+    incremental deltas across a balance change (the
+    ``apply_latest_messages`` weight-consistency contract)."""
+    seg = jnp.where(msg_block >= 0, msg_block, capacity)
+    return jax.ops.segment_sum(
+        jnp.where(msg_block >= 0, weight.astype(jnp.int64), 0), seg,
+        num_segments=capacity + 1)[:capacity]
 
 
 @jax.jit
@@ -247,6 +279,11 @@ def remove_latest_messages(msg_block, msg_epoch, vote_weight, val_idx, weight):
 
 # --- host-side densification --------------------------------------------------
 
+def next_pow2(x: int) -> int:
+    """Capacity rounding shared by the one-shot and resident dense stores."""
+    return max(int(2 ** np.ceil(np.log2(max(x, 2)))), 2)
+
+
 def build_dense_store(store, capacity: int | None = None):
     """Build a DenseStore from a spec-level Store (host side).
 
@@ -260,7 +297,7 @@ def build_dense_store(store, capacity: int | None = None):
     roots = list(store.blocks.keys())  # insertion = topological order
     b = len(roots)
     if capacity is None:
-        capacity = max(int(2 ** np.ceil(np.log2(max(b, 2)))), 2)
+        capacity = next_pow2(b)
     index_of = {r: i for i, r in enumerate(roots)}
     rank = np.argsort(np.argsort(np.array([r for r in roots], dtype=object)))
 

@@ -46,9 +46,11 @@ def _chain_words(h_words: list):
 def _agg_sig_kernel(k_ref, w2_ref, states_ref, out_ref, *, unroll: bool):
     """One committee: states (1, 8, C) midstates; w2 (1, 1, 64) the
     attestation's second-block schedule; out (1, 24, C) signature words.
-    k_ref: (1, 64) round constants, consulted by the loop form only.
+    k_ref: (1, 64) round constants, consulted by the loop form only — on
+    the unrolled (compiled) path it is dead weight still DMA'd each grid
+    step, kept so one kernel signature serves both modes.
 
-    The per-attestation schedule words are read as (1,) static slices so
+    The per-attestation schedule words are read as (1, 1) static slices so
     they broadcast over the signer lanes without a scalar extract (which
     Mosaic does not lower from VMEM vectors)."""
     c = states_ref.shape[2]

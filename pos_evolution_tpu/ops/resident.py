@@ -1,0 +1,234 @@
+"""Persistent device fork-choice store (the consumer of the incremental
+bucket kernels in ``ops/forkchoice.py``).
+
+The reference runs ``get_head`` on every propose/attest decision
+(pos-evolution.md:298, 762) over a store that changes by small deltas:
+one block row per ``on_block`` (:986-1036), a handful of latest-message
+updates per ``on_attestation`` (:1435-1441), rare equivocator removals
+(:1447-1461). ``get_head_dense`` rebuilt the whole dense image from the
+spec store on *every* query — an O(blocks + registry) host loop that
+dwarfs the kernel it feeds. This class keeps the dense image **resident
+on device** and mirrors the spec store incrementally:
+
+- ``note_block``      — append one row (parent/slot/rank/viability);
+- ``note_attestation``— queue votes; flushed as one padded
+                        ``apply_latest_messages`` scatter batch;
+- ``note_slashing``   — ``remove_latest_messages`` + weight zeroing;
+- ``head()``          — flush, then ``head_from_buckets``: O(B log B) on
+                        device, no registry rescan, no host rebuild.
+
+Wholesale refreshes happen only where the incremental contracts demand
+them (the ``rebuild_buckets`` epoch-boundary hook): effective balances
+and activation windows move at epoch processing (pos-evolution.md:
+122-133), viability and vote weights re-anchor when the justified /
+finalized checkpoints move (:874-880, 1026-1036). ``sync()`` detects
+those events by comparing cheap fingerprints and triggers a rebuild —
+every other head query runs purely from resident state.
+
+Differential contract: ``head()`` must equal the spec walk
+(``specs/forkchoice.get_head``) and the rescan kernel
+(``head_and_weights``) at every query; ``tests/test_resident.py`` pins
+all three across simulated epochs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from pos_evolution_tpu.ops.forkchoice import (
+    apply_latest_messages,
+    build_dense_store,
+    head_from_buckets,
+    next_pow2,
+    rebuild_buckets,
+    remove_latest_messages,
+)
+
+
+class ResidentForkChoice:
+    """Device-resident dense mirror of one spec-level ``Store``."""
+
+    def __init__(self, store, capacity: int = 64):
+        self._min_capacity = capacity
+        self.rebuild(store)
+
+    # -- full (re)build --------------------------------------------------------
+
+    def rebuild(self, store) -> None:
+        """Densify the spec store from scratch (anchor init, capacity
+        growth, prune, or a contract-mandated epoch/checkpoint refresh)."""
+        capacity = max(self._min_capacity, next_pow2(len(store.blocks)))
+        dense, roots, capacity = build_dense_store(store, capacity)
+        self.capacity = capacity
+        self.roots: list[bytes] = list(roots)
+        self.index_of = {r: i for i, r in enumerate(self.roots)}
+        self.parent = dense.parent
+        self.slot = dense.slot
+        self.rank = dense.rank
+        self.real = dense.real
+        self.leaf_viable = dense.leaf_viable
+        self.msg_block = dense.msg_block
+        self.msg_epoch = dense.msg_epoch
+        # Full per-validator weights (``build_dense_store`` zeroes weight
+        # for validators without a landed message — correct for a one-shot
+        # rescan, but the resident store must weight *future* voters too):
+        # effective balance under the justified-checkpoint registry, masked
+        # by activation window / slashed / equivocating (pos-evolution.md
+        # :322, 1438).
+        from pos_evolution_tpu.specs.forkchoice import get_current_slot
+        from pos_evolution_tpu.specs.helpers import compute_epoch_at_slot
+        jstate = store.checkpoint_states[store.justified_checkpoint.as_key()]
+        reg = jstate.validators
+        current_epoch = compute_epoch_at_slot(get_current_slot(store))
+        active = ((reg.activation_epoch <= np.uint64(current_epoch))
+                  & (np.uint64(current_epoch) < reg.exit_epoch))
+        weight = np.where(active & ~reg.slashed,
+                          reg.effective_balance.astype(np.int64), 0)
+        # vote-landing mask: False once a validator equivocates (:1438)
+        ok = np.ones(len(reg), dtype=bool)
+        for v in store.equivocating_indices:
+            if v < ok.shape[0]:
+                ok[v] = False
+                weight[v] = 0
+        self.ok = jnp.asarray(ok)
+        self.weight = jnp.asarray(weight)
+        self.buckets = rebuild_buckets(self.msg_block, self.weight,
+                                       self.capacity)
+        self._pending: list[tuple[np.ndarray, int, int]] = []
+        self._fingerprint = self._store_fingerprint(store)
+
+    def _store_fingerprint(self, store):
+        """Events that void the incremental contracts: justified /
+        finalized checkpoint moves (weights + viability re-anchor) and
+        epoch rollover (activation windows + the viability grace window,
+        pos-evolution.md:874-880)."""
+        from pos_evolution_tpu.config import cfg
+        from pos_evolution_tpu.specs.forkchoice import get_current_slot
+        epoch = get_current_slot(store) // cfg().slots_per_epoch
+        return (int(store.justified_checkpoint.epoch),
+                bytes(store.justified_checkpoint.root),
+                int(store.finalized_checkpoint.epoch),
+                bytes(store.finalized_checkpoint.root),
+                epoch)
+
+    def sync(self, store) -> None:
+        """Refresh resident state if a rebuild-mandating event occurred
+        (the epoch-boundary hook of the bucket-path contract)."""
+        if (len(store.blocks) > self.capacity
+                or len(self.roots) != len(store.blocks)
+                or self._fingerprint != self._store_fingerprint(store)):
+            # No flush: pending votes were already applied to the spec
+            # store before being queued, so the rebuild re-reads them from
+            # the message table and a device scatter here would be
+            # discarded work.
+            self.rebuild(store)
+
+    # -- incremental handlers --------------------------------------------------
+
+    def note_block(self, store, block_root: bytes) -> None:
+        """Mirror one ``on_block``: append a row. Ranks are order
+        statistics over all roots, so the insertion shifts ranks above the
+        new root — recomputed host-side in O(B log B) numpy, no device
+        rescan. Checkpoint moves triggered by the block are caught by the
+        ``sync`` fingerprint."""
+        if len(self.roots) + 1 > self.capacity:
+            self.rebuild(store)
+            return
+        from pos_evolution_tpu.specs.forkchoice import _leaf_is_viable
+        i = len(self.roots)
+        block = store.blocks[block_root]
+        self.roots.append(block_root)
+        self.index_of[block_root] = i
+        parent_idx = self.index_of.get(bytes(block.parent_root), -1)
+        self.parent = self.parent.at[i].set(parent_idx)
+        self.slot = self.slot.at[i].set(int(block.slot))
+        self.real = self.real.at[i].set(True)
+        self.leaf_viable = self.leaf_viable.at[i].set(
+            _leaf_is_viable(store, block_root))
+        order = np.argsort(np.argsort(np.array(self.roots, dtype=object)))
+        rank = np.zeros(self.capacity, np.int32)
+        rank[: len(self.roots)] = order
+        self.rank = jnp.asarray(rank)
+        self.sync(store)
+
+    def note_attestation(self, attesting_indices, target_epoch: int,
+                         beacon_block_root: bytes) -> None:
+        """Queue latest-message updates; one padded scatter batch lands
+        them at the next flush point (head query / slashing / sync)."""
+        idx = self.index_of.get(bytes(beacon_block_root))
+        if idx is None:
+            return
+        vi = np.asarray(attesting_indices, dtype=np.int32)
+        # indices past the resident registry (deposits landed after the
+        # justified state) would clamp-corrupt the last validator's entry
+        # under jnp gather/scatter — drop them like the spec's weight walk
+        # does (specs/forkchoice.py latest-message loop, i >= len(reg))
+        vi = vi[vi < self.weight.shape[0]]
+        if vi.size == 0:
+            return
+        self._pending.append((vi, int(target_epoch), idx))
+
+    def flush(self) -> None:
+        """Apply queued votes in one ``apply_latest_messages`` batch,
+        padded to the next power of two so recompiles stay bounded (the
+        in-kernel dedup keeps batched semantics equal to sequential
+        application)."""
+        if not self._pending:
+            return
+        val_idx = np.concatenate([p[0] for p in self._pending])
+        epochs = np.concatenate(
+            [np.full(p[0].shape[0], p[1], np.int64) for p in self._pending])
+        blocks = np.concatenate(
+            [np.full(p[0].shape[0], p[2], np.int32) for p in self._pending])
+        self._pending.clear()
+        k = next_pow2(val_idx.shape[0])
+        pad = k - val_idx.shape[0]
+        # padded entries: new_block = -1 never lands; epoch 0 + later
+        # position never beats a real entry in the dedup tournament
+        val_idx = jnp.asarray(np.concatenate(
+            [val_idx, np.zeros(pad, np.int32)]))
+        blocks = jnp.asarray(np.concatenate(
+            [blocks, np.full(pad, -1, np.int32)]))
+        epochs = jnp.asarray(np.concatenate([epochs, np.zeros(pad, np.int64)]))
+        self.msg_block, self.msg_epoch, self.buckets = apply_latest_messages(
+            self.msg_block, self.msg_epoch, self.buckets, val_idx, blocks,
+            epochs, self.weight[val_idx], self.ok[val_idx])
+
+    def note_slashing(self, indices) -> None:
+        """Mirror ``on_attester_slashing``: discount landed votes and bar
+        future ones (equivocation discounting, pos-evolution.md:1438)."""
+        idx = np.asarray(sorted(set(int(i) for i in indices)), dtype=np.int32)
+        idx = idx[idx < self.weight.shape[0]]
+        if idx.size == 0:
+            return
+        self.flush()  # ordering: votes before the evidence still land
+        vi = jnp.asarray(idx)
+        self.msg_block, self.msg_epoch, self.buckets = remove_latest_messages(
+            self.msg_block, self.msg_epoch, self.buckets, vi, self.weight[vi])
+        self.ok = self.ok.at[vi].set(False)
+        self.weight = self.weight.at[vi].set(0)
+
+    # -- queries ---------------------------------------------------------------
+
+    def head(self, store) -> bytes:
+        """The fast-path head query: flush pending votes, read boost
+        scalars from the spec store (they are per-slot host state,
+        pos-evolution.md:942-944), descend on device."""
+        from pos_evolution_tpu.specs.forkchoice import get_proposer_boost
+        self.sync(store)
+        self.flush()
+        boost_idx = -1
+        boost_amount = 0
+        if store.proposer_boost_root != b"\x00" * 32:
+            bi = self.index_of.get(bytes(store.proposer_boost_root))
+            if bi is not None:
+                boost_idx = bi
+                boost_amount = get_proposer_boost(store)
+        justified_idx = self.index_of[bytes(store.justified_checkpoint.root)]
+        head_idx, _ = head_from_buckets(
+            self.parent, self.real, self.rank, self.leaf_viable,
+            jnp.int32(justified_idx), self.buckets, jnp.int32(boost_idx),
+            jnp.int64(boost_amount), self.capacity)
+        return self.roots[int(head_idx)]

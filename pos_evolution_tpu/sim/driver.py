@@ -50,17 +50,27 @@ class ViewGroup:
     """One equivalence class of validator views: a Store + a message queue
     + an attestation pool for proposals made from this view."""
 
-    def __init__(self, group_id: int, store: fc.Store, members: np.ndarray):
+    def __init__(self, group_id: int, store: fc.Store, members: np.ndarray,
+                 resident=None):
         self.id = group_id
         self.store = store
         self.members = members
         self.queue: list[_QueuedMessage] = []
         self.pool: dict[bytes, object] = {}  # attestation root -> Attestation
         self._seq = 0
+        # Device-resident dense mirror (ops/resident.py) when the sim runs
+        # accelerated fork choice; handlers below forward their deltas.
+        self.resident = resident
 
     def enqueue(self, time: float, kind: str, payload) -> None:
         heapq.heappush(self.queue, _QueuedMessage(time, self._seq, kind, payload))
         self._seq += 1
+
+    def _mirror_attestation(self, att, indices) -> None:
+        if self.resident is not None and indices is not None:
+            self.resident.note_attestation(
+                indices, int(att.data.target.epoch),
+                bytes(att.data.beacon_block_root))
 
     def deliver_due(self, now: float, timer) -> None:
         track = timer.track
@@ -71,19 +81,26 @@ class ViewGroup:
                     # block-carried attestations are part of on_block cost
                     with track("on_block"):
                         fc.on_block(self.store, msg.payload)
+                        if self.resident is not None:
+                            self.resident.note_block(
+                                self.store, hash_tree_root(msg.payload.message))
                         for att in msg.payload.message.body.attestations:
                             try:
-                                fc.on_attestation(self.store, att,
-                                                  is_from_block=True)
+                                idx = fc.on_attestation(self.store, att,
+                                                        is_from_block=True)
+                                self._mirror_attestation(att, idx)
                             except AssertionError:
                                 pass
                 elif msg.kind == "attestation":
                     with track("on_attestation"):
-                        fc.on_attestation(self.store, msg.payload)
+                        idx = fc.on_attestation(self.store, msg.payload)
+                        self._mirror_attestation(msg.payload, idx)
                     self.pool[hash_tree_root(msg.payload)] = msg.payload
                 elif msg.kind == "slashing":
                     with track("on_attester_slashing"):
-                        fc.on_attester_slashing(self.store, msg.payload)
+                        evil = fc.on_attester_slashing(self.store, msg.payload)
+                        if self.resident is not None:
+                            self.resident.note_slashing(evil)
             except AssertionError:
                 # Invalid-at-this-time messages are dropped (the reference
                 # permits re-queueing, pos-evolution.md:967-968; the driver
@@ -101,28 +118,33 @@ class Simulation:
         state, anchor = make_genesis(n_validators, genesis_time)
         self.genesis_state = state
         self.anchor_root = hash_tree_root(anchor)
-        self.groups = [
-            ViewGroup(g, fc.get_forkchoice_store(state, anchor),
-                      self.schedule.members(g))
-            for g in range(self.schedule.n_groups)
-        ]
+        def _make_group(g):
+            store = fc.get_forkchoice_store(state, anchor)
+            resident = None
+            if accelerated_forkchoice:
+                from pos_evolution_tpu.ops.resident import ResidentForkChoice
+                resident = ResidentForkChoice(store)
+            return ViewGroup(g, store, self.schedule.members(g), resident)
+
+        self.groups = [_make_group(g) for g in range(self.schedule.n_groups)]
         self.slot = 0
         self.metrics: list[dict] = []
-        # Device fork choice (ops/forkchoice.py): every head query runs the
-        # dense segment-sum + reachability pass instead of the spec walk —
-        # differential-equal by test_dense_forkchoice.py.
+        # Device fork choice: every head query runs on the persistent
+        # device store (ops/resident.py) — incremental bucket updates as
+        # messages arrive, O(B log B) head_from_buckets per query, no
+        # per-query host rebuild — differential-equal to the spec walk by
+        # test_resident.py / test_dense_forkchoice.py.
         self.accelerated_forkchoice = accelerated_forkchoice
         # Per-handler tracing (SURVEY.md §5): wall-clock p50/p95 for
         # get_head / on_block / on_attestation via utils.metrics.
         from pos_evolution_tpu.utils.metrics import HandlerTimer
         self.timer = HandlerTimer()
 
-    def _get_head(self, store: fc.Store) -> bytes:
+    def _get_head(self, group: ViewGroup) -> bytes:
         with self.timer.track("get_head"):
-            if self.accelerated_forkchoice:
-                from pos_evolution_tpu.ops.forkchoice import get_head_dense
-                return get_head_dense(store)
-            return fc.get_head(store)
+            if group.resident is not None:
+                return group.resident.head(group.store)
+            return fc.get_head(group.store)
 
     def trace_summary(self) -> dict:
         """Per-handler timing percentiles for this run."""
@@ -143,7 +165,7 @@ class Simulation:
 
     # -- duties --
     def _head_state(self, group: ViewGroup, slot: int):
-        head = self._get_head(group.store)
+        head = self._get_head(group)
         return head, advance_state_to_slot(group.store.block_states[head], slot)
 
     def _propose(self, slot: int) -> None:
@@ -173,7 +195,7 @@ class Simulation:
     def _pack_attestations(self, group: ViewGroup, slot: int) -> list:
         c = self.cfg
         out = []
-        head = self._get_head(group.store)
+        head = self._get_head(group)
         head_state = group.store.block_states[head]
         for att in group.pool.values():
             a_slot = int(att.data.slot)
@@ -234,7 +256,7 @@ class Simulation:
     # -- observability (SURVEY.md §5: structured per-slot log) --
     def _record_metrics(self, slot: int) -> None:
         g0 = self.groups[0].store
-        head = self._get_head(g0)
+        head = self._get_head(self.groups[0])
         self.metrics.append({
             "slot": slot,
             "head": head.hex()[:8],

@@ -285,8 +285,12 @@ def update_latest_messages(store: Store, attesting_indices, attestation: Attesta
 
 
 def on_attestation(store: Store, attestation: Attestation,
-                   is_from_block: bool = False) -> None:
-    """pos-evolution.md:963-979 / :1423-1428."""
+                   is_from_block: bool = False):
+    """pos-evolution.md:963-979 / :1423-1428.
+
+    Returns the attesting indices (the pyspec handler returns None; the
+    value is surplus for spec fidelity but lets accelerated mirrors
+    forward the vote batch without re-deriving the committee)."""
     validate_on_attestation(store, attestation, is_from_block)
     target_key = attestation.data.target.as_key()
     if target_key in store.checkpoint_states:
@@ -304,6 +308,7 @@ def on_attestation(store: Store, attestation: Attestation,
     if commit_checkpoint_state is not None:
         store.checkpoint_states[target_key] = commit_checkpoint_state
     update_latest_messages(store, indexed_attestation.attesting_indices, attestation)
+    return indexed_attestation.attesting_indices
 
 
 def should_update_justified_checkpoint(store: Store,
@@ -391,8 +396,11 @@ def prune_store(store: Store) -> int:
     return len(dropped)
 
 
-def on_attester_slashing(store: Store, attester_slashing: AttesterSlashing) -> None:
-    """Equivocation evidence feeds the discounting set (pos-evolution.md:1447-1461)."""
+def on_attester_slashing(store: Store, attester_slashing: AttesterSlashing):
+    """Equivocation evidence feeds the discounting set (pos-evolution.md:1447-1461).
+
+    Returns the newly discounted indices (surplus over the pyspec's None
+    return, so accelerated mirrors see exactly the set the handler used)."""
     a1, a2 = attester_slashing.attestation_1, attester_slashing.attestation_2
     assert is_slashable_attestation_data(a1.data, a2.data), "not slashable"
     state = store.block_states[bytes(store.justified_checkpoint.root)]
@@ -402,3 +410,4 @@ def on_attester_slashing(store: Store, attester_slashing: AttesterSlashing) -> N
         & set(int(i) for i in np.asarray(a2.attesting_indices))
     for index in indices:
         store.equivocating_indices.add(index)
+    return indices

@@ -37,11 +37,20 @@ import pytest  # noqa: E402
 from pos_evolution_tpu.config import minimal_config, use_config  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "mesh8: test requires the 8-device virtual CPU mesh (skipped when "
+        "POS_TEST_ACCEL=1 runs the suite on a smaller real-chip topology)")
+
+
 def pytest_collection_modifyitems(config, items):
     if not _ACCEL:
         return
     # On real hardware (usually a single chip) skip tests that require the
     # 8-device virtual CPU mesh instead of letting their fixtures assert.
+    # Selection is by explicit @pytest.mark.mesh8 marker, not nodeid
+    # substring, so new mesh-requiring tests anywhere opt in reliably.
     try:
         import jax
 
@@ -52,7 +61,7 @@ def pytest_collection_modifyitems(config, items):
         return
     skip = pytest.mark.skip(reason="needs the 8-device CPU mesh (unset POS_TEST_ACCEL)")
     for item in items:
-        if "test_parallel" in item.nodeid or "restore_onto_mesh" in item.nodeid:
+        if "mesh8" in item.keywords:
             item.add_marker(skip)
 
 

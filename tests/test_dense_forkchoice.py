@@ -207,6 +207,60 @@ class TestIncrementalBuckets:
         assert int(h_ref) == int(h_inc)
         assert np.array_equal(np.asarray(w_ref), np.asarray(w_inc))
 
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_duplicate_val_idx_in_batch_matches_sequential(self, seed):
+        """Contract enforcement (round-2 sharp edge): duplicate ``val_idx``
+        within one batch used to silently corrupt buckets; the in-kernel
+        dedup must now match applying the batch one entry at a time."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.forkchoice import apply_latest_messages
+        rng = np.random.default_rng(seed)
+        capacity, n, k = 16, 64, 48
+        msg_block = jnp.asarray(rng.integers(-1, capacity, n).astype(np.int32))
+        msg_epoch = jnp.where(msg_block >= 0,
+                              jnp.asarray(rng.integers(0, 4, n)), 0
+                              ).astype(jnp.int64)
+        weight = jnp.asarray(rng.integers(1, 5, n).astype(np.int64))
+        buckets0 = jax.ops.segment_sum(
+            jnp.where(msg_block >= 0, weight, 0),
+            jnp.where(msg_block >= 0, msg_block, capacity),
+            num_segments=capacity + 1)[:capacity]
+        # heavy duplication: 48 entries over only 12 distinct validators
+        val_idx = jnp.asarray(rng.choice(12, size=k).astype(np.int32))
+        new_block = jnp.asarray(rng.integers(0, capacity, k).astype(np.int32))
+        new_epoch = jnp.asarray(rng.integers(0, 6, k).astype(np.int64))
+        # mixed per-entry masks: an inactive or padded (-1 block) duplicate
+        # must not knock out a live lower-epoch vote in the tournament
+        active = jnp.asarray(rng.random(k) < 0.7)
+        new_block = jnp.where(jnp.asarray(rng.random(k) < 0.15), -1, new_block)
+        got = apply_latest_messages(
+            msg_block, msg_epoch, buckets0, val_idx, new_block, new_epoch,
+            weight[val_idx], active)
+        # oracle: sequential one-entry batches
+        mb, me, bk = msg_block, msg_epoch, buckets0
+        for i in range(k):
+            mb, me, bk = apply_latest_messages(
+                mb, me, bk, val_idx[i:i + 1], new_block[i:i + 1],
+                new_epoch[i:i + 1], weight[val_idx[i:i + 1]], active[i:i + 1])
+        assert np.array_equal(np.asarray(got[0]), np.asarray(mb))
+        assert np.array_equal(np.asarray(got[1]), np.asarray(me))
+        assert np.array_equal(np.asarray(got[2]), np.asarray(bk))
+
+    def test_rebuild_buckets_after_balance_change(self):
+        """The epoch-boundary hook: new effective balances -> wholesale
+        rebuild equals a fresh rescan with the new weights."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.forkchoice import rebuild_buckets
+        rng = np.random.default_rng(5)
+        capacity, n = 32, 256
+        msg_block = jnp.asarray(rng.integers(-1, capacity, n).astype(np.int32))
+        new_weight = jnp.asarray(rng.integers(1, 40, n).astype(np.int64))
+        got = rebuild_buckets(msg_block, new_weight, capacity)
+        mb = np.asarray(msg_block)
+        expect = np.zeros(capacity, np.int64)
+        np.add.at(expect, mb[mb >= 0], np.asarray(new_weight)[mb >= 0])
+        assert np.array_equal(np.asarray(got), expect)
+
     def test_remove_discounts_landed_votes(self):
         import jax.numpy as jnp
         from pos_evolution_tpu.ops.forkchoice import (

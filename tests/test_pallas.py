@@ -39,6 +39,39 @@ class TestMerkleLevelKernel:
             got = out[i].astype(">u4").tobytes()
             assert got == expect, f"row {i} mismatch"
 
+    def test_unrolled_rounds_graph_on_cpu(self):
+        """The unrolled (unroll=True) round graph — the form Mosaic
+        compiles on TPU — pinned against hashlib on CPU. Runs `_rounds` /
+        `_schedule` eagerly outside pallas_call: the pallas interpreter
+        always jits its kernel, and the fully-unrolled SHA graph sends
+        XLA:CPU's algebraic simplifier into a multi-minute loop, so the
+        ref-plumbing wrapper stays covered by the loop-form interpret
+        tests while the unrolled arithmetic is pinned here."""
+        import jax.numpy as jnp
+
+        from pos_evolution_tpu.ops.pallas_sha256 import (
+            H0, _rounds, _schedule,
+        )
+
+        rng = np.random.default_rng(7)
+        n = 8
+        msgs = rng.integers(0, 2**32, (16, n), dtype=np.uint64).astype(np.uint32)
+        w_stack = _schedule([jnp.asarray(msgs[t:t + 1, :]) for t in range(16)])
+        init = tuple(jnp.full((1, n), np.uint32(H0[i])) for i in range(8))
+        fin = _rounds(init, w_stack, unroll=True)
+        state1 = np.stack([np.asarray(fin[i] + init[i])[0] for i in range(8)])
+        # second block: fixed padding for a 64-byte message
+        zero = jnp.zeros((1, n), dtype=jnp.uint32)
+        pad16 = [zero] * 16
+        pad16[0] = jnp.full((1, n), np.uint32(0x80000000))
+        pad16[15] = jnp.full((1, n), np.uint32(512))
+        fin2 = _rounds(tuple(jnp.asarray(state1[i:i + 1]) for i in range(8)),
+                       _schedule(pad16), unroll=True)
+        out = np.stack([np.asarray(fin2[i])[0] + state1[i] for i in range(8)])
+        for col in (0, 3, n - 1):
+            assert out[:, col].astype(">u4").tobytes() == \
+                hashlib.sha256(msgs[:, col].astype(">u4").tobytes()).digest()
+
     def test_multi_tile_grid(self):
         rng = np.random.default_rng(1)
         n = 2 * TILE

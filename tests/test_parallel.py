@@ -10,6 +10,8 @@ from pos_evolution_tpu.config import minimal_config
 
 jax = pytest.importorskip("jax")
 
+pytestmark = pytest.mark.mesh8
+
 
 @pytest.fixture(scope="module")
 def mesh():

@@ -1,0 +1,166 @@
+"""Differential tests for the persistent device fork-choice store
+(ops/resident.py): the resident incremental mirror must equal the spec
+walk AND the full-rescan dense kernel at every query, across handler
+sequences including forks, boost, equivocation slashing, capacity growth
+and checkpoint movement (SURVEY.md §4.4b; pos-evolution.md:298,762 run
+get_head on every duty, which is exactly the query this path serves).
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import cfg
+from pos_evolution_tpu.specs import forkchoice as fc
+from pos_evolution_tpu.specs.genesis import make_genesis
+from pos_evolution_tpu.specs.validator import build_block, make_committee_attestation
+from pos_evolution_tpu.ssz import hash_tree_root
+
+jax = pytest.importorskip("jax")
+
+from pos_evolution_tpu.ops.forkchoice import get_head_dense  # noqa: E402
+from pos_evolution_tpu.ops.resident import ResidentForkChoice  # noqa: E402
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+def tick_to_slot(store, slot, offset=0):
+    fc.on_tick(store, store.genesis_time + slot * cfg().seconds_per_slot + offset)
+
+
+def assert_triple_equal(resident, store, context=""):
+    """spec walk == rescan kernel == resident incremental head."""
+    want = fc.get_head(store)
+    assert get_head_dense(store) == want, f"rescan diverged {context}"
+    assert resident.head(store) == want, f"resident diverged {context}"
+
+
+class TestResidentHandlers:
+    def test_fork_votes_boost_and_slashing(self):
+        from pos_evolution_tpu.specs.containers import AttesterSlashing
+        from pos_evolution_tpu.specs.helpers import get_indexed_attestation
+
+        state, anchor = make_genesis(64)
+        store = fc.get_forkchoice_store(state, anchor)
+        resident = ResidentForkChoice(store)
+        tick_to_slot(store, 1, offset=cfg().seconds_per_slot)
+        sb_a = build_block(state, 1, graffiti=b"\x0a" * 32)
+        sb_b = build_block(state, 1, graffiti=b"\x0b" * 32)
+        for sb in (sb_a, sb_b):
+            fc.on_block(store, sb)
+            resident.note_block(store, hash_tree_root(sb.message))
+        assert_triple_equal(resident, store, "after fork blocks")
+
+        ra, rb = hash_tree_root(sb_a.message), hash_tree_root(sb_b.message)
+        loser, winner = sorted([ra, rb])
+        st = {ra: store.block_states[ra], rb: store.block_states[rb]}
+        att1 = make_committee_attestation(st[loser], 1, 0, loser)
+        tick_to_slot(store, 2)
+        idx = fc.on_attestation(store, att1)
+        resident.note_attestation(idx, int(att1.data.target.epoch), loser)
+        assert_triple_equal(resident, store, "after vote for loser")
+        assert resident.head(store) == loser
+
+        # equivocation: the same committee votes the other fork; slashing
+        # evidence discounts them -> tie-break flips to the winner root
+        att2 = make_committee_attestation(st[winner], 1, 0, winner)
+        slashing = AttesterSlashing(
+            attestation_1=get_indexed_attestation(st[loser], att1),
+            attestation_2=get_indexed_attestation(st[winner], att2))
+        fc.on_attester_slashing(store, slashing)
+        evil = (set(int(i) for i in np.asarray(slashing.attestation_1.attesting_indices))
+                & set(int(i) for i in np.asarray(slashing.attestation_2.attesting_indices)))
+        resident.note_slashing(evil)
+        assert_triple_equal(resident, store, "after slashing")
+        assert resident.head(store) == winner
+
+        # a discounted validator's future vote must not land (:1438)
+        tick_to_slot(store, 3)
+        att3 = make_committee_attestation(st[loser], 2, 0, loser)
+        try:
+            idx3 = fc.on_attestation(store, att3)
+            resident.note_attestation(idx3, int(att3.data.target.epoch), loser)
+        except AssertionError:
+            pass
+        assert_triple_equal(resident, store, "after post-slashing vote")
+
+    def test_boost_rides_host_scalars(self):
+        state, anchor = make_genesis(64)
+        store = fc.get_forkchoice_store(state, anchor)
+        resident = ResidentForkChoice(store)
+        tick_to_slot(store, 1, offset=cfg().seconds_per_slot)
+        sb_a = build_block(state, 1, graffiti=b"\x0a" * 32)
+        fc.on_block(store, sb_a)
+        resident.note_block(store, hash_tree_root(sb_a.message))
+        # timely block at slot 2 earns the boost (pos-evolution.md:1020-1024)
+        tick_to_slot(store, 2, offset=0)
+        sb_c = build_block(state, 2, graffiti=b"\x0c" * 32)
+        fc.on_block(store, sb_c)
+        resident.note_block(store, hash_tree_root(sb_c.message))
+        assert store.proposer_boost_root == hash_tree_root(sb_c.message)
+        assert_triple_equal(resident, store, "with live boost")
+        # boost resets on the next slot tick (:942-944)
+        tick_to_slot(store, 3)
+        assert store.proposer_boost_root == b"\x00" * 32
+        assert_triple_equal(resident, store, "after boost reset")
+
+    def test_capacity_growth_rebuild(self):
+        """Exceeding the initial capacity triggers a transparent rebuild."""
+        state, anchor = make_genesis(32)
+        store = fc.get_forkchoice_store(state, anchor)
+        resident = ResidentForkChoice(store, capacity=4)
+        parent_state = state
+        for slot in range(1, 10):
+            tick_to_slot(store, slot)
+            sb = build_block(parent_state, slot)
+            fc.on_block(store, sb)
+            root = hash_tree_root(sb.message)
+            resident.note_block(store, root)
+            parent_state = store.block_states[root]
+            assert_triple_equal(resident, store, f"slot {slot}")
+        assert resident.capacity >= 10
+
+
+class TestResidentInSimulation:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_sleepy_fuzz_triple_differential(self, seed):
+        """Random sleepy schedules; the sim's resident head must equal
+        both oracles on the principal view at every slot — across epoch
+        boundaries (weight rebuilds) and justification movement."""
+        from pos_evolution_tpu.sim import Schedule, Simulation
+        rng = np.random.default_rng(seed)
+        sleep_table = rng.random((200, 64)) < 0.25
+        sched = Schedule(
+            n_validators=64,
+            awake=lambda r, v: not sleep_table[min(r, 199), v])
+        sim = Simulation(64, schedule=sched, accelerated_forkchoice=True)
+        for _ in range(2 * cfg().slots_per_epoch):
+            sim.run_slot()
+            group = sim.groups[0]
+            store = group.store
+            want = fc.get_head(store)
+            assert group.resident.head(store) == want, \
+                f"divergence at slot {sim.slot - 1} (seed {seed})"
+        assert sim.metrics[-1]["n_blocks"] > 1  # chain actually grew
+
+    def test_finalizes_and_no_rebuild_between_epochs(self):
+        """Honest run: epochs finalize through the resident path, and head
+        queries between rebuild events do not re-densify (the round-2
+        missing-integration complaint: no per-query host rebuild)."""
+        from pos_evolution_tpu.sim import Simulation
+        sim = Simulation(64, accelerated_forkchoice=True)
+        resident = sim.groups[0].resident
+        calls = {"n": 0}
+        orig = resident.rebuild
+
+        def counting_rebuild(store):
+            calls["n"] += 1
+            return orig(store)
+
+        resident.rebuild = counting_rebuild
+        sim.run_epochs(4)
+        assert sim.finalized_epoch() >= 1
+        # rebuild events: epoch rollovers + justified/finalized movement +
+        # capacity doublings — far fewer than head queries
+        n_queries = sim.trace_summary()["get_head"]["count"]
+        assert calls["n"] < n_queries / 3, \
+            f"{calls['n']} rebuilds for {n_queries} head queries"

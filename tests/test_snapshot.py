@@ -115,6 +115,7 @@ class TestDenseCheckpoints:
             assert np.array_equal(np.asarray(getattr(reg, f)),
                                   np.asarray(getattr(back, f))), f
 
+    @pytest.mark.mesh8
     def test_orbax_restore_onto_mesh(self, tmp_path):
         """Restore re-places arrays sharded over the *current* mesh."""
         jax = pytest.importorskip("jax")
