@@ -1,0 +1,240 @@
+"""BLS12-381 base-field arithmetic on TPU lanes (component N1, layer 0).
+
+The reference's signature layer is real BLS12-381 in every deployment
+(``bls.Verify`` pos-evolution.md:165, aggregate attestation signatures
+:714-717, sync aggregates :642). SURVEY.md §2.7 N1 mandates the pairing
+as a *device* kernel: Fp elements as fixed-width limb vectors in int32
+lanes, batched over attestations.
+
+Design — idiomatic TPU, not a bignum-library port:
+
+- **Radix 2^12, 32 limbs** (384 bits ≥ 381). Limb products are < 2^24,
+  so a full 32-term convolution column sum stays < 2^29 — comfortably
+  inside int32, the widest integer multiply the VPU natively runs
+  (no u64, no i128, unlike CPU bignum code).
+- **Plain domain + Barrett reduction** (no Montgomery): products are
+  digit convolutions (log-depth stacked-shift sums), and the quotient
+  estimate is two more convolutions against the precomputed
+  ``MU = floor(2^768 / p)``. Everything is data-parallel over limbs and
+  batch; there is *no sequential 32-step CIOS loop*, which matters
+  because a pairing chains ~30K field multiplies and the loop would
+  serialize on the VPU.
+- **Carry/borrow resolution in log depth**: large digits are folded with
+  3 local rounds (digit-sum bounds shrink 2^31 -> 2^12+1), then the
+  final single-bit carries ripple through a Kogge-Stone-style
+  carry-lookahead ``associative_scan`` over (generate, propagate) pairs
+  — 5 parallel rounds for 32 limbs, never a 32-step ripple.
+- **Lazy canonical form**: residues live in [0, 2p); multiplication
+  output lands there without any compare (Barrett remainder < 3p, one
+  conditional subtract of 2p), adds/subs re-enter it with one
+  conditional subtract. Equality canonicalizes with one more.
+
+Correctness oracle: ``crypto/bls12_381.py`` (pure-Python pairing, exact
+integers) — every op here is differential-tested against Python ints in
+``tests/test_fp_device.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from pos_evolution_tpu.crypto.bls12_381 import Q as P_INT  # noqa: E402
+
+BITS = 12
+MASK = (1 << BITS) - 1
+L = 32                       # limbs per element: 32 * 12 = 384 bits
+CONV = 2 * L - 1             # full-product digit count
+
+
+def to_limbs(x: int, n: int = L) -> np.ndarray:
+    """Python int -> little-endian base-2^12 digit vector (host side)."""
+    assert x >= 0
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= BITS
+    assert x == 0, "value does not fit in the limb vector"
+    return out
+
+
+def from_limbs(v) -> int:
+    """Digit vector -> Python int (host side; accepts unnormalized)."""
+    out = 0
+    for i, d in enumerate(np.asarray(v).tolist()):
+        out += int(d) << (BITS * i)
+    return out
+
+
+P = to_limbs(P_INT)
+TWO_P = to_limbs(2 * P_INT)              # 2p < 2^384: fits 32 limbs
+MU = to_limbs(2**768 // P_INT, 33)       # Barrett constant, 33 limbs
+ZERO = np.zeros(L, dtype=np.int32)
+ONE = to_limbs(1)
+
+
+# --- digit plumbing (all log-depth, batch-leading shapes [..., n]) ------------
+
+def conv_digits(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Full product in digit space: [..., m] x [..., n] -> [..., m+n-1]
+    column sums (each < #terms * 2^24 < 2^29). A stack of shifted partial
+    products reduced with one tree sum — no sequential accumulation."""
+    m = a.shape[-1]
+    n = b.shape[-1]
+    prods = a[..., :, None] * b[..., None, :]            # [..., m, n]
+    pad_cfg = [(0, 0)] * (prods.ndim - 2)
+    terms = [jnp.pad(prods[..., i, :], pad_cfg + [(i, m - 1 - i)])
+             for i in range(m)]
+    # explicit i32 accumulator: the column-sum bound (< 2^29) is proven,
+    # and letting x64 promote to int64 would both break scan carries and
+    # leave the VPU's native width
+    return jnp.stack(terms, 0).sum(0, dtype=jnp.int32)
+
+
+def carry_norm(x: jax.Array, out_len: int) -> jax.Array:
+    """Normalize arbitrary non-negative digit sums (< 2^31) to canonical
+    digits < 2^12 over ``out_len`` limbs. The represented *value* must fit
+    ``out_len`` digits (carries past the top limb are dropped); every
+    caller here guarantees that by construction (e.g. 4p^2 < 2^768 for the
+    64-limb full product).
+
+    3 local fold rounds shrink digits to <= 2^12; the remaining single-bit
+    carries resolve in one carry-lookahead ``associative_scan``
+    ((generate, propagate) composition — 5 parallel rounds), avoiding the
+    worst-case full ripple of repeated local folding (…FFF FFF + 1)."""
+    pad = out_len - x.shape[-1]
+    if pad > 0:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    elif pad < 0:
+        raise ValueError("carry_norm cannot truncate")
+    for _ in range(3):
+        c = x >> BITS
+        x = (x & MASK) + jnp.pad(c, [(0, 0)] * (x.ndim - 1) + [(1, 0)]
+                                 )[..., :out_len]
+    # digits now in [0, 2^12]; lookahead for the final 0/1 carries
+    g = x > MASK                      # generates a carry regardless of c_in
+    p = x == MASK                     # propagates an incoming carry
+
+    def compose(lo, hi):
+        g1, p1 = lo
+        g2, p2 = hi
+        return g2 | (p2 & g1), p2 & p1
+
+    gs, _ = jax.lax.associative_scan(compose, (g, p), axis=-1)
+    c_in = jnp.pad(gs, [(0, 0)] * (x.ndim - 1) + [(1, 0)])[..., :out_len]
+    return (x + c_in.astype(jnp.int32)) & MASK
+
+
+def sub_digits(x: jax.Array, y: jax.Array):
+    """(x - y, underflow) over canonical digit vectors of equal length.
+    Borrow resolution by the same lookahead composition — log depth."""
+    t = x - y                                  # digits in [-4095, 4095]
+    g = t < 0
+    p = t == 0
+
+    def compose(lo, hi):
+        g1, p1 = lo
+        g2, p2 = hi
+        return g2 | (p2 & g1), p2 & p1
+
+    gs, _ = jax.lax.associative_scan(compose, (g, p), axis=-1)
+    b_in = jnp.pad(gs, [(0, 0)] * (t.ndim - 1) + [(1, 0)])[..., : t.shape[-1]]
+    u = t - b_in.astype(jnp.int32)
+    d = u + ((u < 0).astype(jnp.int32) << BITS)
+    return d, gs[..., -1]
+
+
+def cond_sub(x: jax.Array, y: np.ndarray) -> jax.Array:
+    """x - y if x >= y else x (canonical digits in, canonical out)."""
+    d, uf = sub_digits(x, jnp.asarray(y))
+    return jnp.where(uf[..., None], x, d)
+
+
+# --- field ops: residues in [0, 2p), canonical digits -------------------------
+
+def barrett_reduce(x: jax.Array) -> jax.Array:
+    """Reduce a canonical-digit value x < 4p^2 (<= 64 limbs) to [0, 2p).
+
+    Digit Barrett with m = 32: q_hat = ((x >> 2^(12*31)) * MU) >> 2^(12*33)
+    satisfies q - 2 <= q_hat <= q, so r = x - q_hat * p < 3p and one
+    conditional subtract of 2p lands in [0, 2p)."""
+    n = x.shape[-1]
+    if n < 64:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, 64 - n)])
+    x_hi = x[..., 31:]                                       # 33 digits
+    q1 = carry_norm(conv_digits(x_hi, jnp.asarray(MU)), 66)
+    q_hat = q1[..., 33:65]                                   # 32 digits
+    qp = carry_norm(conv_digits(q_hat, jnp.asarray(P)), 64)
+    r, uf = sub_digits(x, qp)
+    # r < 3p < 2^383: upper digits are zero by construction
+    r = r[..., :L]
+    return cond_sub(r, TWO_P)
+
+
+def modmul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a * b mod p (inputs/outputs in [0, 2p): 2p * 2p = 4p^2 < 2^384 * p,
+    inside Barrett's domain)."""
+    return barrett_reduce(carry_norm(conv_digits(a, b), 64))
+
+
+def modadd(a: jax.Array, b: jax.Array) -> jax.Array:
+    s = carry_norm(a + b, L)          # < 4p < 2^384: no spill digit
+    return cond_sub(s, TWO_P)
+
+
+def modsub(a: jax.Array, b: jax.Array) -> jax.Array:
+    d, uf = sub_digits(a, b)
+    # underflow: d holds a - b + 2^384; add 2p and drop the 2^384 carry-out
+    wrapped = carry_norm(d + jnp.asarray(TWO_P), L + 1)[..., :L]
+    return jnp.where(uf[..., None], wrapped, d)
+
+
+def modneg(a: jax.Array) -> jax.Array:
+    return modsub(jnp.asarray(ZERO), a)
+
+
+def canon(a: jax.Array) -> jax.Array:
+    """[0, 2p) -> [0, p): exact canonical form for equality/serialization."""
+    return cond_sub(a, P)
+
+
+def eq(a: jax.Array, b: jax.Array) -> jax.Array:
+    return (canon(a) == canon(b)).all(axis=-1)
+
+
+def is_zero(a: jax.Array) -> jax.Array:
+    return (canon(a) == 0).all(axis=-1)
+
+
+_P_MINUS_2_BITS = np.array(
+    [(P_INT - 2) >> i & 1 for i in range(P_INT.bit_length())][::-1],
+    dtype=bool)
+
+
+def modinv(a: jax.Array) -> jax.Array:
+    """a^(p-2) mod p by square-and-multiply over the static bit string of
+    p-2 (``lax.scan``: 380 steps, 2 multiplies each). Rare by design —
+    only tower inversions (one per final exponentiation) and affine
+    conversions reach it. Returns 0 for a = 0 (Fermat's convention)."""
+    one = jnp.broadcast_to(jnp.asarray(ONE), a.shape).astype(jnp.int32)
+
+    def step(acc, bit):
+        acc = modmul(acc, acc)
+        acc = jnp.where(bit, modmul(acc, a), acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, one, jnp.asarray(_P_MINUS_2_BITS))
+    return acc
+
+
+modmul_jit = jax.jit(modmul)
+modadd_jit = jax.jit(modadd)
+modsub_jit = jax.jit(modsub)
+modinv_jit = jax.jit(modinv)

@@ -1,0 +1,142 @@
+"""Differential tests: device limb-vector Fp arithmetic (ops/fp.py) vs
+exact Python integers — the base layer of the BLS12-381 pairing kernel
+(SURVEY.md §2.7 N1). Randomized batches plus adversarial boundary values
+(0, 1, p-1, p, 2p-1, values with long FFF... carry ripples).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pos_evolution_tpu.crypto.bls12_381 import Q as P_INT  # noqa: E402
+from pos_evolution_tpu.ops import fp  # noqa: E402
+
+
+def rand_residues(rng, n, bound=None):
+    """n random values in [0, bound) as (ints, limb array)."""
+    bound = bound if bound is not None else 2 * P_INT
+    vals = [int.from_bytes(rng.bytes(48), "big") % bound for _ in range(n)]
+    arr = np.stack([fp.to_limbs(v) for v in vals])
+    return vals, jax.numpy.asarray(arr)
+
+
+EDGE = [0, 1, 2, P_INT - 1, P_INT, P_INT + 1, 2 * P_INT - 1,
+        (1 << 372) - 1, ((1 << 384) - 1) % (2 * P_INT)]
+
+
+class TestLimbCodec:
+    def test_roundtrip(self):
+        rng = np.random.default_rng(0)
+        for v in EDGE + [int.from_bytes(rng.bytes(48), "big") % (2 * P_INT)
+                         for _ in range(20)]:
+            assert fp.from_limbs(fp.to_limbs(v)) == v
+
+    def test_carry_norm_ripple(self):
+        """The pathological all-FFF ripple that defeats bounded local
+        folding must resolve exactly through the lookahead."""
+        import jax.numpy as jnp
+        x = np.full(32, fp.MASK, dtype=np.int32)
+        x[0] = fp.MASK + 1  # forces a carry that ripples through every limb
+        got = np.asarray(fp.carry_norm(jnp.asarray(x)[None, :], 33))[0]
+        assert fp.from_limbs(got) == fp.from_limbs(x)
+
+    def test_carry_norm_large_digits(self):
+        """Digit sums up to 2^29 (the conv-column bound); the top digit is
+        kept small so the value honours the out_len contract, as every
+        real convolution output does (4p^2 < 2^768)."""
+        import jax.numpy as jnp
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, 2**29, (8, 63), dtype=np.int64).astype(np.int32)
+        x[:, -1] = rng.integers(0, 2**19, 8)
+        got = np.asarray(fp.carry_norm(jnp.asarray(x), 64))
+        for i in range(8):
+            assert fp.from_limbs(got[i]) == fp.from_limbs(x[i])
+            assert got[i].max() <= fp.MASK
+
+
+class TestFieldOps:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_mul_matches_python(self, seed):
+        rng = np.random.default_rng(seed)
+        va, a = rand_residues(rng, 64)
+        vb, b = rand_residues(rng, 64)
+        got = np.asarray(fp.modmul_jit(a, b))
+        for i in range(64):
+            assert fp.from_limbs(got[i]) % P_INT == (va[i] * vb[i]) % P_INT
+            assert fp.from_limbs(got[i]) < 2 * P_INT
+
+    def test_mul_edge_values(self):
+        import jax.numpy as jnp
+        vals = EDGE
+        arr = jnp.asarray(np.stack([fp.to_limbs(v) for v in vals]))
+        n = len(vals)
+        got = np.asarray(fp.modmul_jit(arr[:, None, :].repeat(n, 1).reshape(n * n, -1),
+                                       arr[None, :, :].repeat(n, 0).reshape(n * n, -1)))
+        k = 0
+        for va in vals:
+            for vb in vals:
+                assert fp.from_limbs(got[k]) % P_INT == (va * vb) % P_INT, (va, vb)
+                assert fp.from_limbs(got[k]) < 2 * P_INT
+                k += 1
+
+    @pytest.mark.parametrize("op,pyop", [
+        ("modadd", lambda a, b: a + b),
+        ("modsub", lambda a, b: a - b),
+    ])
+    def test_add_sub(self, op, pyop):
+        rng = np.random.default_rng(7)
+        va, a = rand_residues(rng, 64)
+        vb, b = rand_residues(rng, 64)
+        got = np.asarray(jax.jit(getattr(fp, op))(a, b))
+        for i in range(64):
+            assert fp.from_limbs(got[i]) % P_INT == pyop(va[i], vb[i]) % P_INT
+            assert fp.from_limbs(got[i]) < 2 * P_INT
+
+    def test_neg_canon_eq(self):
+        rng = np.random.default_rng(9)
+        va, a = rand_residues(rng, 16)
+        neg = np.asarray(jax.jit(fp.modneg)(a))
+        for i in range(16):
+            assert fp.from_limbs(neg[i]) % P_INT == (-va[i]) % P_INT
+        can = np.asarray(jax.jit(fp.canon)(a))
+        for i in range(16):
+            assert fp.from_limbs(can[i]) == va[i] % P_INT
+        # eq across non-canonical representatives: v and v + p compare equal
+        vplus = jax.numpy.asarray(np.stack(
+            [fp.to_limbs((v % P_INT) + P_INT) for v in va]))
+        assert np.asarray(jax.jit(fp.eq)(a, vplus)).all()
+
+    def test_inv(self):
+        rng = np.random.default_rng(3)
+        va, a = rand_residues(rng, 8)
+        got = np.asarray(fp.modinv_jit(a))
+        for i in range(8):
+            inv = fp.from_limbs(got[i]) % P_INT
+            assert (inv * va[i]) % P_INT == 1 if va[i] % P_INT != 0 else inv == 0
+
+    def test_inv_zero(self):
+        import jax.numpy as jnp
+        z = jnp.asarray(fp.ZERO)[None, :]
+        assert fp.from_limbs(np.asarray(fp.modinv_jit(z))[0]) % P_INT == 0
+
+    def test_long_chain_stays_reduced(self):
+        """1000 chained muls/adds keep residues in [0, 2p) and match
+        Python — guards against bound-tracking mistakes accumulating."""
+        import jax.numpy as jnp
+        rng = np.random.default_rng(11)
+        v, x = rand_residues(rng, 4)
+        acc_v = [1] * 4
+        acc = jnp.asarray(np.stack([fp.to_limbs(1)] * 4))
+
+        @jax.jit
+        def step(acc, x):
+            return fp.modadd(fp.modmul(acc, x), x)
+
+        for _ in range(1000):
+            acc = step(acc, x)
+            acc_v = [(a * b + b) % P_INT for a, b in zip(acc_v, v)]
+        got = np.asarray(acc)
+        for i in range(4):
+            assert fp.from_limbs(got[i]) % P_INT == acc_v[i]
+            assert fp.from_limbs(got[i]) < 2 * P_INT
