@@ -12,13 +12,20 @@ Workload per epoch (the reference's own protocol shape):
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
 vs_baseline = (1 s target) / measured — >1 means faster than the north-star
 target of <1 s on a TPU v5e (BASELINE.json).
+
+Measurement methodology (revised in round 3 after discovering that
+``jax.block_until_ready`` does NOT synchronize through the axon relay in
+its default mode — timings taken that way measure enqueue latency, not
+execution, and the r1/r2 recorded numbers are invalid for the TPU path):
+
+see ``pos_evolution_tpu/utils/benchtime.py`` (the shared implementation of
+the fused-loop work-difference recipe) for the details.
 """
 
 import json
 import os
 import subprocess
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -27,11 +34,14 @@ import numpy as np
 
 def _probe_accelerator(timeout_s: int = 90) -> bool:
     """Check the accelerator tunnel is alive in a subprocess (a wedged
-    tunnel makes jax.devices() hang forever; never hang the bench)."""
+    tunnel makes jax.devices() hang forever; never hang the bench).
+    A real round-trip transfer is the probe — device enumeration alone
+    can succeed while the execution path hangs."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
-             "import jax; d=jax.devices(); import sys; "
+             "import jax, numpy, jax.numpy as jnp; d=jax.devices(); "
+             "numpy.asarray(jnp.arange(4) + 1); import sys; "
              "sys.exit(0 if d and d[0].platform != 'cpu' else 3)"],
             timeout=timeout_s, capture_output=True)
         return proc.returncode == 0
@@ -53,8 +63,13 @@ def main():
     from pos_evolution_tpu.ops.aggregation import aggregate_verify_batch
     from pos_evolution_tpu.ops.epoch import DenseRegistry, process_epoch_dense
     from pos_evolution_tpu.ops.forkchoice import DenseStore, head_and_weights
+    from pos_evolution_tpu.utils.benchtime import checksum_tree, fused_measure
 
     on_accel = jax.default_backend() not in ("cpu",)
+    # Per-invocation entropy folded into every salt: the relay's execution
+    # cache persists ACROSS processes, so fixed salts + a fixed rng seed
+    # would replay prior runs' results after the first invocation ever.
+    entropy = int.from_bytes(os.urandom(3), "little")
     n = 1_000_000 if on_accel else 65_536  # CPU smoke-run scales down
     slots = 32
     committees_per_slot = 64
@@ -104,68 +119,50 @@ def main():
         boost_amount=jnp.int64(32 * gwei * (n // 32) // 4),
     )
 
-    # Race the XLA aggregation kernel against the Pallas per-committee
-    # kernel during warmup (salted inputs); keep whichever is faster on this
-    # backend, falling back to XLA if Mosaic rejects the Pallas lowering.
-    agg_impl = aggregate_verify_batch
-    impl_name = "xla"
+    def epoch_body(agg_fn):
+        """One salted epoch: aggregation + 32 head passes + epoch sweep,
+        every output folded into the i32 accumulator (checksum_tree uses
+        full reductions so no stage dead-code-eliminates)."""
+
+        def one_epoch(salt, acc):
+            ok = agg_fn(pk_states, committees, agg_bits,
+                        messages.at[0, 0].set(salt.astype(jnp.uint32)),
+                        signatures)
+            acc = acc + ok.sum().astype(jnp.int32)
+
+            def head_body(s, a):
+                t = salt.astype(jnp.int64) * slots + s
+                st = store._replace(
+                    msg_epoch=store.msg_epoch.at[0].set(t),
+                    boost_idx=(t % capacity).astype(jnp.int32))
+                h, w = head_and_weights(st, capacity)
+                return a + h.astype(jnp.int32) + checksum_tree(w)
+
+            acc = jax.lax.fori_loop(0, slots, head_body, acc)
+            out = process_epoch_dense(
+                reg._replace(balance=reg.balance.at[0].set(
+                    31 * gwei + salt.astype(jnp.int64))),
+                10, 8, bits, 8, 9, 0, cfg)
+            return acc + checksum_tree(out)
+
+        return one_epoch
+
+    best = fused_measure(epoch_body(aggregate_verify_batch),
+                         entropy=entropy, tag="xla aggregation")
     if on_accel:
+        # Race the Pallas per-committee aggregation kernel; keep the faster,
+        # falling back to XLA if Mosaic rejects the lowering.
         try:
             from pos_evolution_tpu.ops.pallas_aggregation import (
                 aggregate_verify_batch_pallas_jit,
             )
-
-            def _time(fn, salt0):
-                jax.block_until_ready(fn(
-                    pk_states, committees, agg_bits,
-                    messages.at[0, 0].set(np.uint32(salt0)), signatures))
-                best = float("inf")
-                for k in range(1, 4):  # min over 3 reps: robust to hiccups
-                    t0 = time.perf_counter()
-                    jax.block_until_ready(fn(
-                        pk_states, committees, agg_bits,
-                        messages.at[0, 0].set(np.uint32(salt0 + k)), signatures))
-                    best = min(best, time.perf_counter() - t0)
-                return best
-
-            t_xla = _time(aggregate_verify_batch, 100)
-            t_pl = _time(aggregate_verify_batch_pallas_jit, 200)
-            if t_pl < t_xla:
-                agg_impl = aggregate_verify_batch_pallas_jit
-                impl_name = "pallas"
-            print(f"# aggregation impl race: xla={t_xla*1e3:.1f}ms "
-                  f"pallas={t_pl*1e3:.1f}ms -> {impl_name}", file=sys.stderr)
+            t_pl = fused_measure(epoch_body(aggregate_verify_batch_pallas_jit),
+                                 entropy=entropy, tag="pallas aggregation")
+            best = min(best, t_pl)
         except Exception as e:  # Mosaic lowering/compile failure: keep XLA
             print(f"# pallas aggregation unavailable: {e!r:.120}", file=sys.stderr)
 
-    def one_epoch(salt: int):
-        # Inputs vary with `salt` so no execution-cache layer (e.g. the axon
-        # relay) can replay results; costs are unchanged.
-        outs = []
-        outs.append(agg_impl(
-            pk_states, committees, agg_bits,
-            messages.at[0, 0].set(np.uint32(salt)), signatures))
-        for s in range(slots):
-            st = store._replace(
-                msg_epoch=store.msg_epoch.at[0].set(np.int64(salt * slots + s)),
-                boost_idx=jnp.int32((salt * slots + s) % capacity))
-            h, w = head_and_weights(st, capacity)
-            outs.append(h)
-        outs.append(process_epoch_dense(
-            reg._replace(balance=reg.balance.at[0].set(np.int64(31 * gwei + salt))),
-            10, 8, bits, 8, 9, 0, cfg))
-        return outs
-
-    # warmup / compile
-    jax.block_until_ready(one_epoch(0))
-    # measure
-    reps = 3
-    times = []
-    for r in range(1, reps + 1):
-        t0 = time.perf_counter()
-        jax.block_until_ready(one_epoch(r))
-        times.append(time.perf_counter() - t0)
-    t = float(np.median(times))
+    t = float(best)
     if not on_accel:
         # normalize the CPU smoke-run to the full validator count so the
         # metric stays comparable in spirit (linear in n)

@@ -8,10 +8,12 @@ lanes, batched over attestations.
 
 Design — idiomatic TPU, not a bignum-library port:
 
-- **Radix 2^12, 32 limbs** (384 bits ≥ 381). Limb products are < 2^24,
-  so a full 32-term convolution column sum stays < 2^29 — comfortably
-  inside int32, the widest integer multiply the VPU natively runs
-  (no u64, no i128, unlike CPU bignum code).
+- **Radix 2^12, 32 limbs** (384 bits ≥ 381). Limb products are
+  ≤ (2^12-1)^2, so even the widest convolution column here (33 terms in
+  the Barrett step) sums to 33·(2^12-1)^2 < 2^30 — inside int32, the
+  widest integer multiply the VPU natively runs (no u64, no i128, unlike
+  CPU bignum code). NOTE: raising BITS to 13 would overflow (33·(2^13-1)^2
+  > 2^31).
 - **Plain domain + Barrett reduction** (no Montgomery): products are
   digit convolutions (log-depth stacked-shift sums), and the quotient
   estimate is two more convolutions against the precomputed
@@ -35,8 +37,6 @@ integers) — every op here is differential-tested against Python ints in
 """
 
 from __future__ import annotations
-
-from functools import partial
 
 import numpy as np
 
@@ -82,6 +82,14 @@ ONE = to_limbs(1)
 
 # --- digit plumbing (all log-depth, batch-leading shapes [..., n]) ------------
 
+def _gp_compose(lo, hi):
+    """(generate, propagate) composition for carry/borrow lookahead —
+    the associative operator of a Kogge-Stone scan."""
+    g1, p1 = lo
+    g2, p2 = hi
+    return g2 | (p2 & g1), p2 & p1
+
+
 def conv_digits(a: jax.Array, b: jax.Array) -> jax.Array:
     """Full product in digit space: [..., m] x [..., n] -> [..., m+n-1]
     column sums (each < #terms * 2^24 < 2^29). A stack of shifted partial
@@ -92,9 +100,9 @@ def conv_digits(a: jax.Array, b: jax.Array) -> jax.Array:
     pad_cfg = [(0, 0)] * (prods.ndim - 2)
     terms = [jnp.pad(prods[..., i, :], pad_cfg + [(i, m - 1 - i)])
              for i in range(m)]
-    # explicit i32 accumulator: the column-sum bound (< 2^29) is proven,
-    # and letting x64 promote to int64 would both break scan carries and
-    # leave the VPU's native width
+    # explicit i32 accumulator: the column-sum bound (< 2^30 at the widest
+    # 33-term Barrett column) is proven, and letting x64 promote to int64
+    # would both break scan carries and leave the VPU's native width
     return jnp.stack(terms, 0).sum(0, dtype=jnp.int32)
 
 
@@ -121,13 +129,7 @@ def carry_norm(x: jax.Array, out_len: int) -> jax.Array:
     # digits now in [0, 2^12]; lookahead for the final 0/1 carries
     g = x > MASK                      # generates a carry regardless of c_in
     p = x == MASK                     # propagates an incoming carry
-
-    def compose(lo, hi):
-        g1, p1 = lo
-        g2, p2 = hi
-        return g2 | (p2 & g1), p2 & p1
-
-    gs, _ = jax.lax.associative_scan(compose, (g, p), axis=-1)
+    gs, _ = jax.lax.associative_scan(_gp_compose, (g, p), axis=-1)
     c_in = jnp.pad(gs, [(0, 0)] * (x.ndim - 1) + [(1, 0)])[..., :out_len]
     return (x + c_in.astype(jnp.int32)) & MASK
 
@@ -136,15 +138,9 @@ def sub_digits(x: jax.Array, y: jax.Array):
     """(x - y, underflow) over canonical digit vectors of equal length.
     Borrow resolution by the same lookahead composition — log depth."""
     t = x - y                                  # digits in [-4095, 4095]
-    g = t < 0
-    p = t == 0
-
-    def compose(lo, hi):
-        g1, p1 = lo
-        g2, p2 = hi
-        return g2 | (p2 & g1), p2 & p1
-
-    gs, _ = jax.lax.associative_scan(compose, (g, p), axis=-1)
+    g = t < 0                                  # generates a borrow
+    p = t == 0                                 # propagates an incoming borrow
+    gs, _ = jax.lax.associative_scan(_gp_compose, (g, p), axis=-1)
     b_in = jnp.pad(gs, [(0, 0)] * (t.ndim - 1) + [(1, 0)])[..., : t.shape[-1]]
     u = t - b_in.astype(jnp.int32)
     d = u + ((u < 0).astype(jnp.int32) << BITS)

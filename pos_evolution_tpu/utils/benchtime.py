@@ -1,0 +1,82 @@
+"""Honest device timing on the axon relay (and any async JAX backend).
+
+Discovered in round 3: ``jax.block_until_ready`` does NOT synchronize
+through the axon relay in its default mode — it returns at enqueue, so
+naive timings measure dispatch latency regardless of workload. Only a
+device->host transfer truly syncs, and the first transfer switches the
+process into a synchronous mode with a ~70-90 ms round-trip per dispatch.
+
+The one honest recipe, shared by ``bench.py`` and
+``scripts/pallas_tpu_evidence.py`` so it cannot drift:
+
+- fuse K iterations of the workload into ONE jitted ``lax.fori_loop``
+  whose body folds a per-iteration salt into the inputs (the relay's
+  execution cache persists across processes, so callers must pass
+  per-invocation ``os.urandom`` entropy);
+- every timed call ends in a transfer of an i32 checksum that every
+  output feeds (full reductions, not element picks — XLA's simplifier
+  moves slices through elementwise ops and would shrink the work);
+- report the work-difference ``(t(K_hi) - t(1)) / (K_hi - 1)``, which
+  cancels the fixed per-call round-trip out of the number.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def checksum_tree(out) -> jax.Array:
+    """i32 checksum covering EVERY element of every leaf (wraparound sums:
+    a slice-through-elementwise rewrite cannot eliminate the work)."""
+    acc = jnp.int32(0)
+    for leaf in jax.tree_util.tree_leaves(out):
+        # sum(dtype=...) keeps the accumulator i32 even under x64's
+        # numpy-style promotion (wraparound is fine for a checksum)
+        acc = acc + leaf.ravel().sum(dtype=jnp.int32)
+    return acc
+
+
+def fused_measure(body, *, k_hi: int = 4, entropy: int | None = None,
+                  tag: str = "", reps: int = 2) -> float:
+    """Per-iteration seconds for ``body(salt_i32, acc_i32) -> acc_i32``.
+
+    ``body`` must fold ``salt`` into its inputs and fold all its outputs
+    into the returned accumulator (use ``checksum_tree``).
+    """
+    ent = entropy if entropy is not None else \
+        int.from_bytes(os.urandom(3), "little")
+
+    @jax.jit
+    def run(k, salt0):
+        def step(i, acc):
+            return body(salt0 + i, acc)
+        return jax.lax.fori_loop(0, k, step, jnp.int32(0))
+
+    def t_of(k: int, salt0: int) -> float:
+        t0 = time.perf_counter()
+        np.asarray(run(jnp.int32(k), jnp.int32(salt0)))  # transfer = sync
+        return time.perf_counter() - t0
+
+    t_of(1, ent)                                         # compile + warm
+    t1 = min(t_of(1, ent + 11 + r) for r in range(reps))
+    thi = min(t_of(k_hi, ent + 21 + r) for r in range(reps))
+    per = (thi - t1) / (k_hi - 1)
+    if per <= 0:
+        # Jitter swamped the added work: fall back to the conservative
+        # upper bound (includes the round-trip) and say so loudly rather
+        # than report a bogus sub-nanosecond number.
+        print(f"# benchtime WARNING [{tag}]: non-positive work-difference "
+              f"(t1={t1*1e3:.1f}ms t{k_hi}={thi*1e3:.1f}ms); reporting the "
+              f"round-trip-inclusive upper bound", file=sys.stderr)
+        return thi / k_hi
+    if tag:
+        print(f"# {tag}: t1={t1*1e3:.1f}ms t{k_hi}={thi*1e3:.1f}ms "
+              f"-> {per*1e3:.2f}ms/iter", file=sys.stderr)
+    return per

@@ -1,0 +1,138 @@
+"""On-TPU Pallas evidence (VERDICT r2 task 6): time the two Pallas kernels
+against their XLA twins at several shapes, assert parity, and record the
+result as an artifact (PALLAS_TPU_r03.json).
+
+Methodology: the shared fused-loop work-difference recipe in
+``pos_evolution_tpu/utils/benchtime.py`` (``block_until_ready`` does not
+sync on the axon relay; see that module's docstring).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pos_evolution_tpu.utils.benchtime import fused_measure
+
+from pos_evolution_tpu.crypto.bls import FakeBLS
+from pos_evolution_tpu.ops.aggregation import (
+    aggregate_verify_batch,
+    messages_to_words,
+    pack_signature_words,
+    precompute_pk_states,
+)
+from pos_evolution_tpu.ops.pallas_aggregation import (
+    aggregate_verify_batch_pallas_jit,
+)
+from pos_evolution_tpu.ops.pallas_sha256 import merkle_level_pallas
+from pos_evolution_tpu.ops.sha256 import sha256_pair_words
+
+def measure(kernel_of_salt, checksum, tag=""):
+    """Per-iteration seconds for ``kernel_of_salt(salt) -> array``."""
+    return fused_measure(
+        lambda salt, acc: acc + checksum(kernel_of_salt(salt)),
+        k_hi=9, tag=tag)
+
+
+def merkle_case(n_pairs: int, rng) -> dict:
+    msgs = rng.integers(0, 2**32, (16, n_pairs), dtype=np.uint64).astype(np.uint32)
+    pairs_t = jnp.asarray(msgs)
+    nodes = jnp.asarray(msgs.T.reshape(2 * n_pairs, 8))
+
+    csum = lambda out: out.sum(dtype=jnp.int32)    # noqa: E731
+    t_pl = measure(
+        lambda s: merkle_level_pallas(pairs_t.at[0, 0].set(s.astype(jnp.uint32))),
+        csum, tag=f"merkle_pallas@{n_pairs}")
+    t_xla = measure(
+        lambda s: sha256_pair_words(
+            nodes.at[0, 0].set(s.astype(jnp.uint32))[0::2], nodes[1::2]),
+        csum, tag=f"merkle_xla@{n_pairs}")
+
+    # parity on identical message bytes through both paths
+    got_pl = np.asarray(merkle_level_pallas(pairs_t)).T
+    got_xla = np.asarray(jax.jit(sha256_pair_words)(nodes[0::2], nodes[1::2]))
+    return {"kernel": "merkle_level", "n_pairs": n_pairs,
+            "pallas_ms": round(t_pl * 1e3, 3), "xla_ms": round(t_xla * 1e3, 3),
+            "parity_ok": bool((got_pl == got_xla).all())}
+
+
+def aggregation_case(n_aggs: int, lanes: int, n_val: int, rng) -> dict:
+    pubkeys = np.stack([np.frombuffer(FakeBLS.SkToPk(i + 1), np.uint8)
+                        for i in range(256)])
+    # synthetic pk states for the full registry (timing only needs shape);
+    # parity below uses a real signed sub-batch
+    pk_states = jnp.asarray(
+        rng.integers(0, 2**32, (n_val, 8), dtype=np.uint64).astype(np.uint32))
+    committees = jnp.asarray(
+        rng.integers(0, n_val, (n_aggs, lanes)).astype(np.int32))
+    bits = jnp.asarray(rng.random((n_aggs, lanes)) < 0.99)
+    messages = jnp.asarray(
+        rng.integers(0, 2**32, (n_aggs, 8), dtype=np.uint64).astype(np.uint32))
+    signatures = jnp.asarray(
+        rng.integers(0, 2**32, (n_aggs, 24), dtype=np.uint64).astype(np.uint32))
+
+    def run(impl, tag):
+        return measure(
+            lambda s: impl(pk_states, committees, bits,
+                           messages.at[0, 0].set(s.astype(jnp.uint32)),
+                           signatures),
+            lambda ok: ok.sum(dtype=jnp.int32),
+            tag=f"agg_{tag}@{n_aggs}x{lanes}")
+
+    t_xla = run(aggregate_verify_batch, "xla")
+    t_pl = run(aggregate_verify_batch_pallas_jit, "pallas")
+
+    # parity: a genuinely signed batch must verify on both paths
+    A, C = 4, 16
+    st = precompute_pk_states(pubkeys)
+    comm = rng.permutation(256)[: A * C].reshape(A, C).astype(np.int32)
+    msgs = rng.integers(0, 255, (A, 32)).astype(np.uint8)
+    sigs = [FakeBLS.Aggregate(
+        [FakeBLS._sig_for(pubkeys[v].tobytes(), msgs[a].tobytes())
+         for v in comm[a]]) for a in range(A)]
+    args = (st, jnp.asarray(comm), jnp.ones((A, C), bool),
+            jnp.asarray(messages_to_words(msgs)),
+            jnp.asarray(pack_signature_words(sigs)))
+    ok_x = np.asarray(aggregate_verify_batch(*args))
+    ok_p = np.asarray(aggregate_verify_batch_pallas_jit(*args))
+    return {"kernel": "fakebls_aggregation", "n_aggregates": n_aggs,
+            "lanes": lanes, "registry": n_val,
+            "pallas_ms": round(t_pl * 1e3, 3), "xla_ms": round(t_xla * 1e3, 3),
+            "parity_ok": bool(ok_x.all() and ok_p.all()
+                              and (ok_x == ok_p).all())}
+
+
+def main():
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    out = {
+        "round": 3,
+        "backend": jax.default_backend(),
+        "device": str(dev),
+        "note": ("fake-crypto aggregation pipeline (SHA/XOR FakeBLS), not "
+                 "real BLS pairings; merkle kernel is real SHA-256. Times "
+                 "are per-iteration work-differences of a fused K-loop "
+                 "(see module docstring)."),
+        "cases": [],
+    }
+    for n in (512, 4096, 32768):
+        out["cases"].append(merkle_case(n, rng))
+        print(out["cases"][-1], file=sys.stderr)
+    for n_aggs, lanes, n_val in ((256, 128, 65_536), (2048, 512, 1_000_000)):
+        out["cases"].append(aggregation_case(n_aggs, lanes, n_val, rng))
+        print(out["cases"][-1], file=sys.stderr)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PALLAS_TPU_r03.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
