@@ -92,18 +92,38 @@ def _gp_compose(lo, hi):
 
 def conv_digits(a: jax.Array, b: jax.Array) -> jax.Array:
     """Full product in digit space: [..., m] x [..., n] -> [..., m+n-1]
-    column sums (each < #terms * 2^24 < 2^29). A stack of shifted partial
-    products reduced with one tree sum — no sequential accumulation."""
+    column sums (each <= 33 * (2^12-1)^2 < 2^30, inside int32 — the i32
+    accumulation is explicit; x64 promotion to int64 would break scan
+    carries and leave the VPU's native width).
+
+    Formulated as the outer product followed by ONE matmul against a
+    static 0/1 anti-diagonal-selector matrix instead of m padded partial
+    products: the graph is 2 ops, so deep compositions (a pairing is
+    ~30K of these) stay compilable, and the contraction is matmul-shaped
+    for the MXU."""
     m = a.shape[-1]
     n = b.shape[-1]
-    prods = a[..., :, None] * b[..., None, :]            # [..., m, n]
-    pad_cfg = [(0, 0)] * (prods.ndim - 2)
-    terms = [jnp.pad(prods[..., i, :], pad_cfg + [(i, m - 1 - i)])
-             for i in range(m)]
-    # explicit i32 accumulator: the column-sum bound (< 2^30 at the widest
-    # 33-term Barrett column) is proven, and letting x64 promote to int64
-    # would both break scan carries and leave the VPU's native width
-    return jnp.stack(terms, 0).sum(0, dtype=jnp.int32)
+    outer = a[..., :, None] * b[..., None, :]        # broadcasts batch dims
+    prods = outer.reshape(outer.shape[:-2] + (m * n,))
+    sel = jnp.asarray(_conv_selector(m, n))
+    return jnp.einsum("...p,pk->...k", prods, sel,
+                      preferred_element_type=jnp.int32)
+
+
+_CONV_SELECTORS: dict = {}
+
+
+def _conv_selector(m: int, n: int) -> np.ndarray:
+    """Static [m*n, m+n-1] 0/1 matrix: entry ((i, j), k) = [i + j == k].
+    Cached as numpy (a jnp constant created inside a trace would leak)."""
+    key = (m, n)
+    if key not in _CONV_SELECTORS:
+        i = np.arange(m)[:, None, None]
+        j = np.arange(n)[None, :, None]
+        k = np.arange(m + n - 1)[None, None, :]
+        _CONV_SELECTORS[key] = (i + j == k).reshape(
+            m * n, m + n - 1).astype(np.int32)
+    return _CONV_SELECTORS[key]
 
 
 def carry_norm(x: jax.Array, out_len: int) -> jax.Array:
@@ -156,7 +176,13 @@ def cond_sub(x: jax.Array, y: np.ndarray) -> jax.Array:
 # --- field ops: residues in [0, 2p), canonical digits -------------------------
 
 def barrett_reduce(x: jax.Array) -> jax.Array:
-    """Reduce a canonical-digit value x < 4p^2 (<= 64 limbs) to [0, 2p).
+    """Reduce a canonical-digit value x < p * 2^384 (<= 64 limbs) to
+    [0, 2p). Two constraints meet at that bound: the classical q_hat
+    error q-2 <= q_hat <= q holds for x < b^(2k) = 2^768 (HAC 14.42-43,
+    p a k=32-digit modulus), and this implementation's quotient window
+    (q1[..., 33:65], 32 digits) requires q = floor(x/p) < 2^384.
+    Callers range from 4p^2 full products to ~50p linear-combination
+    folds — all far inside p * 2^384 (~2^765).
 
     Digit Barrett with m = 32: q_hat = ((x >> 2^(12*31)) * MU) >> 2^(12*33)
     satisfies q - 2 <= q_hat <= q, so r = x - q_hat * p < 3p and one

@@ -1,0 +1,364 @@
+"""BLS12-381 extension-field tower on TPU lanes (component N1, layer 0).
+
+Builds Fq2 -> Fq6 -> Fq12 on top of the base-field limb arithmetic in
+``ops/fp.py``, mirroring the oracle tower in ``crypto/bls12_381.py``
+(same irreducibles: u^2 = -1, v^3 = u+1, w^2 = v) so every op is
+differential-testable against exact Python integers.
+
+Representation — ONE dense array per element, not nested objects:
+
+- Fq element: int32[..., 32] limbs, residues in [0, 2p) (fp.py's domain)
+- Fq2  = [..., 2, 32], Fq6 = [..., 6, 32], Fq12 = [..., 12, 32]
+  component order = the nested tower flattened:
+  Fq12 slot (part, vpow, upart) -> index part*6 + vpow*2 + upart,
+  i.e. (a.c0.a, a.c0.b, a.c1.a, ..., b.c2.b).
+
+Multiplication is ONE *stacked* base-field mul over all component pairs
+plus two static einsums against the algebra's structure tensor T
+(T[i,j,k] = Fq-coefficient of e_k in e_i * e_j), derived at import time
+by multiplying oracle basis elements — no hand-written tower formulas to
+get wrong, a ~40x smaller XLA graph than composing scalar field ops
+(which XLA:CPU cannot compile at Fq12 depth), and every op is a wide
+batched limb kernel, which is exactly the shape the TPU VPU/MXU wants.
+
+Signed recombination avoids negative digit vectors by adding a static
+multiple of p before subtracting the negative part, then one Barrett
+reduction lands each output component back in [0, 2p); all bounds are
+asserted at tensor-construction time, not assumed.
+
+Frobenius maps use host-precomputed gamma constants
+gamma_k[i] = xi^(i * (q^k - 1) / 6) over the w-power basis, computed
+exactly with the oracle at import time.
+
+Cited reference surface: pos-evolution.md:165 (bls.Verify), :714-717
+(aggregate attestation signatures), :642 (sync aggregates); SURVEY.md
+§2.7 N1 mandates this as a device kernel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pos_evolution_tpu.crypto import bls12_381 as oracle
+from pos_evolution_tpu.ops import fp
+
+Q = oracle.Q
+
+# --- structure tensors, derived from the oracle -------------------------------
+
+
+def _fq12_from_coeffs(c: list) -> "oracle.Fq12":
+    f2 = [oracle.Fq2(c[2 * i], c[2 * i + 1]) for i in range(6)]
+    return oracle.Fq12(oracle.Fq6(*f2[:3]), oracle.Fq6(*f2[3:]))
+
+
+def _fq12_to_coeffs(x: "oracle.Fq12") -> list:
+    out = []
+    for part in (x.a, x.b):
+        for c2 in (part.a, part.b, part.c):
+            out.extend([c2.a, c2.b])
+    return out
+
+
+def _signed(c: int) -> int:
+    return c - Q if c > Q // 2 else c
+
+
+def _structure_tensor(d: int) -> np.ndarray:
+    """T[i,j,k] over the first d components (d = 2 -> Fq2, 6 -> Fq6,
+    12 -> Fq12; the tower ordering nests, so a prefix of the Fq12 basis
+    IS the smaller algebra's basis)."""
+    T = np.zeros((d, d, d), dtype=np.int64)
+    basis = []
+    for i in range(d):
+        c = [0] * 12
+        c[i] = 1
+        basis.append(_fq12_from_coeffs(c))
+    for i in range(d):
+        for j in range(d):
+            prod = _fq12_to_coeffs(basis[i] * basis[j])
+            for k, coef in enumerate(prod):
+                s = _signed(coef)
+                assert abs(s) <= 4, (i, j, k, s)
+                assert k < d or s == 0, "product escaped the subalgebra"
+                if k < d:
+                    T[i, j, k] = s
+    return T
+
+
+def _mul_plan(T: np.ndarray, y_slots=None):
+    """Precompute the einsum operands for alg_mul: positive/negative
+    parts of T (restricted to ``y_slots`` of the right operand for
+    sparse multiplicands) + the digit vector of the p-multiple offset
+    that keeps the signed recombination non-negative."""
+    if y_slots is not None:
+        T = T[:, list(y_slots), :]
+    Tpos = np.maximum(T, 0).astype(np.int32)
+    Tneg = np.maximum(-T, 0).astype(np.int32)
+    neg_bound = int(Tneg.sum(axis=(0, 1)).max())   # worst Σ|neg coef| per k
+    pos_bound = int(Tpos.sum(axis=(0, 1)).max())
+    m = 2 * neg_bound + 2                          # offset = m*p >= neg*2p
+    # every value stays < (2*pos + m + 2) * p; must fit 33 digits
+    assert (2 * pos_bound + m + 2) * Q < 2**(12 * 33)
+    offset = fp.to_limbs(m * Q, 33)
+    # numpy, not jnp: this cache may first fill inside a trace, and a
+    # traced-context jnp constant would leak its tracer
+    return (Tpos, Tneg, offset)
+
+
+_T2 = _structure_tensor(2)
+_T6 = _structure_tensor(6)
+_T12 = _structure_tensor(12)
+_PLANS: dict = {}
+
+
+def _plan(d: int, y_slots=None):
+    key = (d, y_slots)
+    if key not in _PLANS:
+        T = {2: _T2, 6: _T6, 12: _T12}[d]
+        _PLANS[key] = _mul_plan(T, y_slots)
+    return _PLANS[key]
+
+
+# --- generic algebra ops ------------------------------------------------------
+
+
+def alg_mul(x: jax.Array, y: jax.Array, y_slots: tuple | None = None
+            ) -> jax.Array:
+    """x * y in the d-component algebra; x [..., d, 32], y [..., dy, 32]
+    where dy = len(y_slots) if y is sparse (its components live at
+    ``y_slots`` of the full basis) else d."""
+    d = x.shape[-2]
+    tpos, tneg, offset = (jnp.asarray(t) for t in _plan(d, y_slots))
+    prods = fp.modmul(x[..., :, None, :], y[..., None, :, :])
+    pos = jnp.einsum("ijk,...ijl->...kl", tpos, prods,
+                     preferred_element_type=jnp.int32)
+    neg = jnp.einsum("ijk,...ijl->...kl", tneg, prods,
+                     preferred_element_type=jnp.int32)
+    pos = jnp.pad(pos, [(0, 0)] * (pos.ndim - 1) + [(0, 33 - pos.shape[-1])])
+    s = fp.carry_norm(pos + offset, 33)
+    t = fp.carry_norm(neg, 33)
+    diff, uf = fp.sub_digits(s, t)
+    return fp.barrett_reduce(diff)
+
+
+def alg_sq(x: jax.Array) -> jax.Array:
+    return alg_mul(x, x)
+
+
+# add/sub/neg/select/eq are just the base-field ops broadcast over the
+# component axis — no algebra-specific code needed
+alg_add = fp.modadd
+alg_sub = fp.modsub
+alg_neg = fp.modneg
+
+
+def alg_eq(x: jax.Array, y: jax.Array) -> jax.Array:
+    return fp.eq(x, y).all(axis=-1)
+
+
+def alg_is_zero(x: jax.Array) -> jax.Array:
+    return fp.is_zero(x).all(axis=-1)
+
+
+def alg_select(pred: jax.Array, x: jax.Array, y: jax.Array) -> jax.Array:
+    """pred [...] broadcast over [..., d, 32]."""
+    return jnp.where(pred[..., None, None], x, y)
+
+
+def alg_one(d: int, batch: tuple = ()) -> jax.Array:
+    out = np.zeros(batch + (d, fp.L), dtype=np.int32)
+    out[..., 0, :] = fp.ONE
+    return jnp.asarray(out)
+
+
+def alg_zero(d: int, batch: tuple = ()) -> jax.Array:
+    return jnp.zeros(batch + (d, fp.L), dtype=jnp.int32)
+
+
+def embed(x: jax.Array, d: int, slots: tuple) -> jax.Array:
+    """Place sparse components x [..., len(slots), 32] at ``slots`` of a
+    d-component zero element."""
+    out = jnp.zeros(x.shape[:-2] + (d, fp.L), dtype=jnp.int32)
+    return out.at[..., jnp.asarray(slots), :].set(x)
+
+
+# --- Fq2 specifics ------------------------------------------------------------
+
+
+def fq2_mul(x, y):
+    return alg_mul(x, y)
+
+
+def fq2_sq(x):
+    return alg_mul(x, x)
+
+
+def fq2_conj(x):
+    return jnp.stack([x[..., 0, :], fp.modneg(x[..., 1, :])], axis=-2)
+
+
+def fq2_mul_xi(x):
+    """(a+bu)(1+u) = (a-b) + (a+b)u."""
+    a, b = x[..., 0, :], x[..., 1, :]
+    return jnp.stack([fp.modsub(a, b), fp.modadd(a, b)], axis=-2)
+
+
+def fq2_inv(x):
+    """1/(a+bu) = (a - bu)/(a^2 + b^2); zero maps to zero (Fermat)."""
+    a, b = x[..., 0, :], x[..., 1, :]
+    d = fp.modinv(fp.modadd(fp.modmul(a, a), fp.modmul(b, b)))
+    return jnp.stack([fp.modmul(a, d), fp.modneg(fp.modmul(b, d))], axis=-2)
+
+
+def fq2_muli(x, k: int):
+    """Multiply by a small non-negative int (trace-time shift-add)."""
+    acc = None
+    add = x
+    while k:
+        if k & 1:
+            acc = add if acc is None else fp.modadd(acc, add)
+        add = fp.modadd(add, add)
+        k >>= 1
+    return acc if acc is not None else jnp.zeros_like(x)
+
+
+# --- Fq6 / Fq12 specifics -----------------------------------------------------
+
+
+def fq6_mul_v(x):
+    """*v: (c0, c1, c2) -> (c2*xi, c0, c1) over [..., 6, 32] ((vpow,
+    upart) flattened)."""
+    c0, c1, c2 = x[..., 0:2, :], x[..., 2:4, :], x[..., 4:6, :]
+    return jnp.concatenate([fq2_mul_xi(c2), c0, c1], axis=-2)
+
+
+def fq6_inv(x):
+    """Cubic-extension inverse (oracle bls12_381.py:181-187)."""
+    a, b, c = x[..., 0:2, :], x[..., 2:4, :], x[..., 4:6, :]
+    c0 = fp.modsub(fq2_sq(a), fq2_mul_xi(fq2_mul(b, c)))
+    c1 = fp.modsub(fq2_mul_xi(fq2_sq(c)), fq2_mul(a, b))
+    c2 = fp.modsub(fq2_sq(b), fq2_mul(a, c))
+    t = fq2_inv(fp.modadd(fq2_mul(a, c0), fq2_mul_xi(
+        fp.modadd(fq2_mul(c, c1), fq2_mul(b, c2)))))
+    return jnp.concatenate([fq2_mul(c0, t), fq2_mul(c1, t), fq2_mul(c2, t)],
+                           axis=-2)
+
+
+def fq12_mul(x, y):
+    return alg_mul(x, y)
+
+
+def fq12_sq(x):
+    return alg_mul(x, x)
+
+
+def fq12_conj(x):
+    """Conjugation = Frobenius^6 (oracle :227-229): negate the w-part.
+    For elements in the cyclotomic subgroup this IS the inverse."""
+    return jnp.concatenate([x[..., 0:6, :], fp.modneg(x[..., 6:12, :])],
+                           axis=-2)
+
+
+def fq12_inv(x):
+    """Quadratic-over-Fq6 inverse (oracle :223-225)."""
+    a, b = x[..., 0:6, :], x[..., 6:12, :]
+    a2 = alg_mul(a, a)
+    b2 = alg_mul(b, b)
+    t = fq6_inv(fp.modsub(a2, fq6_mul_v(b2)))
+    return jnp.concatenate([alg_mul(a, t), fp.modneg(alg_mul(b, t))],
+                           axis=-2)
+
+
+def fq12_pow_bits(x: jax.Array, bits: np.ndarray) -> jax.Array:
+    """x^e for the static bit string ``bits`` (MSB first) via lax.scan —
+    one Fq12 square + conditional mul per bit."""
+    one = alg_one(12, x.shape[:-2])
+
+    def step(acc, bit):
+        acc = fq12_sq(acc)
+        return alg_select(bit, fq12_mul(acc, x), acc), None
+
+    out, _ = jax.lax.scan(step, one, jnp.asarray(bits))
+    return out
+
+
+# --- Frobenius ----------------------------------------------------------------
+#
+# Over the w-power basis c_i * w^i (i = 0..5, w^6 = xi):
+#   frob^k(c_i w^i) = frob^k(c_i) * gamma_k[i] * w^i,
+#   gamma_k[i] = xi^(i * (q^k - 1) / 6)
+# frob on Fq2 is conjugation (frob^2 = identity on Fq2).
+# Tower slot (pairs) <-> w-power: (a.c0, a.c1, a.c2, b.c0, b.c1, b.c2)
+#                              =  (w^0,  w^2,  w^4,  w^1,  w^3,  w^5).
+
+_WPOW = [0, 2, 4, 1, 3, 5]
+
+
+def _gamma_const(k: int) -> np.ndarray:
+    """[6, 2, 32] gamma constants per tower Fq2 slot."""
+    qk = Q if k == 1 else Q * Q
+    out = np.zeros((6, 2, fp.L), dtype=np.int32)
+    for slot in range(6):
+        g = oracle.XI.pow(_WPOW[slot] * (qk - 1) // 6)
+        out[slot, 0] = fp.to_limbs(g.a)
+        out[slot, 1] = fp.to_limbs(g.b)
+    return out
+
+
+_G1C = jnp.asarray(_gamma_const(1))
+_G2C = jnp.asarray(_gamma_const(2))
+
+
+def fq12_frob1(x):
+    pairs = x.reshape(x.shape[:-2] + (6, 2, fp.L))
+    conj = jnp.stack([pairs[..., 0, :], fp.modneg(pairs[..., 1, :])],
+                     axis=-2)
+    out = alg_mul(conj, jnp.broadcast_to(_G1C, conj.shape))
+    return out.reshape(x.shape)
+
+
+def fq12_frob2(x):
+    pairs = x.reshape(x.shape[:-2] + (6, 2, fp.L))
+    out = alg_mul(pairs, jnp.broadcast_to(_G2C, pairs.shape))
+    return out.reshape(x.shape)
+
+
+# --- host <-> device codecs ---------------------------------------------------
+
+
+def fq2_encode(x: "oracle.Fq2") -> np.ndarray:
+    return np.stack([fp.to_limbs(x.a), fp.to_limbs(x.b)])
+
+
+def fq2_decode(x, idx=()) -> "oracle.Fq2":
+    arr = np.asarray(x)[idx]
+    return oracle.Fq2(fp.from_limbs(arr[0]), fp.from_limbs(arr[1]))
+
+
+def fq6_encode(x: "oracle.Fq6") -> np.ndarray:
+    return np.concatenate([fq2_encode(c) for c in (x.a, x.b, x.c)])
+
+
+def fq6_decode(x, idx=()) -> "oracle.Fq6":
+    arr = np.asarray(x)[idx]
+    return oracle.Fq6(*(oracle.Fq2(fp.from_limbs(arr[2 * i]),
+                                   fp.from_limbs(arr[2 * i + 1]))
+                        for i in range(3)))
+
+
+def fq12_encode(x: "oracle.Fq12") -> np.ndarray:
+    return np.concatenate([fq6_encode(x.a), fq6_encode(x.b)])
+
+
+def fq12_decode(x, idx=()) -> "oracle.Fq12":
+    arr = np.asarray(x)[idx]
+    halves = []
+    for off in (0, 6):
+        halves.append(oracle.Fq6(*(oracle.Fq2(
+            fp.from_limbs(arr[off + 2 * i]),
+            fp.from_limbs(arr[off + 2 * i + 1])) for i in range(3))))
+    return oracle.Fq12(*halves)

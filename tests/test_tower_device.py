@@ -1,0 +1,132 @@
+"""Differential tests: device tower arithmetic (ops/tower.py, dense
+[..., d, 32] algebra representation) vs the exact Python oracle
+(crypto/bls12_381.py) — Fq2/Fq6/Fq12 ops, Frobenius, pow ladder."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pos_evolution_tpu.crypto import bls12_381 as oracle  # noqa: E402
+from pos_evolution_tpu.ops import tower  # noqa: E402
+
+
+def rand_fq2(rng) -> oracle.Fq2:
+    return oracle.Fq2(int.from_bytes(rng.bytes(48), "big"),
+                      int.from_bytes(rng.bytes(48), "big"))
+
+
+def rand_fq6(rng) -> oracle.Fq6:
+    return oracle.Fq6(rand_fq2(rng), rand_fq2(rng), rand_fq2(rng))
+
+
+def rand_fq12(rng) -> oracle.Fq12:
+    return oracle.Fq12(rand_fq6(rng), rand_fq6(rng))
+
+
+def batch(encoded):
+    return jax.numpy.asarray(np.stack(encoded))
+
+
+class TestStructureTensors:
+    def test_tensor_entries_small(self):
+        for T in (tower._T2, tower._T6, tower._T12):
+            assert np.abs(T).max() <= 2
+
+    def test_subalgebra_nesting(self):
+        assert (tower._T12[:2, :2, :2] == tower._T2).all()
+        assert (tower._T12[:6, :6, :6] == tower._T6).all()
+
+
+class TestFq2:
+    def test_mul_add_sub(self):
+        rng = np.random.default_rng(0)
+        xs = [rand_fq2(rng) for _ in range(8)]
+        ys = [rand_fq2(rng) for _ in range(8)]
+        ex = batch([tower.fq2_encode(v) for v in xs])
+        ey = batch([tower.fq2_encode(v) for v in ys])
+        mul = jax.jit(tower.fq2_mul)(ex, ey)
+        add = jax.jit(tower.alg_add)(ex, ey)
+        sub = jax.jit(tower.alg_sub)(ex, ey)
+        for i in range(8):
+            assert tower.fq2_decode(mul, (i,)) == xs[i] * ys[i]
+            assert tower.fq2_decode(add, (i,)) == xs[i] + ys[i]
+            assert tower.fq2_decode(sub, (i,)) == xs[i] - ys[i]
+
+    def test_sq_conj_xi_inv_muli(self):
+        rng = np.random.default_rng(1)
+        xs = [rand_fq2(rng) for _ in range(4)]
+        e = batch([tower.fq2_encode(v) for v in xs])
+        sq = jax.jit(tower.fq2_sq)(e)
+        cj = jax.jit(tower.fq2_conj)(e)
+        xi = jax.jit(tower.fq2_mul_xi)(e)
+        iv = jax.jit(tower.fq2_inv)(e)
+        m3 = jax.jit(lambda v: tower.fq2_muli(v, 3))(e)
+        for i in range(4):
+            assert tower.fq2_decode(sq, (i,)) == xs[i].sq()
+            assert tower.fq2_decode(cj, (i,)) == xs[i].conj()
+            assert tower.fq2_decode(xi, (i,)) == xs[i] * oracle.XI
+            assert tower.fq2_decode(iv, (i,)) == xs[i].inv()
+            assert tower.fq2_decode(m3, (i,)) == xs[i] * 3
+
+
+class TestFq6:
+    def test_mul_v_inv(self):
+        rng = np.random.default_rng(2)
+        x, y = rand_fq6(rng), rand_fq6(rng)
+        ex = batch([tower.fq6_encode(x)])
+        ey = batch([tower.fq6_encode(y)])
+        assert tower.fq6_decode(jax.jit(tower.alg_mul)(ex, ey), (0,)) == x * y
+        assert tower.fq6_decode(jax.jit(tower.fq6_mul_v)(ex), (0,)) \
+            == x.mul_by_v()
+        got = tower.fq6_decode(jax.jit(tower.fq6_inv)(ex), (0,))
+        assert got * x == oracle.FQ6_ONE
+
+
+class TestFq12:
+    def test_mul_sq_conj_inv(self):
+        rng = np.random.default_rng(3)
+        x, y = rand_fq12(rng), rand_fq12(rng)
+        ex = batch([tower.fq12_encode(x)])
+        ey = batch([tower.fq12_encode(y)])
+        assert tower.fq12_decode(jax.jit(tower.fq12_mul)(ex, ey), (0,)) == x * y
+        assert tower.fq12_decode(jax.jit(tower.fq12_sq)(ex), (0,)) == x.sq()
+        assert tower.fq12_decode(jax.jit(tower.fq12_conj)(ex), (0,)) == x.conj()
+        got = tower.fq12_decode(jax.jit(tower.fq12_inv)(ex), (0,))
+        assert got * x == oracle.FQ12_ONE
+
+    def test_sparse_mul(self):
+        """Sparse right operand at chosen Fq-component slots == dense mul
+        of its embedding (the Miller-loop line multiplication shape)."""
+        rng = np.random.default_rng(4)
+        x = rand_fq12(rng)
+        slots = (0, 1, 4, 5, 8, 9)   # Fq2 slots w^0, w^2, w^3 flattened
+        svals = [int.from_bytes(rng.bytes(48), "big") % oracle.Q
+                 for _ in slots]
+        dense = [0] * 12
+        for s, v in zip(slots, svals):
+            dense[s] = v
+        y_or = tower._fq12_from_coeffs(dense)
+        ex = batch([tower.fq12_encode(x)])
+        ysp = batch([np.stack([tower.fp.to_limbs(v) for v in svals])])
+        got = jax.jit(lambda a, b: tower.alg_mul(a, b, y_slots=slots))(ex, ysp)
+        assert tower.fq12_decode(got, (0,)) == x * y_or
+
+    def test_frobenius(self):
+        rng = np.random.default_rng(5)
+        x = rand_fq12(rng)
+        ex = batch([tower.fq12_encode(x)])
+        got1 = tower.fq12_decode(jax.jit(tower.fq12_frob1)(ex), (0,))
+        got2 = tower.fq12_decode(jax.jit(tower.fq12_frob2)(ex), (0,))
+        assert got1 == x.pow(oracle.Q)
+        assert got2 == x.pow(oracle.Q * oracle.Q)
+
+    def test_pow_bits(self):
+        rng = np.random.default_rng(6)
+        xs = [rand_fq12(rng) for _ in range(2)]
+        e = int.from_bytes(rng.bytes(8), "big")
+        bits = np.array([b == "1" for b in bin(e)[2:]], dtype=bool)
+        enc = batch([tower.fq12_encode(v) for v in xs])
+        got = jax.jit(lambda v: tower.fq12_pow_bits(v, bits))(enc)
+        for i in range(2):
+            assert tower.fq12_decode(got, (i,)) == xs[i].pow(e)
