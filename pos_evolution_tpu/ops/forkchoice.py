@@ -184,6 +184,29 @@ def head_from_buckets(parent, real, rank, leaf_viable, justified_idx,
                               vote_weight, boost_idx, boost_amount, capacity)
 
 
+def _vote_landing(msg_block, msg_epoch, val_idx, new_block, new_epoch,
+                  active):
+    """Shared landing predicate for the incremental vote kernels: which
+    batch entries update the LMD table (pos-evolution.md:1435-1441),
+    including the in-batch dedup tournament for duplicate ``val_idx`` —
+    the first entry carrying the maximum target epoch among entries that
+    could land at all wins (later equal-epoch votes would not land
+    against it, :1440; inactive or padded entries never land
+    sequentially, so they must not knock out a live lower-epoch vote
+    either). Returns (lands, old_block, old_epoch)."""
+    old_block = msg_block[val_idx]
+    old_epoch = msg_epoch[val_idx]
+    lands = (active & (new_block >= 0)
+             & ((old_block < 0) | (new_epoch > old_epoch)))
+    k = val_idx.shape[0]
+    pos = jnp.arange(k, dtype=jnp.int64)
+    key = new_epoch.astype(jnp.int64) * (2 * k) + (k - pos)
+    competitor = active & (new_block >= 0)
+    same = (val_idx[:, None] == val_idx[None, :]) & ~jnp.eye(k, dtype=bool)
+    loses = (same & (key[None, :] > key[:, None]) & competitor[None, :]).any(axis=1)
+    return lands & ~loses, old_block, old_epoch
+
+
 @jax.jit
 def apply_latest_messages(msg_block, msg_epoch, vote_weight,
                           val_idx, new_block, new_epoch, weight, active):
@@ -205,23 +228,8 @@ def apply_latest_messages(msg_block, msg_epoch, vote_weight,
     same validator — on effective-balance changes (epoch boundaries) call
     ``rebuild_buckets``.
     """
-    old_block = msg_block[val_idx]
-    old_epoch = msg_epoch[val_idx]
-    lands = (active & (new_block >= 0)
-             & ((old_block < 0) | (new_epoch > old_epoch)))
-
-    # In-batch dedup: for equal val_idx, only the sequential winner lands —
-    # the first entry carrying the maximum target epoch among entries that
-    # could land at all (later equal-epoch votes would not land against
-    # it, :1440; inactive or padded entries never land sequentially, so
-    # they must not knock out a live lower-epoch vote either).
-    k = val_idx.shape[0]
-    pos = jnp.arange(k, dtype=jnp.int64)
-    key = new_epoch.astype(jnp.int64) * (2 * k) + (k - pos)
-    competitor = active & (new_block >= 0)
-    same = (val_idx[:, None] == val_idx[None, :]) & ~jnp.eye(k, dtype=bool)
-    loses = (same & (key[None, :] > key[:, None]) & competitor[None, :]).any(axis=1)
-    lands = lands & ~loses
+    lands, old_block, old_epoch = _vote_landing(
+        msg_block, msg_epoch, val_idx, new_block, new_epoch, active)
 
     nb = vote_weight.shape[0]
     # subtract old weight where a previous message existed
@@ -275,6 +283,90 @@ def remove_latest_messages(msg_block, msg_epoch, vote_weight, val_idx, weight):
     msg_block = msg_block.at[val_idx].set(-1)
     msg_epoch = msg_epoch.at[val_idx].set(0)
     return msg_block, msg_epoch, vote_weight
+
+
+# --- epoch-windowed buckets: incremental heads for expiry variants ------------
+#
+# RLMD-GHOST weighs only latest messages from the last eta epochs
+# (pos-evolution.md:1581-1609; eta = 1 recovers Goldfish's GHOST-Eph
+# :1549, eta = inf recovers LMD). Flat buckets destroy per-vote epochs,
+# so expiry variants previously had to rescan the registry per head
+# query. These kernels keep per-(block, recent-epoch) weight columns —
+# window W is a small static bound on eta — making the expiry-windowed
+# head as incremental as the LMD one. Columns are indexed relative to a
+# resident ``base_epoch``; sliding the window = the epoch-boundary
+# rebuild that the bucket contract already mandates for balance changes.
+
+
+@partial(jax.jit, static_argnames=("capacity", "window"))
+def rebuild_epoch_buckets(msg_block, msg_epoch, weight, capacity: int,
+                          window: int, base_epoch):
+    """[capacity, window] weight columns: column e holds the summed
+    weight of latest messages with target epoch == base_epoch + e.
+    Messages older than ``base_epoch`` are permanently expired (the
+    window only slides forward) and carry no bucket weight; messages
+    ABOVE the window clamp into the top column — exactly correct for
+    every query the window can express, since both the true and the
+    clamped epoch exceed any representable ``min_vote_epoch``
+    (< base + window), and the table keeps the true epoch so later
+    delta-subtractions re-clamp consistently."""
+    col = jnp.minimum((msg_epoch - base_epoch).astype(jnp.int32), window - 1)
+    valid = (msg_block >= 0) & (col >= 0)
+    seg = jnp.where(valid, msg_block * window + col, capacity * window)
+    flat = jax.ops.segment_sum(
+        jnp.where(valid, weight.astype(jnp.int64), 0), seg,
+        num_segments=capacity * window + 1)[:capacity * window]
+    return flat.reshape(capacity, window)
+
+
+@jax.jit
+def apply_latest_messages_windowed(msg_block, msg_epoch, epoch_buckets,
+                                   base_epoch, val_idx, new_block,
+                                   new_epoch, weight, active):
+    """Windowed twin of ``apply_latest_messages``: same landing/dedup
+    semantics, but bucket deltas carry the vote's target epoch. Votes
+    below ``base_epoch`` contribute no bucket weight (expired on
+    arrival, as the rescan with ``min_vote_epoch >= base_epoch`` treats
+    them); votes above the window clamp into the top column (see
+    ``rebuild_epoch_buckets`` for why that is exact)."""
+    lands, old_block, old_epoch = _vote_landing(
+        msg_block, msg_epoch, val_idx, new_block, new_epoch, active)
+    capacity, window = epoch_buckets.shape
+    flat = epoch_buckets.reshape(capacity * window)
+    drop = capacity * window
+
+    def slot(block, epoch, ok):
+        col = jnp.minimum((epoch - base_epoch).astype(jnp.int32), window - 1)
+        in_win = ok & (col >= 0)
+        return jnp.where(in_win, block * window + col, drop), in_win
+
+    w = weight.astype(flat.dtype)
+    sub_seg, sub_ok = slot(old_block, old_epoch, lands & (old_block >= 0))
+    add_seg, add_ok = slot(new_block, new_epoch, lands)
+    flat = flat.at[sub_seg].add(-jnp.where(sub_ok, w, 0), mode="drop")
+    flat = flat.at[add_seg].add(jnp.where(add_ok, w, 0), mode="drop")
+
+    tgt = jnp.where(lands, val_idx, msg_block.shape[0])
+    msg_block = msg_block.at[tgt].set(new_block, mode="drop")
+    msg_epoch = msg_epoch.at[tgt].set(new_epoch, mode="drop")
+    return msg_block, msg_epoch, flat.reshape(capacity, window)
+
+
+@partial(jax.jit, static_argnames=("capacity", "window"))
+def head_from_epoch_buckets(parent, real, rank, leaf_viable, justified_idx,
+                            epoch_buckets, base_epoch, min_vote_epoch,
+                            boost_idx, boost_amount, capacity: int,
+                            window: int):
+    """Expiry-windowed head from resident columns: mask columns below
+    ``min_vote_epoch`` (= current_epoch - eta + 1 in RLMD terms), sum,
+    descend. Differential oracle: ``head_and_weights(min_vote_epoch=...)``
+    (pinned in tests/test_dense_forkchoice.py); requires
+    min_vote_epoch >= base_epoch (older columns no longer exist)."""
+    cols = base_epoch + jnp.arange(window, dtype=epoch_buckets.dtype)
+    vote_weight = jnp.where(cols[:, None] >= min_vote_epoch,
+                            epoch_buckets.T, 0).sum(axis=0)
+    return _head_from_buckets(parent, real, rank, leaf_viable, justified_idx,
+                              vote_weight, boost_idx, boost_amount, capacity)
 
 
 # --- host-side densification --------------------------------------------------

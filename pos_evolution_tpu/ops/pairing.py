@@ -1,0 +1,359 @@
+"""BLS12-381 pairing on TPU lanes (component N1) — batched ate pairing
+and FastAggregateVerify over the dense tower (ops/tower.py).
+
+The reference's signature surface is real pairing crypto in every
+deployment: ``bls.Verify`` for deposits (pos-evolution.md:165), aggregate
+attestation signatures over ``aggregation_bits`` (:714-717), sync
+aggregates (:642). SURVEY.md §2.7 N1 and BASELINE config #3 demand a
+batched pairing kernel. Correctness oracle: ``crypto/bls12_381.py``
+(exact Python integers); every public function here is differential-
+tested against it in ``tests/test_pairing_device.py``.
+
+Design (TPU-first, no data-dependent control flow):
+
+- **Miller loop on the twist.** The oracle untwists Q into Fq12 and runs
+  generic Fq12 curve arithmetic with per-step inversions; here the loop
+  state is a Jacobian point over Fq2 on the twist E'(Fq2) and the line
+  function is evaluated *through* the untwist map algebraically:
+  psi(x',y') = (x'/w^2, y'/w^3), so the tangent/chord line at P=(xp,yp)
+  scaled by the Fq2 constant 2YZ^3 (resp. piZ) lands in the sparse
+  subspace  c0 + cx*xp*w^2 + cy*yp*w^3  (slots (0,1,2,3,8,9) of the
+  dense basis — the classic 014 sparsity in Fq6-pair terms). Each line
+  is additionally scaled by w^3; across the fixed loop that multiplies
+  the Miller value by w^(3*68) = xi^34 in Fq2, and Fq2 constants die in
+  the final exponentiation. No inversion anywhere in the loop.
+- **Fixed schedule.** The loop runs over the static 63-bit tail of
+  |t| = 0xd201000000010000 as a ``lax.scan``; the 5 addition steps are
+  computed every iteration and masked in (compute-and-select, the jit
+  idiom), the final conjugation implements t < 0.
+- **Final exponentiation by the x-chain.** Easy part
+  f^((q^6-1)(q^2+1)) via conjugation, one tower inversion and one
+  Frobenius; hard part uses the exactly-verified identity
+  3*(q^4-q^2+1)/r = (x-1)^2 * (x+q) * (x^2+q^2-1) + 3  (gcd(3, r) = 1,
+  so the cubed pairing decides the same verification equations) — four
+  64-bit pow-by-|x| scans, two Frobenius maps and a handful of
+  multiplications; in the cyclotomic subgroup inversion is conjugation.
+- **G1 aggregation as a masked reduction tree.** Aggregate pubkeys are
+  summed with a unified, branch-free Jacobian add (compute the general
+  sum, the doubling, and the infinity cases; select by predicate) over
+  log2(lanes) tree levels — the aggregation shape of the reference's
+  committees (pos-evolution.md:474-475).
+
+Preconditions: points are decompressed, on-curve and subgroup-checked at
+the host boundary (``g1_decompress``/``g2_decompress`` + subgroup checks
+in the oracle/native code paths), mirroring how pyspec deployments gate
+inputs before the pairing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pos_evolution_tpu.crypto import bls12_381 as oracle
+from pos_evolution_tpu.ops import fp
+from pos_evolution_tpu.ops.tower import (
+    alg_eq,
+    alg_mul,
+    alg_one,
+    alg_select,
+    fq2_mul,
+    fq2_muli,
+    fq2_sq,
+    fq12_conj,
+    fq12_frob1,
+    fq12_frob2,
+    fq12_inv,
+    fq12_mul,
+    fq12_sq,
+)
+
+BLS_X = oracle.BLS_X                      # |t|; t is negative
+
+# static bit schedules (MSB first)
+_LOOP_BITS = np.array([b == "1" for b in bin(BLS_X)[3:]], dtype=bool)
+_X_BITS = np.array([b == "1" for b in bin(BLS_X)[2:]], dtype=bool)
+_XP1_BITS = np.array([b == "1" for b in bin(BLS_X + 1)[2:]], dtype=bool)
+
+# line sparsity: (w^0, w^2, w^3) as Fq2 pairs in the dense-basis order
+LINE_SLOTS = (0, 1, 2, 3, 8, 9)
+
+
+# --- small helpers ------------------------------------------------------------
+
+
+def _sel(pred, x, y):
+    """Select full-precision values by a [...]-shaped predicate,
+    broadcasting over any trailing structure axes."""
+    extra = x.ndim - pred.ndim
+    return jnp.where(pred.reshape(pred.shape + (1,) * extra), x, y)
+
+
+def _fq2_scale_fq(c2, s):
+    """Fq2 [..., 2, 32] times base-field scalar s [..., 32]."""
+    return fp.modmul(c2, s[..., None, :])
+
+
+def g2_neg(q):
+    """Negate an affine twisted point [..., 2(xy), 2, 32]."""
+    return jnp.concatenate([q[..., 0:1, :, :], fp.modneg(q[..., 1:2, :, :])],
+                           axis=-3)
+
+
+# --- encoders (host) ----------------------------------------------------------
+
+
+def g1_affine_encode(p) -> np.ndarray:
+    """Oracle G1 affine (ints) or None -> [2, 32] limbs (inf -> zeros;
+    pair with an explicit inf mask)."""
+    if p is None:
+        return np.zeros((2, fp.L), dtype=np.int32)
+    return np.stack([fp.to_limbs(p[0]), fp.to_limbs(p[1])])
+
+
+def g2_affine_encode(q) -> np.ndarray:
+    """Oracle G2 affine (Fq2 pair) or None -> [2, 2, 32] limbs."""
+    if q is None:
+        return np.zeros((2, 2, fp.L), dtype=np.int32)
+    x, y = q
+    return np.stack([
+        np.stack([fp.to_limbs(x.a), fp.to_limbs(x.b)]),
+        np.stack([fp.to_limbs(y.a), fp.to_limbs(y.b)]),
+    ])
+
+
+_G1_GEN = g1_affine_encode(oracle.G1_GEN)
+
+
+# --- Miller loop --------------------------------------------------------------
+
+
+def _line_embed(c0, cxp, cyp):
+    """Pack the three Fq2 line coefficients into the sparse [..., 6, 32]
+    operand for ``alg_mul(..., y_slots=LINE_SLOTS)``."""
+    return jnp.concatenate([c0, cxp, cyp], axis=-2)
+
+
+def miller_loop(p_aff: jax.Array, q_aff: jax.Array,
+                inf: jax.Array | None = None) -> jax.Array:
+    """Batched ate Miller loop: e-numerator for (P in G1, Q in E'(Fq2)).
+
+    p_aff [..., 2, 32] (affine Fq coords), q_aff [..., 2, 2, 32]
+    (affine twisted Fq2 coords), inf [...] optional mask marking pairs
+    whose contribution must be one (either point at infinity).
+    Returns f [..., 12, 32] (pre-final-exponentiation, scaled by an
+    Fq2 constant per the module docstring).
+    """
+    xp, yp = p_aff[..., 0, :], p_aff[..., 1, :]
+    xq, yq = q_aff[..., 0, :, :], q_aff[..., 1, :, :]
+    batch = xp.shape[:-1]
+    one12 = alg_one(12, batch)
+    one2 = jnp.asarray(
+        np.broadcast_to(np.stack([fp.ONE, fp.ZERO]), batch + (2, fp.L)))
+
+    def body(carry, bit):
+        f, X, Y, Z = carry
+        # -- doubling step (a=0 Jacobian dbl-2009-l) + tangent line
+        A = fq2_sq(X)
+        B = fq2_sq(Y)
+        C = fq2_sq(B)
+        ZZ = fq2_sq(Z)
+        D = fq2_muli(fp.modsub(fp.modsub(fq2_sq(fp.modadd(X, B)), A), C), 2)
+        E = fq2_muli(A, 3)
+        X3 = fp.modsub(fq2_sq(E), fq2_muli(D, 2))
+        Y3 = fp.modsub(fq2_mul(E, fp.modsub(D, X3)), fq2_muli(C, 8))
+        YZ = fq2_mul(Y, Z)
+        Z3 = fq2_muli(YZ, 2)
+        c0 = fp.modsub(fq2_muli(B, 2), fq2_muli(fq2_mul(X, A), 3))
+        cx = fq2_muli(fq2_mul(A, ZZ), 3)
+        cy = fp.modneg(fq2_muli(fq2_mul(YZ, ZZ), 2))
+        line = _line_embed(c0, _fq2_scale_fq(cx, xp), _fq2_scale_fq(cy, yp))
+        f = fq12_sq(f)
+        f = alg_mul(f, line, y_slots=LINE_SLOTS)
+        X, Y, Z = X3, Y3, Z3
+        # -- mixed addition step (Q affine) + chord line, masked by bit
+        ZZ = fq2_sq(Z)
+        H = fp.modsub(fq2_mul(xq, ZZ), X)
+        r = fp.modsub(fq2_mul(yq, fq2_mul(Z, ZZ)), Y)
+        H2 = fq2_sq(H)
+        H3 = fq2_mul(H, H2)
+        V = fq2_mul(X, H2)
+        X4 = fp.modsub(fp.modsub(fq2_sq(r), H3), fq2_muli(V, 2))
+        Y4 = fp.modsub(fq2_mul(r, fp.modsub(V, X4)), fq2_mul(Y, H3))
+        Z4 = fq2_mul(Z, H)
+        c0 = fp.modsub(fq2_mul(Z4, yq), fq2_mul(r, xq))
+        line = _line_embed(c0, _fq2_scale_fq(r, xp),
+                           _fq2_scale_fq(fp.modneg(Z4), yp))
+        f_add = alg_mul(f, line, y_slots=LINE_SLOTS)
+        pred = jnp.broadcast_to(bit, batch)
+        f = alg_select(pred, f_add, f)
+        X = _sel(pred, X4, X)
+        Y = _sel(pred, Y4, Y)
+        Z = _sel(pred, Z4, Z)
+        return (f, X, Y, Z), None
+
+    (f, _, _, _), _ = jax.lax.scan(
+        body, (one12, xq, yq, one2), jnp.asarray(_LOOP_BITS))
+    f = fq12_conj(f)                       # t < 0
+    if inf is not None:
+        f = alg_select(inf, one12, f)
+    return f
+
+
+# --- final exponentiation -----------------------------------------------------
+
+
+def _pow_bits(x, bits):
+    """x^e over a static bit schedule (reuses the tower scan ladder)."""
+    from pos_evolution_tpu.ops.tower import fq12_pow_bits
+    return fq12_pow_bits(x, bits)
+
+
+def final_exponentiation(f: jax.Array) -> jax.Array:
+    """f^(3 * (q^12-1)/r).  The cube (gcd(3, r) = 1) preserves every
+    is-one verification decision and admits the inversion-free x-chain
+    hard part (identity verified exactly in the test suite)."""
+    # easy part: f^((q^6-1)(q^2+1)) — after this, inversion = conjugation
+    f1 = fq12_mul(fq12_conj(f), fq12_inv(f))
+    f2 = fq12_mul(fq12_frob2(f1), f1)
+    # hard part: f2^((x-1)^2 * (x+q) * (x^2+q^2-1)) * f2^3
+    a = _pow_bits(_pow_bits(f2, _XP1_BITS), _XP1_BITS)   # (x-1)^2 = (|x|+1)^2
+    b = fq12_mul(fq12_conj(_pow_bits(a, _X_BITS)), fq12_frob1(a))  # ^(x+q)
+    c = fq12_mul(fq12_mul(_pow_bits(_pow_bits(b, _X_BITS), _X_BITS),
+                          fq12_frob2(b)),
+                 fq12_conj(b))                            # ^(x^2+q^2-1)
+    return fq12_mul(fq12_mul(fq12_sq(f2), f2), c)
+
+
+def pairing(p_aff, q_aff, inf=None):
+    """Full batched pairing e(P, Q)^3 in canonical dense-Fq12 form."""
+    return final_exponentiation(miller_loop(p_aff, q_aff, inf))
+
+
+# --- G1 arithmetic (pubkey aggregation) ---------------------------------------
+
+
+def g1_double_jac(P):
+    """a=0 Jacobian doubling; P [..., 3, 32]."""
+    X, Y, Z = P[..., 0, :], P[..., 1, :], P[..., 2, :]
+    A = fp.modmul(X, X)
+    B = fp.modmul(Y, Y)
+    C = fp.modmul(B, B)
+    t = fp.modadd(X, B)
+    D = _dbl(fp.modsub(fp.modsub(fp.modmul(t, t), A), C))
+    E = fp.modadd(fp.modadd(A, A), A)
+    X3 = fp.modsub(fp.modmul(E, E), _dbl(D))
+    Y3 = fp.modsub(fp.modmul(E, fp.modsub(D, X3)), _mul8(C))
+    Z3 = _dbl(fp.modmul(Y, Z))
+    return jnp.stack([X3, Y3, Z3], axis=-2)
+
+
+def _dbl(x):
+    return fp.modadd(x, x)
+
+
+def _mul8(x):
+    return _dbl(_dbl(_dbl(x)))
+
+
+def g1_add_jac(P, Q):
+    """Unified branch-free Jacobian add: handles either operand at
+    infinity (Z = 0), P == Q (doubling) and P == -Q (infinity) by
+    computing every case and selecting."""
+    X1, Y1, Z1 = P[..., 0, :], P[..., 1, :], P[..., 2, :]
+    X2, Y2, Z2 = Q[..., 0, :], Q[..., 1, :], Q[..., 2, :]
+    Z1Z1 = fp.modmul(Z1, Z1)
+    Z2Z2 = fp.modmul(Z2, Z2)
+    U1 = fp.modmul(X1, Z2Z2)
+    U2 = fp.modmul(X2, Z1Z1)
+    S1 = fp.modmul(Y1, fp.modmul(Z2, Z2Z2))
+    S2 = fp.modmul(Y2, fp.modmul(Z1, Z1Z1))
+    H = fp.modsub(U2, U1)
+    r = fp.modsub(S2, S1)
+    H2 = fp.modmul(H, H)
+    H3 = fp.modmul(H, H2)
+    V = fp.modmul(U1, H2)
+    X3 = fp.modsub(fp.modsub(fp.modmul(r, r), H3), _dbl(V))
+    Y3 = fp.modsub(fp.modmul(r, fp.modsub(V, X3)), fp.modmul(S1, H3))
+    Z3 = fp.modmul(H, fp.modmul(Z1, Z2))
+    gen = jnp.stack([X3, Y3, Z3], axis=-2)
+
+    p_inf = fp.is_zero(Z1)
+    q_inf = fp.is_zero(Z2)
+    same_x = fp.is_zero(H) & ~p_inf & ~q_inf
+    same_y = fp.is_zero(r)
+    out = _sel(same_x & same_y, g1_double_jac(P), gen)
+    out = _sel(same_x & ~same_y, jnp.zeros_like(out), out)   # P + (-P)
+    out = _sel(p_inf, Q, out)
+    out = _sel(q_inf & ~p_inf, P, out)
+    return out
+
+
+def g1_sum_masked(points: jax.Array, mask: jax.Array) -> jax.Array:
+    """Masked sum of affine points: points [..., C, 2, 32], mask
+    [..., C] -> Jacobian [..., 3, 32]. Unset lanes become infinity; a
+    log2(C) unified-add tree does the reduction (the committee
+    aggregation shape, pos-evolution.md:474-475)."""
+    c = points.shape[-3]
+    k = 1
+    while k < c:
+        k *= 2
+    z = _sel(mask, jnp.broadcast_to(jnp.asarray(np.asarray(fp.ONE)),
+                                    points.shape[:-3] + (c, fp.L)),
+             jnp.zeros(points.shape[:-3] + (c, fp.L), jnp.int32))
+    jac = jnp.concatenate([points, z[..., None, :]], axis=-2)
+    if k != c:
+        pad = jnp.zeros(points.shape[:-3] + (k - c, 3, fp.L), jnp.int32)
+        jac = jnp.concatenate([jac, pad], axis=-3)
+    while k > 1:
+        k //= 2
+        jac = g1_add_jac(jac[..., :k, :, :], jac[..., k:, :, :])
+    return jac[..., 0, :, :]
+
+
+def g1_to_affine(P):
+    """Jacobian -> (affine [..., 2, 32], inf mask [...])."""
+    X, Y, Z = P[..., 0, :], P[..., 1, :], P[..., 2, :]
+    zi = fp.modinv(fp.canon(Z))
+    zi2 = fp.modmul(zi, zi)
+    x = fp.modmul(X, zi2)
+    y = fp.modmul(Y, fp.modmul(zi, zi2))
+    return jnp.stack([x, y], axis=-2), fp.is_zero(Z)
+
+
+# --- FastAggregateVerify ------------------------------------------------------
+
+
+def fast_aggregate_verify_batch(pk_table: jax.Array,
+                                committees: jax.Array,
+                                bits: jax.Array,
+                                msg_g2: jax.Array,
+                                sig_g2: jax.Array,
+                                sig_inf: jax.Array) -> jax.Array:
+    """Batched real-BLS FastAggregateVerify (pos-evolution.md:714-717).
+
+    pk_table   [N, 2, 32]      affine G1 pubkeys (host-decompressed)
+    committees [..., C] int32  validator index per lane
+    bits       [..., C] bool   aggregation bitlist
+    msg_g2     [..., 2, 2, 32] hashed messages on the twist (host N1 map)
+    sig_g2     [..., 2, 2, 32] decompressed aggregate signatures
+    sig_inf    [...]   bool    signature-at-infinity flags
+    Returns bool[...]: e(sum pk, H(m)) == e(g1, sig), False for empty
+    aggregates / infinity signatures (oracle semantics).
+    """
+    pks = pk_table[committees]                     # [..., C, 2, 32]
+    agg = g1_sum_masked(pks, bits)
+    pk_aff, pk_inf = g1_to_affine(agg)
+    # one Miller scan over the doubled batch (pk vs H(m), g1 vs -sig)
+    # instead of two separately traced 63-iteration loops
+    g1s = jnp.concatenate(
+        [pk_aff, jnp.asarray(np.broadcast_to(_G1_GEN, pk_aff.shape))], axis=0)
+    g2s = jnp.concatenate([msg_g2, g2_neg(sig_g2)], axis=0)
+    infs = jnp.concatenate([pk_inf, sig_inf], axis=0)
+    fs = miller_loop(g1s, g2s, infs)
+    b = pk_aff.shape[0]
+    f = fq12_mul(fs[:b], fs[b:])
+    ok = alg_eq(final_exponentiation(f), alg_one(12, f.shape[:-2]))
+    return ok & ~pk_inf & ~sig_inf

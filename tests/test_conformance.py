@@ -1,0 +1,235 @@
+"""Conformance tests against INDEPENDENT implementations and external
+anchors — breaking the self-generated golden-vector circularity
+(pos-evolution.md:9-11: the pyspec's whole testing story is producing
+vectors checked by independent implementations).
+
+This environment has no network egress, so the real
+``ethereum/consensus-specs`` vector tarballs are unreachable; the
+strongest available substitutes, used here:
+
+1. **hashlib** (OpenSSL) as a genuinely external SHA-256 implementation:
+   the zero-hash chain and merkle trees are recomputed from raw hashlib
+   calls, never through the package's hashing layer.
+2. **From-spec reimplementations written in this file**: a standalone
+   SSZ merkleizer/serializer built directly from the SSZ spec rules, and
+   the swap-or-not shuffle transcribed from the reference document's own
+   pyspec listing (pos-evolution.md:513-535), both deliberately
+   structured differently from the package code they check.
+3. **Externally standardized BLS12-381 constants and algebra**: the
+   IETF/ZCash curve parameters (q, r, generators) are spec constants;
+   conformance asserts the mathematical properties every correct
+   implementation must satisfy (generators on curve and of order r,
+   pairing bilinearity and non-degeneracy) rather than trusting any
+   in-repo implementation.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.specs import containers as C
+from pos_evolution_tpu.specs.helpers import compute_shuffled_index
+from pos_evolution_tpu.ssz import (
+    Bitlist,
+    ZERO_HASHES,
+    hash_tree_root,
+    serialize,
+    uint64,
+)
+from pos_evolution_tpu.ssz import List as SSZList
+
+
+# --- independent SSZ implementation (from the SSZ spec rules) -----------------
+
+
+def _h(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+def _merkleize(chunks: list, limit: int | None = None) -> bytes:
+    """Binary merkle tree over 32-byte chunks, zero-chunk padded to the
+    next power of two of ``limit`` (or chunk count)."""
+    count = max(len(chunks), 1)
+    width = 1
+    while width < (limit if limit is not None else count):
+        width *= 2
+    padded = chunks + [b"\x00" * 32] * (width - len(chunks))
+    while len(padded) > 1:
+        padded = [_h(padded[i], padded[i + 1]) for i in range(0, len(padded), 2)]
+    return padded[0]
+
+
+def _pack(data: bytes) -> list:
+    if not data:
+        return []
+    if len(data) % 32:
+        data = data + b"\x00" * (32 - len(data) % 32)
+    return [data[i:i + 32] for i in range(0, len(data), 32)]
+
+
+def _mix_len(root: bytes, n: int) -> bytes:
+    return _h(root, n.to_bytes(32, "little"))
+
+
+def _htr_uint64(v: int) -> bytes:
+    return v.to_bytes(8, "little") + b"\x00" * 24
+
+
+def _htr_bool(v: bool) -> bytes:
+    return bytes([1 if v else 0]) + b"\x00" * 31
+
+
+def _htr_bytes(v: bytes) -> bytes:
+    return _merkleize(_pack(v), (len(v) + 31) // 32)
+
+
+def _htr_checkpoint(epoch: int, root: bytes) -> bytes:
+    return _merkleize([_htr_uint64(epoch), _htr_bytes(root)])
+
+
+class TestSSZAgainstIndependentImpl:
+    def test_zero_hash_chain_vs_hashlib(self):
+        z = b"\x00" * 32
+        for level in range(len(ZERO_HASHES)):
+            assert bytes(ZERO_HASHES[level]) == z
+            z = hashlib.sha256(z + z).digest()
+
+    def test_uint64_and_bool(self):
+        for v in (0, 1, 2**64 - 1, 0xDEADBEEF):
+            assert hash_tree_root(v, uint64) == _htr_uint64(v)
+
+    def test_checkpoint(self):
+        cp = C.Checkpoint(epoch=7, root=b"\x42" * 32)
+        assert hash_tree_root(cp) == _htr_checkpoint(7, b"\x42" * 32)
+        # fixed-size container serialization = field concatenation
+        assert serialize(cp) == (7).to_bytes(8, "little") + b"\x42" * 32
+
+    def test_attestation_data(self):
+        ad = C.AttestationData(
+            slot=3, index=5, beacon_block_root=b"\x01" * 32,
+            source=C.Checkpoint(epoch=1, root=b"\x02" * 32),
+            target=C.Checkpoint(epoch=2, root=b"\x03" * 32))
+        want = _merkleize([
+            _htr_uint64(3), _htr_uint64(5), _htr_bytes(b"\x01" * 32),
+            _htr_checkpoint(1, b"\x02" * 32), _htr_checkpoint(2, b"\x03" * 32),
+        ])
+        assert hash_tree_root(ad) == want
+
+    def test_validator_container(self):
+        v = C.Validator(
+            pubkey=b"\xaa" * 48, withdrawal_credentials=b"\xbb" * 32,
+            effective_balance=32 * 10**9, slashed=True,
+            activation_eligibility_epoch=1, activation_epoch=2,
+            exit_epoch=3, withdrawable_epoch=4)
+        want = _merkleize([
+            _htr_bytes(b"\xaa" * 48), _htr_bytes(b"\xbb" * 32),
+            _htr_uint64(32 * 10**9), _htr_bool(True),
+            _htr_uint64(1), _htr_uint64(2), _htr_uint64(3), _htr_uint64(4),
+        ])
+        assert hash_tree_root(v) == want
+
+    def test_list_of_uint64(self):
+        limit = 100
+        values = [5, 6, 7]
+        sed = SSZList(uint64, limit)
+        packed = _pack(b"".join(v.to_bytes(8, "little") for v in values))
+        want = _mix_len(_merkleize(packed, (limit * 8 + 31) // 32), len(values))
+        assert hash_tree_root(values, sed) == want
+
+    def test_bitlist(self):
+        limit = 40
+        bits = [True, False, True, True, False, False, True, False, True]
+        sed = Bitlist(limit)
+        byts = bytearray((len(bits) + 7) // 8)
+        for i, b in enumerate(bits):
+            if b:
+                byts[i // 8] |= 1 << (i % 8)
+        want = _mix_len(_merkleize(_pack(bytes(byts)), (limit + 255) // 256),
+                        len(bits))
+        assert hash_tree_root(bits, sed) == want
+        # serialization appends the length-delimiter bit
+        ser = bytearray(byts)
+        ser[len(bits) // 8] |= 1 << (len(bits) % 8)
+        assert serialize(bits, sed) == bytes(ser)
+
+
+# --- shuffle transcribed from the reference listing ---------------------------
+
+
+def _shuffle_from_reference(index: int, index_count: int, seed: bytes,
+                            rounds: int) -> int:
+    """Verbatim transcription of pos-evolution.md:511-533."""
+    assert index < index_count
+    for current_round in range(rounds):
+        pivot = int.from_bytes(
+            hashlib.sha256(seed + bytes([current_round])).digest()[0:8],
+            "little") % index_count
+        flip = (pivot + index_count - index) % index_count
+        position = max(index, flip)
+        source = hashlib.sha256(
+            seed + bytes([current_round])
+            + (position // 256).to_bytes(4, "little")).digest()
+        byte = source[(position % 256) // 8]
+        bit = (byte >> (position % 8)) % 2
+        index = flip if bit else index
+    return index
+
+
+class TestShuffleAgainstReferenceListing:
+    def test_scalar_helper_matches(self, minimal_cfg):
+        seed = hashlib.sha256(b"conformance-seed").digest()
+        n = 173
+        rounds = minimal_cfg.shuffle_round_count
+        got = [int(compute_shuffled_index(i, n, seed)) for i in range(n)]
+        want = [_shuffle_from_reference(i, n, seed, rounds) for i in range(n)]
+        assert got == want
+        assert sorted(got) == list(range(n))  # it is a permutation
+
+    def test_device_permutation_matches(self, minimal_cfg):
+        from pos_evolution_tpu.ops.shuffle import shuffle_permutation_jax
+        seed = hashlib.sha256(b"device-conformance").digest()
+        n = 128
+        rounds = minimal_cfg.shuffle_round_count
+        perm = np.asarray(shuffle_permutation_jax(seed, n, rounds))
+        want = [_shuffle_from_reference(i, n, seed, rounds) for i in range(n)]
+        assert perm.tolist() == want
+
+
+# --- BLS12-381 against the external standard ----------------------------------
+
+
+class TestBLSAgainstStandard:
+    """The curve parameters are fixed by the external standard (ZCash
+    BLS12-381 / IETF ciphersuites); any correct implementation must
+    reproduce these algebraic facts about them."""
+
+    def test_field_and_group_orders(self):
+        from pos_evolution_tpu.crypto import bls12_381 as b
+        # q prime of 381 bits, r prime of 255 bits, r | q^12 - 1 (embedding
+        # degree 12), and the curve orders: #E(Fq) = h1 * r
+        assert b.Q.bit_length() == 381
+        assert b.R.bit_length() == 255
+        assert pow(2, b.Q - 1, b.Q) == 1 and pow(2, b.R - 1, b.R) == 1
+        assert (b.Q**12 - 1) % b.R == 0
+        for k in (1, 2, 3, 4, 6):
+            assert (b.Q**k - 1) % b.R != 0, "embedding degree must be 12"
+        # BLS parametrization: r = x^4 - x^2 + 1, q = (x-1)^2/3 * r + x
+        x = -b.BLS_X
+        assert b.R == x**4 - x**2 + 1
+        assert b.Q == (x - 1)**2 // 3 * b.R + x
+
+    def test_generators_on_curve_and_order(self):
+        from pos_evolution_tpu.crypto import bls12_381 as b
+        assert b.g1_on_curve(b.G1_GEN)
+        assert b.g2_on_curve(b.G2_GEN)
+        assert b.ec_mul(b.G1_GEN, b.R) is None
+        assert b.ec_mul(b.G2_GEN, b.R) is None
+
+    def test_pairing_bilinear_nondegenerate(self):
+        from pos_evolution_tpu.crypto import bls12_381 as b
+        a, c = 6, 11
+        e_ab = b.pairing(b.ec_mul(b.G1_GEN, a), b.ec_mul(b.G2_GEN, c))
+        e_11 = b.pairing(b.G1_GEN, b.G2_GEN)
+        assert not e_11.is_one()          # non-degeneracy
+        assert e_ab == e_11.pow(a * c)    # bilinearity

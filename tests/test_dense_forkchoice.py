@@ -308,3 +308,94 @@ class TestIncrementalBuckets:
             expect[: bi + 1] += int(st.boost_amount)
         assert np.array_equal(np.asarray(w), expect)
         assert int(h) == capacity - 1  # chain head = tip
+
+
+class TestEpochWindowedBuckets:
+    """RLMD/Goldfish expiry on the incremental path: per-(block, epoch)
+    weight columns must reproduce the rescan with ``min_vote_epoch``
+    (pos-evolution.md:1581-1609; VERDICT r2 task 7)."""
+
+    WINDOW = 8
+
+    def _store(self, rng, capacity=32, n=256):
+        return TestIncrementalBuckets._random_store(
+            TestIncrementalBuckets(), rng, capacity, n)
+
+    @pytest.mark.parametrize("seed,min_epoch", [(0, 0), (1, 2), (2, 3)])
+    def test_rebuild_and_head_match_rescan(self, seed, min_epoch):
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.forkchoice import (
+            head_and_weights, head_from_epoch_buckets, rebuild_epoch_buckets)
+        rng = np.random.default_rng(seed)
+        capacity, n = 32, 256
+        st = self._store(rng, capacity, n)
+        base = 0
+        eb = rebuild_epoch_buckets(st.msg_block, st.msg_epoch, st.weight,
+                                   capacity, self.WINDOW, jnp.int64(base))
+        h_ref, w_ref = head_and_weights(st, capacity,
+                                        min_vote_epoch=min_epoch)
+        h_win, w_win = head_from_epoch_buckets(
+            st.parent, st.real, st.rank, st.leaf_viable, st.justified_idx,
+            eb, jnp.int64(base), jnp.int64(min_epoch), st.boost_idx,
+            st.boost_amount, capacity, self.WINDOW)
+        assert int(h_ref) == int(h_win)
+        assert np.array_equal(np.asarray(w_ref), np.asarray(w_win))
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_incremental_batches_match_rescan(self, seed):
+        """Vote batches (with duplicates, inactives, stale-epoch votes
+        below the window base) applied via the windowed kernel, then an
+        expiry-windowed head — vs the rescan oracle on the final table."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.forkchoice import (
+            apply_latest_messages_windowed, head_and_weights,
+            head_from_epoch_buckets, rebuild_epoch_buckets)
+        rng = np.random.default_rng(seed)
+        capacity, n = 32, 256
+        st = self._store(rng, capacity, n)
+        base = 1  # window already slid past epoch 0
+        eb = rebuild_epoch_buckets(st.msg_block, st.msg_epoch, st.weight,
+                                   capacity, self.WINDOW, jnp.int64(base))
+        mb, me = st.msg_block, st.msg_epoch
+        for _ in range(3):
+            k = 48
+            val_idx = jnp.asarray(rng.choice(32, size=k).astype(np.int32))
+            new_block = jnp.asarray(rng.integers(0, capacity, k).astype(np.int32))
+            # include stale votes (below base) AND above-window votes
+            # (clamped into the top column — must stay exact)
+            new_epoch = jnp.asarray(rng.integers(0, base + self.WINDOW + 3, k)
+                                    .astype(np.int64))
+            active = jnp.asarray(rng.random(k) < 0.8)
+            mb, me, eb = apply_latest_messages_windowed(
+                mb, me, eb, jnp.int64(base), val_idx, new_block, new_epoch,
+                st.weight[val_idx], active)
+        st2 = st._replace(msg_block=mb, msg_epoch=me)
+        for min_epoch in (base, base + 3):
+            h_ref, w_ref = head_and_weights(st2, capacity,
+                                            min_vote_epoch=min_epoch)
+            h_win, w_win = head_from_epoch_buckets(
+                st.parent, st.real, st.rank, st.leaf_viable,
+                st.justified_idx, eb, jnp.int64(base), jnp.int64(min_epoch),
+                st.boost_idx, st.boost_amount, capacity, self.WINDOW)
+            assert int(h_ref) == int(h_win), min_epoch
+            assert np.array_equal(np.asarray(w_ref), np.asarray(w_win))
+
+    def test_goldfish_window_one(self):
+        """eta = 1 (GHOST-Eph, pos-evolution.md:1549): only the most
+        recent epoch's votes carry weight."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.forkchoice import (
+            head_and_weights, head_from_epoch_buckets, rebuild_epoch_buckets)
+        rng = np.random.default_rng(9)
+        capacity, n = 16, 128
+        st = self._store(rng, capacity, n)
+        cur = 3
+        eb = rebuild_epoch_buckets(st.msg_block, st.msg_epoch, st.weight,
+                                   capacity, self.WINDOW, jnp.int64(0))
+        h_ref, w_ref = head_and_weights(st, capacity, min_vote_epoch=cur)
+        h_win, w_win = head_from_epoch_buckets(
+            st.parent, st.real, st.rank, st.leaf_viable, st.justified_idx,
+            eb, jnp.int64(0), jnp.int64(cur), st.boost_idx, st.boost_amount,
+            capacity, self.WINDOW)
+        assert int(h_ref) == int(h_win)
+        assert np.array_equal(np.asarray(w_ref), np.asarray(w_win))
