@@ -1,0 +1,84 @@
+"""End-to-end accelerated run at scale (VERDICT r2 task 5): the L6
+simulator — NOT a synthetic kernel harness — at >= 64K validators for
+>= 3 mainnet epochs, with the jax ExecutionBackend (device epoch sweeps,
+specs/epoch.py dispatch) and the resident device fork-choice store
+(every head query via head_from_buckets; no per-query host rebuild).
+
+Success criteria, asserted and recorded in SCALE_DEMO_r03.json:
+- epochs justify and finalize (justified >= 2, finalized >= 1 after 3
+  epochs — the reference's own finalization lag, pos-evolution.md:
+  839-852);
+- the resident-store head equals the spec get_head walk at the end;
+- per-handler p50/p95 from HandlerTimer (SURVEY.md §5).
+
+Usage: [JAX_PLATFORMS=cpu] python scripts/scale_demo.py [n_validators]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65_536
+    epochs = 3
+
+    import jax
+
+    from pos_evolution_tpu.backend import set_backend
+    from pos_evolution_tpu.config import mainnet_config, use_config
+
+    set_backend("jax")
+    with use_config(mainnet_config()):
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.specs import forkchoice as fc
+
+        t0 = time.time()
+        sim = Simulation(n, accelerated_forkchoice=True)
+        init_s = time.time() - t0
+        print(f"# init {n} validators: {init_s:.1f}s", file=sys.stderr)
+
+        t0 = time.time()
+        per_epoch = []
+        for e in range(1, epochs + 1):
+            te = time.time()
+            sim.run_epochs(e)
+            per_epoch.append(round(time.time() - te, 1))
+            m = sim.metrics[-1]
+            print(f"# epoch {e}: {per_epoch[-1]}s  justified="
+                  f"{m['justified_epoch']} finalized={m['finalized_epoch']} "
+                  f"blocks={m['n_blocks']}", file=sys.stderr)
+        run_s = time.time() - t0
+
+        group = sim.groups[0]
+        spec_head = fc.get_head(group.store)
+        resident_head = sim._get_head(group)
+        out = {
+            "n_validators": n,
+            "epochs": epochs,
+            "backend": "jax/" + jax.default_backend(),
+            "accelerated_forkchoice": True,
+            "init_s": round(init_s, 1),
+            "run_s": round(run_s, 1),
+            "per_epoch_s": per_epoch,
+            "justified_epoch": sim.justified_epoch(),
+            "finalized_epoch": sim.finalized_epoch(),
+            "resident_head_equals_spec_walk": resident_head == spec_head,
+            "handler_timers": sim.trace_summary(),
+            "last_slots": sim.metrics[-3:],
+        }
+        assert out["justified_epoch"] >= 2, out
+        assert out["finalized_epoch"] >= 1, out
+        assert out["resident_head_equals_spec_walk"], out
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "SCALE_DEMO_r03.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,13 +1,16 @@
 #!/bin/bash
 # Opportunistic on-TPU evidence capture: run when the axon tunnel is alive.
-# Produces PALLAS_TPU_r03.json + ACCEL_TESTS_r03.txt + a fresh bench line.
+# Produces PALLAS_TPU_r03.json + ACCEL_TESTS_r03.txt + BENCH_ALL_r03.json
+# + a fresh bench line.
 set -u
 cd "$(dirname "$0")/.."
 echo "== probe =="
 timeout 90 python -c "import jax,numpy,jax.numpy as jnp; d=jax.devices(); numpy.asarray(jnp.arange(4)+1); print('tunnel alive:', d)" || { echo "tunnel dead"; exit 3; }
 echo "== accel-gated tests =="
-POS_TEST_ACCEL=1 timeout 1200 python -m pytest tests/test_pallas.py tests/test_fp_device.py -q 2>&1 | tail -3 | tee ACCEL_TESTS_r03.txt
+POS_TEST_ACCEL=1 timeout 1800 python -m pytest tests/test_pallas.py tests/test_fp_device.py tests/test_tower_device.py -q 2>&1 | tail -3 | tee ACCEL_TESTS_r03.txt
 echo "== pallas evidence =="
 timeout 1800 python scripts/pallas_tpu_evidence.py 2>/dev/null | tail -1
-echo "== bench =="
+echo "== bench matrix =="
+timeout 3600 python bench_all.py --record 3 2>&1 | tail -5
+echo "== headline bench =="
 timeout 1800 python bench.py
