@@ -123,6 +123,13 @@ class Config:
     # --- eth1 ---
     epochs_per_eth1_voting_period: int = 64
 
+    # --- merge transition (pos-evolution.md:1011-1013) ---
+    # The simulator's PoW chain is tiny, so the default threshold is small;
+    # mainnet's 5.875e22 would just be this knob set higher.
+    terminal_total_difficulty: int = 2**20
+    terminal_block_hash: bytes = b"\x00" * 32
+    terminal_block_hash_activation_epoch: int = 2**64 - 1
+
     # --- protocol-variant knobs (L7) ---
     # Vote expiry period η: ∞ (None→2**62) = LMD, 1 = Goldfish
     # (pos-evolution.md:1585).

@@ -89,8 +89,12 @@ def aggregate_verify_batch(pk_states, committees, bits, msg_words, signatures):
     """
     a, c = committees.shape
     states = pk_states[committees]                    # (A, C, 8)
-    block2 = _msg_block2(msg_words)[:, None, :]       # (A, 1, 16) broadcast
-    h1 = sha256_compress(states, jnp.broadcast_to(block2, (a, c, 16)))
+    # (A, 1, 16): the lane axis stays size-1 so the message schedule is
+    # genuinely computed once per committee and broadcast inside the round
+    # arithmetic. (An explicit broadcast_to(A, C, 16) here also sent XLA's
+    # algebraic simplifier into a 50-run circular-simplification loop.)
+    block2 = _msg_block2(msg_words)[:, None, :]
+    h1 = sha256_compress(states, block2)
     h2 = _chain_hash(h1)
     h3 = _chain_hash(h2)
     sigs = jnp.concatenate([h1, h2, h3], axis=-1)     # (A, C, 24)

@@ -353,6 +353,17 @@ def apply_latest_messages_windowed(msg_block, msg_epoch, epoch_buckets,
 
 
 @partial(jax.jit, static_argnames=("capacity", "window"))
+def _head_from_epoch_buckets_jit(parent, real, rank, leaf_viable,
+                                 justified_idx, epoch_buckets, base_epoch,
+                                 min_vote_epoch, boost_idx, boost_amount,
+                                 capacity: int, window: int):
+    cols = base_epoch + jnp.arange(window, dtype=epoch_buckets.dtype)
+    vote_weight = jnp.where(cols[:, None] >= min_vote_epoch,
+                            epoch_buckets.T, 0).sum(axis=0)
+    return _head_from_buckets(parent, real, rank, leaf_viable, justified_idx,
+                              vote_weight, boost_idx, boost_amount, capacity)
+
+
 def head_from_epoch_buckets(parent, real, rank, leaf_viable, justified_idx,
                             epoch_buckets, base_epoch, min_vote_epoch,
                             boost_idx, boost_amount, capacity: int,
@@ -360,13 +371,29 @@ def head_from_epoch_buckets(parent, real, rank, leaf_viable, justified_idx,
     """Expiry-windowed head from resident columns: mask columns below
     ``min_vote_epoch`` (= current_epoch - eta + 1 in RLMD terms), sum,
     descend. Differential oracle: ``head_and_weights(min_vote_epoch=...)``
-    (pinned in tests/test_dense_forkchoice.py); requires
-    min_vote_epoch >= base_epoch (older columns no longer exist)."""
-    cols = base_epoch + jnp.arange(window, dtype=epoch_buckets.dtype)
-    vote_weight = jnp.where(cols[:, None] >= min_vote_epoch,
-                            epoch_buckets.T, 0).sum(axis=0)
-    return _head_from_buckets(parent, real, rank, leaf_viable, justified_idx,
-                              vote_weight, boost_idx, boost_amount, capacity)
+    (pinned in tests/test_dense_forkchoice.py).
+
+    Validity window: ``base_epoch <= min_vote_epoch <= base_epoch +
+    window - 1``. Below the lower bound behaves as ``base_epoch`` (older
+    columns no longer exist, so nothing extra can be unmasked); above the
+    upper bound the clamped top column — which holds every vote from
+    epoch >= base_epoch + window - 1 — would be masked out and the head
+    silently undercounted, so concrete out-of-range values fail loudly
+    here. Callers passing traced epochs must size the window themselves
+    (the check cannot see traced values)."""
+    if not (isinstance(base_epoch, jax.core.Tracer)
+            or isinstance(min_vote_epoch, jax.core.Tracer)):
+        hi = int(base_epoch) + window - 1
+        if int(min_vote_epoch) > hi:
+            raise ValueError(
+                f"min_vote_epoch {int(min_vote_epoch)} is above the top "
+                f"resident column (base_epoch {int(base_epoch)} + window "
+                f"{window} - 1 = {hi}); clamped votes would be masked out. "
+                f"Rebuild the buckets with a higher base_epoch instead.")
+    return _head_from_epoch_buckets_jit(
+        parent, real, rank, leaf_viable, justified_idx, epoch_buckets,
+        base_epoch, min_vote_epoch, boost_idx, boost_amount,
+        capacity=capacity, window=window)
 
 
 # --- host-side densification --------------------------------------------------

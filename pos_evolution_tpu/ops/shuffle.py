@@ -37,11 +37,13 @@ def _seed_words(seed: bytes) -> np.ndarray:
     return np.frombuffer(seed, dtype=">u4").astype(np.uint32)
 
 
-@partial(jax.jit, static_argnames=("n", "rounds"))
-def _shuffle_device(seed_words, pivots, n: int, rounds: int):
-    """Full permutation: returns p with p[i] = shuffled index of i."""
+def _shuffle_rounds(seed_words, pivots, idx0, n: int, rounds: int):
+    """Run the fixed swap-or-not round schedule on ``idx0`` (any slice of
+    the index space — each index's trajectory is independent, which is
+    what makes the kernel shardable; see ``parallel.sharded.sharded_shuffle``).
+    Positions range over the FULL [0, n), so the per-round digest table
+    covers all (n+255)//256 blocks regardless of the slice."""
     n_blocks = (n + 255) // 256
-    idx0 = jnp.arange(n, dtype=jnp.int32)
 
     # Static message template for the per-round block hashes:
     # bytes = seed(32) | round(1) | block_le(4) | 0x80 | zeros | len(296 bits)
@@ -73,6 +75,13 @@ def _shuffle_device(seed_words, pivots, n: int, rounds: int):
         return jnp.where(bit.astype(bool), flip, idx)
 
     return jax.lax.fori_loop(0, rounds, round_body, idx0)
+
+
+@partial(jax.jit, static_argnames=("n", "rounds"))
+def _shuffle_device(seed_words, pivots, n: int, rounds: int):
+    """Full permutation: returns p with p[i] = shuffled index of i."""
+    idx0 = jnp.arange(n, dtype=jnp.int32)
+    return _shuffle_rounds(seed_words, pivots, idx0, n, rounds)
 
 
 def shuffle_permutation_jax(seed: bytes, n: int, rounds: int) -> jax.Array:

@@ -25,7 +25,7 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 from jax.experimental import mesh_utils  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
-from jax.experimental.shard_map import shard_map  # noqa: E402
+from jax import shard_map  # noqa: E402
 
 from pos_evolution_tpu.config import Config  # noqa: E402
 from pos_evolution_tpu.ops.epoch import (  # noqa: E402
@@ -133,11 +133,12 @@ def ring_allreduce_tally(mesh: Mesh):
     both = (POD_AXIS, SHARD_AXIS)
     vspec = P(both)
 
-    # check_rep off: the ring leaves every shard holding the same total,
-    # but that replication is not statically inferable from ppermute.
+    # varying-manual-axes check off: the ring leaves every shard holding
+    # the same total, but that replication is not statically inferable
+    # from ppermute.
     @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=(vspec, vspec), out_specs=P(),
-             check_rep=False)
+             check_vma=False)
     def tally(mask, values):
         local = jnp.sum(jnp.where(mask, values, 0))
         n_shard = mesh.shape[SHARD_AXIS]
@@ -152,6 +153,96 @@ def ring_allreduce_tally(mesh: Mesh):
         return jax.lax.psum(acc, POD_AXIS)  # fold pods over DCN
 
     return tally
+
+
+def sharded_vote_weights(mesh: Mesh, capacity: int):
+    """Fork-choice latest-message accumulation sharded over validators
+    (north-star config #1; pos-evolution.md:905-931's latest_messages →
+    weights): each shard segment-sums its local (msg_block, weight) votes
+    into a full block-indexed weight vector, then a two-axis ``psum``
+    (ICI then DCN) merges the partial tallies. Bit-identical to the
+    single-chip ``segment_sum`` — int64 addition reassociates exactly —
+    so the dense subtree/head pass can run replicated on the result.
+
+    msg_block int32[N] (validator-sharded; <0 = no vote), weight int64[N]
+    → vote_weight int64[capacity] (replicated).
+    """
+    both = (POD_AXIS, SHARD_AXIS)
+    vspec = P(both)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(vspec, vspec), out_specs=P())
+    def votes(msg_block, weight):
+        valid = msg_block >= 0
+        seg = jnp.where(valid, msg_block, capacity)
+        local = jax.ops.segment_sum(
+            jnp.where(valid, weight, 0), seg,
+            num_segments=capacity + 1)[:capacity]
+        return jax.lax.psum(jax.lax.psum(local, SHARD_AXIS), POD_AXIS)
+
+    return votes
+
+
+def sharded_aggregation_verify(mesh: Mesh):
+    """Attestation-aggregate verification sharded over committees
+    (north-star config #3): the committee/batch axis is embarrassingly
+    parallel (pos-evolution.md:472-475 — committees partition the slot's
+    validators), so the pk-midstate table is replicated, the per-aggregate
+    inputs are sharded on axis 0, every shard verifies its slice with the
+    single-chip kernel, and one tiled ``all_gather`` merges the verdicts.
+
+    pk_states (N, 8) u32 replicated; committees (A, C) i32, bits (A, C)
+    bool, msg_words (A, 8) u32, signatures (A, 24) u32 all sharded on A.
+    A must divide by the device count. Returns bool[A] (replicated).
+    """
+    both = (POD_AXIS, SHARD_AXIS)
+    aspec = P(both)
+
+    from pos_evolution_tpu.ops.aggregation import aggregate_verify_batch
+
+    # check_vma off: the SHA-256 fori_loop carry mixes the replicated
+    # message schedule with shard-varying lane states, which the static
+    # varying-axes inference cannot type (it would need per-carry pcasts
+    # inside the shared kernel); correctness is pinned by the differential
+    # test against the single-chip kernel instead.
+    @jax.jit
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(), aspec, aspec, aspec, aspec), out_specs=P(),
+             check_vma=False)
+    def verify(pk_states, committees, bits, msg_words, signatures):
+        ok = aggregate_verify_batch(
+            pk_states, committees, bits, msg_words, signatures)
+        return jax.lax.all_gather(ok, both, axis=0, tiled=True)
+
+    return verify
+
+
+def sharded_shuffle(mesh: Mesh, n: int, rounds: int):
+    """Swap-or-not committee shuffle sharded over validator indices
+    (north-star config #2; pos-evolution.md:513-535): every index's
+    swap-or-not trajectory is independent, so each shard runs the full
+    fixed round schedule on its local index slice against the replicated
+    seed/pivot data — zero collectives, the embarrassingly-parallel ideal.
+    The per-round digest table spans the FULL position space (positions
+    mix across shards), which is why ``_shuffle_rounds`` takes ``n``
+    globally rather than per-shard.
+
+    Call with idx = arange(n) sharded over validators; n must divide by
+    the device count. Returns the permutation, validator-sharded.
+    """
+    vspec = P((POD_AXIS, SHARD_AXIS))
+
+    from pos_evolution_tpu.ops.shuffle import _shuffle_rounds
+
+    # check_vma off: same SHA-256 carry-typing limitation as
+    # ``sharded_aggregation_verify`` (differentially pinned instead).
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(), P(), vspec),
+             out_specs=vspec, check_vma=False)
+    def shuf(seed_words, pivots, idx):
+        return _shuffle_rounds(seed_words, pivots, idx, n, rounds)
+
+    return shuf
 
 
 def gossip_all_gather(mesh: Mesh):

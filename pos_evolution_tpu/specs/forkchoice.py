@@ -342,6 +342,12 @@ def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
     state = pre_state.copy()
     state_transition(state, signed_block, True)
 
+    # [New in Bellatrix] merge-transition validation (pos-evolution.md:1011-1013).
+    from pos_evolution_tpu.specs.merge import (
+        is_merge_transition_block, validate_merge_block)
+    if is_merge_transition_block(pre_state, block.body):
+        validate_merge_block(block)
+
     block_root = hash_tree_root(block)
     store.blocks[block_root] = block
     store.block_states[block_root] = state

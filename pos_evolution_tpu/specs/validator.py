@@ -82,7 +82,8 @@ def sign_block(state: BeaconState, block: BeaconBlock) -> SignedBeaconBlock:
 
 def build_block(parent_state: BeaconState, slot: int, attestations=(),
                 attester_slashings=(), deposits=(), voluntary_exits=(),
-                graffiti: bytes = b"\x00" * 32) -> SignedBeaconBlock:
+                graffiti: bytes = b"\x00" * 32,
+                execution_payload=None) -> SignedBeaconBlock:
     """Produce a valid signed block for ``slot`` on top of ``parent_state``.
 
     Follows the proposer duty of pos-evolution.md:597: run the state forward,
@@ -106,6 +107,8 @@ def build_block(parent_state: BeaconState, slot: int, attestations=(),
         deposits=list(deposits),
         voluntary_exits=list(voluntary_exits),
     )
+    if execution_payload is not None:
+        body.execution_payload = execution_payload
     block = BeaconBlock(
         slot=slot,
         proposer_index=proposer_index,

@@ -114,3 +114,35 @@ class StoreInvariantChecker:
                     f"{getattr(handler, '__name__', handler)} mutated the store "
                     f"on a failed call")
             raise
+
+
+@contextmanager
+def device_trace(log_dir, annotation: str | None = None):
+    """``jax.profiler`` device trace around a code region (SURVEY.md §5:
+    per-handler tracing "via jax.profiler traces + host-side counters").
+
+    Writes a TensorBoard/XProf-loadable trace (xplane protobuf) under
+    ``log_dir`` covering every device op dispatched inside the region —
+    the device-timeline complement to ``HandlerTimer``'s host wall-clock.
+    Optionally wraps the region in a named ``TraceAnnotation`` so it is
+    findable on the trace timeline.
+    """
+    import jax
+
+    with jax.profiler.trace(str(log_dir)):
+        if annotation is not None:
+            with jax.profiler.TraceAnnotation(annotation):
+                yield
+        else:
+            yield
+
+
+@contextmanager
+def trace_region(name: str):
+    """Named ``jax.profiler.TraceAnnotation`` region (e.g. per handler:
+    ``with trace_region("on_block"): ...``) — visible in any enclosing
+    ``device_trace`` timeline; free when no trace is active."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -4,7 +4,8 @@ simulator — NOT a synthetic kernel harness — at >= 64K validators for
 specs/epoch.py dispatch) and the resident device fork-choice store
 (every head query via head_from_buckets; no per-query host rebuild).
 
-Success criteria, asserted and recorded in SCALE_DEMO_r03.json:
+Success criteria, asserted and recorded in SCALE_DEMO_r{N}.json
+(N from --record, default 4):
 - epochs justify and finalize (justified >= 2, finalized >= 1 after 3
   epochs — the reference's own finalization lag, pos-evolution.md:
   839-852);
@@ -12,6 +13,7 @@ Success criteria, asserted and recorded in SCALE_DEMO_r03.json:
 - per-handler p50/p95 from HandlerTimer (SURVEY.md §5).
 
 Usage: [JAX_PLATFORMS=cpu] python scripts/scale_demo.py [n_validators]
+       [--record N]
 """
 
 import json
@@ -23,7 +25,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65_536
+    args = sys.argv[1:]
+    record = 4
+    if "--record" in args:
+        i = args.index("--record")
+        try:
+            record = int(args[i + 1])
+        except (IndexError, ValueError):
+            sys.exit("Usage: python scripts/scale_demo.py [n] [--record N]")
+        del args[i:i + 2]
+    n = int(args[0]) if args else 65_536
     epochs = 3
 
     import jax
@@ -74,7 +85,7 @@ def main():
         assert out["finalized_epoch"] >= 1, out
         assert out["resident_head_equals_spec_walk"], out
         path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "SCALE_DEMO_r03.json")
+            os.path.abspath(__file__))), f"SCALE_DEMO_r{record:02d}.json")
         with open(path, "w") as f:
             json.dump(out, f, indent=1)
         print(json.dumps(out, indent=1))

@@ -1,0 +1,155 @@
+"""Merge-transition validation tests (pos-evolution.md:1011-1013).
+
+Covers the two helpers the reference's ``on_block`` consults when a block
+crosses the PoW→PoS boundary, and their wiring into ``on_block``.
+"""
+
+import pytest
+
+from pos_evolution_tpu.config import cfg, use_config
+from pos_evolution_tpu.specs import forkchoice as fc
+from pos_evolution_tpu.specs import merge
+from pos_evolution_tpu.specs.containers import ExecutionPayload
+from pos_evolution_tpu.specs.genesis import make_genesis
+from pos_evolution_tpu.specs.validator import build_block
+from pos_evolution_tpu.ssz import hash_tree_root
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+TTD = None  # read from cfg() inside tests
+
+
+@pytest.fixture(autouse=True)
+def _clean_pow_chain():
+    merge.clear_pow_chain()
+    merge.set_pow_block_provider(None)
+    yield
+    merge.clear_pow_chain()
+    merge.set_pow_block_provider(None)
+
+
+def _payload(parent_hash: bytes) -> ExecutionPayload:
+    return ExecutionPayload(parent_hash=parent_hash, block_number=1,
+                            block_hash=b"\xee" * 32)
+
+
+def _terminal_pair(ttd: int):
+    """Register grandparent (below TTD) and parent (at TTD); return parent hash."""
+    gp = merge.PowBlock(block_hash=b"\xaa" * 32, parent_hash=b"\x00" * 32,
+                        total_difficulty=ttd - 1)
+    p = merge.PowBlock(block_hash=b"\xbb" * 32, parent_hash=gp.block_hash,
+                       total_difficulty=ttd)
+    merge.register_pow_block(gp)
+    merge.register_pow_block(p)
+    return p.block_hash
+
+
+class TestPredicates:
+    def test_default_payload_is_not_transition(self):
+        state, _ = make_genesis(16)
+        sb = build_block(state, 1)
+        assert not merge.is_merge_transition_block(state, sb.message.body)
+
+    def test_real_payload_on_premerge_state_is_transition(self):
+        state, _ = make_genesis(16)
+        sb = build_block(state, 1, execution_payload=_payload(b"\xbb" * 32))
+        assert merge.is_merge_transition_block(state, sb.message.body)
+
+    def test_postmerge_state_is_not_transition(self):
+        state, _ = make_genesis(16)
+        state.latest_execution_payload_header.block_number = 7
+        sb = build_block(state, 1, execution_payload=_payload(b"\xbb" * 32))
+        assert merge.is_merge_transition_complete(state)
+        assert not merge.is_merge_transition_block(state, sb.message.body)
+
+    def test_terminal_pow_block_straddles_ttd(self):
+        ttd = cfg().terminal_total_difficulty
+        below = merge.PowBlock(b"\x01" * 32, b"\x00" * 32, ttd - 1)
+        at = merge.PowBlock(b"\x02" * 32, b"\x01" * 32, ttd)
+        above = merge.PowBlock(b"\x03" * 32, b"\x02" * 32, ttd + 5)
+        assert merge.is_valid_terminal_pow_block(at, below)
+        assert not merge.is_valid_terminal_pow_block(below, below)
+        # Parent already at TTD → this block is past, not at, the boundary.
+        assert not merge.is_valid_terminal_pow_block(above, at)
+
+
+class TestValidateMergeBlock:
+    def test_valid_terminal_parent_accepted(self):
+        state, _ = make_genesis(16)
+        parent_hash = _terminal_pair(cfg().terminal_total_difficulty)
+        sb = build_block(state, 1, execution_payload=_payload(parent_hash))
+        merge.validate_merge_block(sb.message)  # no raise
+
+    def test_unavailable_pow_block_rejected(self):
+        state, _ = make_genesis(16)
+        sb = build_block(state, 1, execution_payload=_payload(b"\xcc" * 32))
+        with pytest.raises(AssertionError, match="unavailable"):
+            merge.validate_merge_block(sb.message)
+
+    def test_insufficient_difficulty_rejected(self):
+        ttd = cfg().terminal_total_difficulty
+        gp = merge.PowBlock(b"\xaa" * 32, b"\x00" * 32, ttd - 10)
+        p = merge.PowBlock(b"\xbb" * 32, gp.block_hash, ttd - 1)
+        merge.register_pow_block(gp)
+        merge.register_pow_block(p)
+        state, _ = make_genesis(16)
+        sb = build_block(state, 1, execution_payload=_payload(p.block_hash))
+        with pytest.raises(AssertionError, match="terminal total difficulty"):
+            merge.validate_merge_block(sb.message)
+
+    def test_terminal_block_hash_override(self):
+        th = b"\x7f" * 32
+        with use_config(cfg().replace(terminal_block_hash=th,
+                                      terminal_block_hash_activation_epoch=0)):
+            state, _ = make_genesis(16)
+            ok = build_block(state, 1, execution_payload=_payload(th))
+            merge.validate_merge_block(ok.message)  # no raise
+            bad = build_block(state, 1, execution_payload=_payload(b"\x11" * 32))
+            with pytest.raises(AssertionError, match="terminal block"):
+                merge.validate_merge_block(bad.message)
+
+    def test_override_activation_epoch_gate(self):
+        th = b"\x7f" * 32
+        far = 2**32
+        with use_config(cfg().replace(terminal_block_hash=th,
+                                      terminal_block_hash_activation_epoch=far)):
+            state, _ = make_genesis(16)
+            sb = build_block(state, 1, execution_payload=_payload(th))
+            with pytest.raises(AssertionError, match="activation epoch"):
+                merge.validate_merge_block(sb.message)
+
+
+class TestOnBlockWiring:
+    def _store(self, n=32):
+        state, anchor = make_genesis(n)
+        store = fc.get_forkchoice_store(state, anchor)
+        return store, state
+
+    def _tick(self, store, slot):
+        fc.on_tick(store, store.genesis_time + slot * cfg().seconds_per_slot)
+
+    def test_transition_block_without_pow_view_rejected(self):
+        store, state = self._store()
+        self._tick(store, 1)
+        sb = build_block(state, 1, execution_payload=_payload(b"\xdd" * 32))
+        with pytest.raises(AssertionError, match="unavailable"):
+            fc.on_block(store, sb)
+        assert hash_tree_root(sb.message) not in store.blocks
+
+    def test_transition_block_with_terminal_parent_accepted(self):
+        store, state = self._store()
+        parent_hash = _terminal_pair(cfg().terminal_total_difficulty)
+        self._tick(store, 1)
+        sb = build_block(state, 1, execution_payload=_payload(parent_hash))
+        fc.on_block(store, sb)
+        root = hash_tree_root(sb.message)
+        assert root in store.blocks
+        # Post-state has recorded the payload header: merge is complete, so
+        # a descendant with another payload is NOT re-validated.
+        post = store.block_states[root]
+        assert merge.is_merge_transition_complete(post)
+        self._tick(store, 2)
+        child = build_block(post, 2,
+                            execution_payload=_payload(b"\x55" * 32))
+        fc.on_block(store, child)  # no PoW view needed post-merge
+        assert hash_tree_root(child.message) in store.blocks

@@ -131,3 +131,98 @@ class TestNumpyCollectivesParity:
         assert np.array_equal(c.psum(x, "shard"), x)
         assert c.all_gather(x, "shard").shape == (1, 4)
         assert c.axis_index("shard") == 0
+
+
+class TestShardedVoteWeights:
+    def test_matches_single_chip_segment_sum(self, mesh):
+        """Config #1 sharded: validator-sharded latest-message accumulation
+        psum-merged == single-device segment_sum (and the host oracle)."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.parallel.sharded import sharded_vote_weights
+
+        n, capacity = 256, 32
+        rng = np.random.default_rng(3)
+        msg_block = rng.integers(-1, capacity, n).astype(np.int32)
+        weight = rng.integers(1, 33, n).astype(np.int64) * 10**9
+
+        votes = sharded_vote_weights(mesh, capacity)
+        got = np.asarray(votes(jnp.asarray(msg_block), jnp.asarray(weight)))
+
+        want = np.zeros(capacity + 1, np.int64)
+        np.add.at(want, np.where(msg_block >= 0, msg_block, capacity),
+                  np.where(msg_block >= 0, weight, 0))
+        assert np.array_equal(got, want[:capacity])
+
+    def test_feeds_subtree_pass(self, mesh):
+        """The replicated psum output composes with the binary-lifting
+        subtree pass to reproduce the single-chip head weights."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.forkchoice import _subtree_accumulate
+        from pos_evolution_tpu.parallel.sharded import sharded_vote_weights
+
+        n, capacity = 128, 16
+        rng = np.random.default_rng(4)
+        msg_block = rng.integers(0, capacity, n).astype(np.int32)
+        weight = np.full(n, 10**9, np.int64)
+        parent = jnp.asarray(np.arange(-1, capacity - 1, dtype=np.int32))
+        real = jnp.ones(capacity, bool)
+
+        votes = sharded_vote_weights(mesh, capacity)
+        vw = votes(jnp.asarray(msg_block), jnp.asarray(weight))
+        got = np.asarray(_subtree_accumulate(parent, real, vw, capacity))
+
+        vw_single = np.bincount(msg_block, weights=weight.astype(float),
+                                minlength=capacity).astype(np.int64)
+        want = np.asarray(_subtree_accumulate(
+            parent, real, jnp.asarray(vw_single), capacity))
+        assert np.array_equal(got, want)
+
+
+class TestShardedAggregation:
+    def test_matches_single_chip_kernel(self, mesh):
+        """Config #3 sharded: committee-sharded aggregate verification
+        all-gather-merged == the single-chip kernel, valid + corrupt."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.aggregation import (
+            aggregate_verify_batch, precompute_pk_states)
+        from pos_evolution_tpu.parallel.sharded import (
+            sharded_aggregation_verify)
+
+        n, n_agg, lanes = 64, 16, 8
+        rng = np.random.default_rng(5)
+        pk_states = precompute_pk_states(
+            rng.integers(0, 256, (n, 48)).astype(np.uint8))
+        committees = rng.integers(0, n, (n_agg, lanes)).astype(np.int32)
+        bits = rng.integers(0, 2, (n_agg, lanes)).astype(bool)
+        msg_words = rng.integers(0, 2**32, (n_agg, 8),
+                                 dtype=np.uint64).astype(np.uint32)
+        sigs = rng.integers(0, 2**32, (n_agg, 24),
+                            dtype=np.uint64).astype(np.uint32)
+        verify = sharded_aggregation_verify(mesh)
+        got = np.asarray(verify(pk_states, jnp.asarray(committees),
+                                jnp.asarray(bits), jnp.asarray(msg_words),
+                                jnp.asarray(sigs)))
+        want = np.asarray(aggregate_verify_batch(
+            pk_states, jnp.asarray(committees), jnp.asarray(bits),
+            jnp.asarray(msg_words), jnp.asarray(sigs)))
+        assert np.array_equal(got, want)
+
+
+class TestShardedShuffle:
+    def test_matches_single_chip_permutation(self, mesh):
+        """Config #2 sharded: index-sharded swap-or-not == the single-chip
+        permutation (which is itself pinned to the scalar spec oracle)."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.ops.shuffle import (
+            _seed_words, host_pivots, shuffle_permutation_jax)
+        from pos_evolution_tpu.parallel.sharded import sharded_shuffle
+
+        n, rounds = 512, 10
+        seed = bytes(reversed(range(32)))
+        shuf = sharded_shuffle(mesh, n, rounds)
+        got = np.asarray(shuf(jnp.asarray(_seed_words(seed)),
+                              jnp.asarray(host_pivots(seed, n, rounds)),
+                              jnp.arange(n, dtype=jnp.int32)))
+        want = np.asarray(shuffle_permutation_jax(seed, n, rounds))
+        assert np.array_equal(got, want)
+        assert sorted(got) == list(range(n))  # a real permutation

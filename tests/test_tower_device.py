@@ -130,3 +130,30 @@ class TestFq12:
         got = jax.jit(lambda v: tower.fq12_pow_bits(v, bits))(enc)
         for i in range(2):
             assert tower.fq12_decode(got, (i,)) == xs[i].pow(e)
+
+
+class TestCyclotomic:
+    def test_cyclotomic_sq_matches_dense_in_subgroup(self):
+        """Granger-Scott squaring == dense squaring for easy-part outputs
+        (the only inputs the final-exponentiation ladders feed it), and
+        the cyclotomic pow ladder == the generic ladder there too."""
+        rng = np.random.default_rng(7)
+        x = rand_fq12(rng)
+        # easy part maps any unit into the cyclotomic subgroup
+        cyc = x.conj() * x.inv()
+        cyc = cyc.pow(oracle.Q * oracle.Q) * cyc
+        enc = batch([tower.fq12_encode(cyc)])
+        got = tower.fq12_decode(jax.jit(tower.fq12_cyclotomic_sq)(enc), (0,))
+        assert got == cyc.sq()
+
+        e = int.from_bytes(rng.bytes(8), "big")
+        bits = np.array([b == "1" for b in bin(e)[2:]], dtype=bool)
+        gotp = tower.fq12_decode(
+            jax.jit(lambda v: tower.fq12_pow_bits_cyclotomic(v, bits))(enc),
+            (0,))
+        assert gotp == cyc.pow(e)
+
+    def test_cyclotomic_sq_of_one_is_one(self):
+        one = batch([tower.fq12_encode(oracle.FQ12_ONE)])
+        got = tower.fq12_decode(tower.fq12_cyclotomic_sq(one), (0,))
+        assert got == oracle.FQ12_ONE

@@ -1,0 +1,27 @@
+"""Device-trace tests (SURVEY.md §5 tracing/profiling): jax.profiler
+traces must capture device work dispatched inside the traced region."""
+
+import glob
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from pos_evolution_tpu.utils.metrics import device_trace, trace_region  # noqa: E402
+
+
+class TestDeviceTrace:
+    def test_trace_writes_xplane(self, tmp_path):
+        import jax.numpy as jnp
+        import numpy as np
+
+        with device_trace(tmp_path, "test-region"):
+            with trace_region("inner-op"):
+                np.asarray(jnp.arange(2048.0) ** 2)
+        files = glob.glob(str(tmp_path / "**" / "*.xplane.pb"), recursive=True)
+        assert files, "device trace produced no xplane protobuf"
+
+    def test_trace_region_free_when_untraced(self):
+        # TraceAnnotation outside any active trace must be a no-op
+        with trace_region("orphan"):
+            pass
