@@ -381,13 +381,17 @@ def head_from_epoch_buckets(parent, real, rank, leaf_viable, justified_idx,
     silently undercounted, so concrete out-of-range values fail loudly
     here. Callers passing traced epochs must size the window themselves
     (the check cannot see traced values)."""
-    if not (isinstance(base_epoch, jax.core.Tracer)
-            or isinstance(min_vote_epoch, jax.core.Tracer)):
+    try:
         hi = int(base_epoch) + window - 1
-        if int(min_vote_epoch) > hi:
+        mve = int(min_vote_epoch)
+    except (jax.errors.TracerIntegerConversionError,
+            jax.errors.ConcretizationTypeError):
+        pass  # traced epochs: callers must size the window themselves
+    else:
+        if mve > hi:
             raise ValueError(
-                f"min_vote_epoch {int(min_vote_epoch)} is above the top "
-                f"resident column (base_epoch {int(base_epoch)} + window "
+                f"min_vote_epoch {mve} is above the top "
+                f"resident column (base_epoch {hi - window + 1} + window "
                 f"{window} - 1 = {hi}); clamped votes would be masked out. "
                 f"Rebuild the buckets with a higher base_epoch instead.")
     return _head_from_epoch_buckets_jit(

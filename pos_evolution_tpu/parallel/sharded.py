@@ -214,7 +214,16 @@ def sharded_aggregation_verify(mesh: Mesh):
             pk_states, committees, bits, msg_words, signatures)
         return jax.lax.all_gather(ok, both, axis=0, tiled=True)
 
-    return verify
+    def checked_verify(pk_states, committees, bits, msg_words, signatures):
+        a = committees.shape[0]
+        if a % mesh.size != 0:
+            raise ValueError(
+                f"sharded_aggregation_verify: batch axis A={a} (committees"
+                f".shape[0]) must be divisible by the mesh device count "
+                f"{mesh.size} (aggregates are sharded evenly)")
+        return verify(pk_states, committees, bits, msg_words, signatures)
+
+    return checked_verify
 
 
 def sharded_shuffle(mesh: Mesh, n: int, rounds: int):
@@ -230,6 +239,10 @@ def sharded_shuffle(mesh: Mesh, n: int, rounds: int):
     Call with idx = arange(n) sharded over validators; n must divide by
     the device count. Returns the permutation, validator-sharded.
     """
+    if n % mesh.size != 0:
+        raise ValueError(
+            f"sharded_shuffle: n={n} must be divisible by the mesh device "
+            f"count {mesh.size} (the index axis is sharded evenly)")
     vspec = P((POD_AXIS, SHARD_AXIS))
 
     from pos_evolution_tpu.ops.shuffle import _shuffle_rounds

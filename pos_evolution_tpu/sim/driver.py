@@ -118,8 +118,14 @@ class Simulation:
         state, anchor = make_genesis(n_validators, genesis_time)
         self.genesis_state = state
         self.anchor_root = hash_tree_root(anchor)
+        # One PoW-chain view per Simulation (shared by its groups — the PoW
+        # chain is objective): merge-transition state never leaks between
+        # Simulation instances in the same process.
+        from pos_evolution_tpu.specs.merge import PowChainView
+        self.pow_chain = PowChainView()
         def _make_group(g):
-            store = fc.get_forkchoice_store(state, anchor)
+            store = fc.get_forkchoice_store(state, anchor,
+                                            pow_chain=self.pow_chain)
             resident = None
             if accelerated_forkchoice:
                 from pos_evolution_tpu.ops.resident import ResidentForkChoice

@@ -58,9 +58,14 @@ class Store:
     block_states: dict = field(default_factory=dict)      # Root -> BeaconState
     checkpoint_states: dict = field(default_factory=dict)  # (epoch, root) -> BeaconState
     latest_messages: dict = field(default_factory=dict)   # ValidatorIndex -> LatestMessage
+    # PoW-chain view for merge-transition validation; None falls back to the
+    # module-level default registry in specs.merge (Simulation installs a
+    # fresh per-instance view so sims never share PoW state).
+    pow_chain: object = None
 
 
-def get_forkchoice_store(anchor_state: BeaconState, anchor_block: BeaconBlock) -> Store:
+def get_forkchoice_store(anchor_state: BeaconState, anchor_block: BeaconBlock,
+                         pow_chain: object = None) -> Store:
     """Init from a trusted anchor (pos-evolution.md:1077-1095); the anchor is
     genesis or a weak-subjectivity checkpoint (:1221)."""
     assert bytes(anchor_block.state_root) == hash_tree_root(anchor_state), \
@@ -78,6 +83,7 @@ def get_forkchoice_store(anchor_state: BeaconState, anchor_block: BeaconBlock) -
         blocks={anchor_root: anchor_block.copy()},
         block_states={anchor_root: anchor_state.copy()},
         checkpoint_states={justified.as_key(): anchor_state.copy()},
+        pow_chain=pow_chain,
     )
 
 
@@ -346,7 +352,7 @@ def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
     from pos_evolution_tpu.specs.merge import (
         is_merge_transition_block, validate_merge_block)
     if is_merge_transition_block(pre_state, block.body):
-        validate_merge_block(block)
+        validate_merge_block(block, pow_view=store.pow_chain)
 
     block_root = hash_tree_root(block)
     store.blocks[block_root] = block

@@ -30,6 +30,8 @@ from pos_evolution_tpu.specs.helpers import compute_epoch_at_slot
 
 __all__ = [
     "PowBlock",
+    "PowChainView",
+    "DEFAULT_POW_CHAIN",
     "get_pow_block",
     "set_pow_block_provider",
     "register_pow_block",
@@ -52,33 +54,59 @@ class PowBlock:
 
 # --- pluggable PoW chain provider -------------------------------------------
 # ``get_pow_block(hash) -> PowBlock | None`` mirrors the engine-API lookup a
-# real client performs. The default provider reads an in-process dict that
-# simulation scenarios populate with ``register_pow_block``.
+# real client performs. Each ``Store`` may carry its own ``PowChainView``
+# (``Simulation`` creates one per instance, so concurrent or sequential sims
+# never share PoW state); stores without one fall back to the module-level
+# default view that ``register_pow_block``/``set_pow_block_provider`` manage.
 
-_pow_chain: Dict[bytes, PowBlock] = {}
-_provider: Optional[Callable[[bytes], Optional[PowBlock]]] = None
+
+class PowChainView:
+    """An isolated PoW-chain lookup: a block registry plus an optional
+    engine-API-style provider that overrides it."""
+
+    def __init__(self) -> None:
+        self.blocks: Dict[bytes, PowBlock] = {}
+        self.provider: Optional[Callable[[bytes], Optional[PowBlock]]] = None
+
+    def register(self, block: PowBlock) -> None:
+        self.blocks[bytes(block.block_hash)] = block
+
+    def clear(self) -> None:
+        self.blocks.clear()
+
+    def set_provider(
+        self, provider: Optional[Callable[[bytes], Optional[PowBlock]]]
+    ) -> None:
+        self.provider = provider
+
+    def get(self, block_hash: bytes) -> Optional[PowBlock]:
+        if self.provider is not None:
+            return self.provider(bytes(block_hash))
+        return self.blocks.get(bytes(block_hash))
+
+
+DEFAULT_POW_CHAIN = PowChainView()
 
 
 def register_pow_block(block: PowBlock) -> None:
-    _pow_chain[bytes(block.block_hash)] = block
+    DEFAULT_POW_CHAIN.register(block)
 
 
 def clear_pow_chain() -> None:
-    _pow_chain.clear()
+    DEFAULT_POW_CHAIN.clear()
 
 
 def set_pow_block_provider(
     provider: Optional[Callable[[bytes], Optional[PowBlock]]]
 ) -> None:
-    """Install a custom PoW lookup (None restores the registry default)."""
-    global _provider
-    _provider = provider
+    """Install a custom PoW lookup on the default view (None restores the
+    registry default)."""
+    DEFAULT_POW_CHAIN.set_provider(provider)
 
 
-def get_pow_block(block_hash: bytes) -> Optional[PowBlock]:
-    if _provider is not None:
-        return _provider(bytes(block_hash))
-    return _pow_chain.get(bytes(block_hash))
+def get_pow_block(block_hash: bytes,
+                  view: Optional[PowChainView] = None) -> Optional[PowBlock]:
+    return (view or DEFAULT_POW_CHAIN).get(bytes(block_hash))
 
 
 # --- transition predicates ---------------------------------------------------
@@ -107,7 +135,8 @@ def is_valid_terminal_pow_block(block: PowBlock, parent: PowBlock) -> bool:
     return is_total_difficulty_reached and is_parent_total_difficulty_valid
 
 
-def validate_merge_block(block: BeaconBlock) -> None:
+def validate_merge_block(block: BeaconBlock,
+                         pow_view: Optional[PowChainView] = None) -> None:
     """Validate the merge-transition block's PoW parent
     (pos-evolution.md:1013).
 
@@ -129,9 +158,10 @@ def validate_merge_block(block: BeaconBlock) -> None:
             "payload parent is not the configured terminal block"
         return
 
-    pow_block = get_pow_block(bytes(block.body.execution_payload.parent_hash))
+    pow_block = get_pow_block(bytes(block.body.execution_payload.parent_hash),
+                              pow_view)
     assert pow_block is not None, "terminal PoW block unavailable"
-    pow_parent = get_pow_block(bytes(pow_block.parent_hash))
+    pow_parent = get_pow_block(bytes(pow_block.parent_hash), pow_view)
     assert pow_parent is not None, "terminal PoW parent unavailable"
     assert is_valid_terminal_pow_block(pow_block, pow_parent), \
         "PoW block does not straddle terminal total difficulty"

@@ -58,12 +58,13 @@ def load_anchor(data: bytes) -> tuple[BeaconState, BeaconBlock]:
     return state, block
 
 
-def resume_store(data: bytes):
+def resume_store(data: bytes, pow_chain=None):
     """Rebuild a fork-choice store from a snapshot — the weak-subjectivity
-    sync flow (pos-evolution.md:1221, 1293)."""
+    sync flow (pos-evolution.md:1221, 1293). ``pow_chain`` reattaches an
+    isolated PoW view (see ``load_store``)."""
     from pos_evolution_tpu.specs.forkchoice import get_forkchoice_store
     state, block = load_anchor(data)
-    return get_forkchoice_store(state, block)
+    return get_forkchoice_store(state, block, pow_chain=pow_chain)
 
 
 def snapshot_head(store) -> bytes:
@@ -103,7 +104,15 @@ def save_store(store) -> bytes:
     return out.getvalue()
 
 
-def load_store(data: bytes):
+def load_store(data: bytes, pow_chain=None):
+    """Rebuild a Store from ``save_store`` bytes.
+
+    ``pow_chain`` reattaches a PoW-chain view (specs.merge.PowChainView):
+    the view can hold a live callable provider, so it is not serialized —
+    a resumed store that must re-validate a merge-transition block needs
+    the caller to pass the view back in (None falls back to the module
+    default registry, as everywhere else).
+    """
     from pos_evolution_tpu.specs.forkchoice import Store
     buf = io.BytesIO(data)
     meta = json.loads(_unframe(buf).decode())
@@ -134,6 +143,7 @@ def load_store(data: bytes):
         checkpoint_states=checkpoint_states,
         latest_messages={int(v): LatestMessage(epoch=m[0], root=bytes.fromhex(m[1]))
                          for v, m in meta["latest_messages"].items()},
+        pow_chain=pow_chain,
     )
 
 

@@ -153,3 +153,34 @@ class TestOnBlockWiring:
                             execution_payload=_payload(b"\x55" * 32))
         fc.on_block(store, child)  # no PoW view needed post-merge
         assert hash_tree_root(child.message) in store.blocks
+
+    def test_store_pow_view_isolated_from_global_registry(self):
+        """A store with its own PowChainView never sees globally-registered
+        PoW blocks (the cross-Simulation leak the r4 advisor flagged)."""
+        state, anchor = make_genesis(32)
+        own_view = merge.PowChainView()
+        store = fc.get_forkchoice_store(state, anchor, pow_chain=own_view)
+        parent_hash = _terminal_pair(cfg().terminal_total_difficulty)  # global
+        self._tick(store, 1)
+        sb = build_block(state, 1, execution_payload=_payload(parent_hash))
+        with pytest.raises(AssertionError, match="unavailable"):
+            fc.on_block(store, sb)
+        # Register in the store's own view: now it validates.
+        own_view.register(merge.PowBlock(b"\xaa" * 32, b"\x00" * 32,
+                                         cfg().terminal_total_difficulty - 1))
+        own_view.register(merge.PowBlock(parent_hash, b"\xaa" * 32,
+                                         cfg().terminal_total_difficulty))
+        fc.on_block(store, sb)
+        assert hash_tree_root(sb.message) in store.blocks
+
+    def test_simulations_do_not_share_pow_state(self):
+        """Two Simulation instances in one process have independent PoW
+        views, each isolated from the module default registry."""
+        from pos_evolution_tpu.sim.driver import Simulation
+        a, b = Simulation(16), Simulation(16)
+        assert a.pow_chain is not b.pow_chain
+        a.pow_chain.register(merge.PowBlock(b"\x42" * 32, b"\x00" * 32, 1))
+        assert b.pow_chain.get(b"\x42" * 32) is None
+        assert merge.get_pow_block(b"\x42" * 32) is None
+        for grp in a.groups:
+            assert grp.store.pow_chain is a.pow_chain

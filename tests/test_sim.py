@@ -116,9 +116,10 @@ class TestRealBLSEndToEnd:
         if not native_bls.available():
             pytest.skip("native BLS library not built")
         from pos_evolution_tpu.crypto.bls import (
-            FakeBLS, bls, set_bls_backend)
+            bls, get_bls_backend, set_bls_backend)
         from pos_evolution_tpu.crypto.native_bls import NativeBLS
 
+        prior_backend = get_bls_backend()
         set_bls_backend(NativeBLS)
         try:
             # Dispatch really is native: a known-answer check against the
@@ -135,4 +136,4 @@ class TestRealBLSEndToEnd:
             assert sim.finalized_epoch() >= 2
             assert sim.metrics[-1]["n_blocks"] == 4 * 8 + 1
         finally:
-            set_bls_backend(FakeBLS)
+            set_bls_backend(prior_backend)
