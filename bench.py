@@ -55,7 +55,9 @@ def main():
         # produces its JSON line
         env = dict(os.environ, POS_BENCH_CHILD="1", JAX_PLATFORMS="cpu",
                    PALLAS_AXON_POOL_IPS="")
-        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                  env)
     import jax
     import jax.numpy as jnp
 
@@ -70,59 +72,58 @@ def main():
     # cache persists ACROSS processes, so fixed salts + a fixed rng seed
     # would replay prior runs' results after the first invocation ever.
     entropy = int.from_bytes(os.urandom(3), "little")
-    n = 1_000_000 if on_accel else 65_536  # CPU smoke-run scales down
     slots = 32
     committees_per_slot = 64
     a_total = slots * committees_per_slot           # 2048 aggregates
-    lanes = max(n // a_total, 1)                    # ~512 signers per aggregate
     capacity = 64                                   # fork-choice tree size
     gwei = 10**9
     cfg = mainnet_config()
-    rng = np.random.default_rng(0)
 
-    # --- inputs ---
-    reg = DenseRegistry(
-        effective_balance=jnp.asarray(np.full(n, 32 * gwei, np.int64)),
-        balance=jnp.asarray(rng.integers(31 * gwei, 33 * gwei, n).astype(np.int64)),
-        activation_epoch=jnp.zeros(n, jnp.int64),
-        exit_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
-        withdrawable_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
-        slashed=jnp.zeros(n, bool),
-        prev_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
-        cur_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
-        inactivity_scores=jnp.zeros(n, jnp.int64),
-    )
-    bits = jnp.zeros(4, bool)
+    def make_epoch_body(n, agg_fn):
+        """Build the one-epoch workload closure at validator count ``n``:
+        aggregation + 32 head passes + epoch sweep, every output folded
+        into the i32 accumulator (checksum_tree uses full reductions so no
+        stage dead-code-eliminates)."""
+        lanes = max(n // a_total, 1)                # ~512 signers/aggregate at 1M
+        rng = np.random.default_rng(0)
 
-    pk_states = jnp.asarray(
-        rng.integers(0, 2**32, (n, 8), dtype=np.uint64).astype(np.uint32))
-    committees = jnp.asarray(
-        rng.permutation(n)[: a_total * lanes].reshape(a_total, lanes).astype(np.int32))
-    agg_bits = jnp.asarray(rng.random((a_total, lanes)) < 0.99)
-    messages = jnp.asarray(
-        rng.integers(0, 2**32, (a_total, 8), dtype=np.uint64).astype(np.uint32))
-    signatures = jnp.asarray(rng.integers(0, 2**32, (a_total, 24), dtype=np.uint64)
-                             .astype(np.uint32))
+        reg = DenseRegistry(
+            effective_balance=jnp.asarray(np.full(n, 32 * gwei, np.int64)),
+            balance=jnp.asarray(rng.integers(31 * gwei, 33 * gwei, n).astype(np.int64)),
+            activation_epoch=jnp.zeros(n, jnp.int64),
+            exit_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
+            withdrawable_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
+            slashed=jnp.zeros(n, bool),
+            prev_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+            cur_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+            inactivity_scores=jnp.zeros(n, jnp.int64),
+        )
+        bits = jnp.zeros(4, bool)
 
-    parent = np.arange(-1, capacity - 1, dtype=np.int32)
-    store = DenseStore(
-        parent=jnp.asarray(parent),
-        slot=jnp.arange(capacity, dtype=jnp.int32),
-        rank=jnp.asarray(rng.permutation(capacity).astype(np.int32)),
-        real=jnp.ones(capacity, bool),
-        leaf_viable=jnp.ones(capacity, bool),
-        justified_idx=jnp.int32(0),
-        msg_block=jnp.asarray(rng.integers(0, capacity, n).astype(np.int32)),
-        msg_epoch=jnp.zeros(n, jnp.int64),
-        weight=reg.effective_balance,
-        boost_idx=jnp.int32(capacity - 1),
-        boost_amount=jnp.int64(32 * gwei * (n // 32) // 4),
-    )
+        pk_states = jnp.asarray(
+            rng.integers(0, 2**32, (n, 8), dtype=np.uint64).astype(np.uint32))
+        committees = jnp.asarray(
+            rng.permutation(n)[: a_total * lanes].reshape(a_total, lanes).astype(np.int32))
+        agg_bits = jnp.asarray(rng.random((a_total, lanes)) < 0.99)
+        messages = jnp.asarray(
+            rng.integers(0, 2**32, (a_total, 8), dtype=np.uint64).astype(np.uint32))
+        signatures = jnp.asarray(rng.integers(0, 2**32, (a_total, 24), dtype=np.uint64)
+                                 .astype(np.uint32))
 
-    def epoch_body(agg_fn):
-        """One salted epoch: aggregation + 32 head passes + epoch sweep,
-        every output folded into the i32 accumulator (checksum_tree uses
-        full reductions so no stage dead-code-eliminates)."""
+        parent = np.arange(-1, capacity - 1, dtype=np.int32)
+        store = DenseStore(
+            parent=jnp.asarray(parent),
+            slot=jnp.arange(capacity, dtype=jnp.int32),
+            rank=jnp.asarray(rng.permutation(capacity).astype(np.int32)),
+            real=jnp.ones(capacity, bool),
+            leaf_viable=jnp.ones(capacity, bool),
+            justified_idx=jnp.int32(0),
+            msg_block=jnp.asarray(rng.integers(0, capacity, n).astype(np.int32)),
+            msg_epoch=jnp.zeros(n, jnp.int64),
+            weight=reg.effective_balance,
+            boost_idx=jnp.int32(capacity - 1),
+            boost_amount=jnp.int64(32 * gwei * (n // 32) // 4),
+        )
 
         def one_epoch(salt, acc):
             ok = agg_fn(pk_states, committees, agg_bits,
@@ -147,32 +148,78 @@ def main():
 
         return one_epoch
 
-    best = fused_measure(epoch_body(aggregate_verify_batch),
-                         entropy=entropy, tag="xla aggregation")
+    extra = {}
     if on_accel:
+        best = fused_measure(make_epoch_body(1_000_000, aggregate_verify_batch),
+                             entropy=entropy, tag="xla aggregation")
         # Race the Pallas per-committee aggregation kernel; keep the faster,
         # falling back to XLA if Mosaic rejects the lowering.
         try:
             from pos_evolution_tpu.ops.pallas_aggregation import (
                 aggregate_verify_batch_pallas_jit,
             )
-            t_pl = fused_measure(epoch_body(aggregate_verify_batch_pallas_jit),
-                                 entropy=entropy, tag="pallas aggregation")
+            t_pl = fused_measure(
+                make_epoch_body(1_000_000, aggregate_verify_batch_pallas_jit),
+                entropy=entropy, tag="pallas aggregation")
             best = min(best, t_pl)
         except Exception as e:  # Mosaic lowering/compile failure: keep XLA
             print(f"# pallas aggregation unavailable: {e!r:.120}", file=sys.stderr)
+        t = float(best)
+    else:
+        # CPU fallback: no single-n linear extrapolation (the assumed
+        # exponent was never validated — VERDICT r4 weak #1). Measure a
+        # size ladder, fit the log-log scaling exponent, extrapolate to 1M
+        # with the FITTED exponent, and report the raw (n, t) pairs so the
+        # number is auditable.
+        ns = [65_536, 131_072, 262_144]
+        pairs = []
+        for ni in ns:
+            ti = fused_measure(make_epoch_body(ni, aggregate_verify_batch),
+                               entropy=entropy, tag=f"xla aggregation n={ni}")
+            pairs.append((ni, float(ti)))
+        slope = float(np.polyfit(np.log([p[0] for p in pairs]),
+                                 np.log([p[1] for p in pairs]), 1)[0])
+        n_top, t_top = pairs[-1]
+        t = t_top * (1_000_000 / n_top) ** slope
+        extra = {
+            "cpu_fallback": True,
+            "measured_n_seconds": [[ni, round(ti, 6)] for ni, ti in pairs],
+            "fitted_scaling_exponent": round(slope, 4),
+            "extrapolation": f"t({n_top}) * (1e6/{n_top})**{slope:.4f}",
+        }
 
-    t = float(best)
-    if not on_accel:
-        # normalize the CPU smoke-run to the full validator count so the
-        # metric stays comparable in spirit (linear in n)
-        t = t * (1_000_000 / n)
+    if "--trace" in sys.argv:
+        # One traced epoch of the measured workload (SURVEY §5 / VERDICT
+        # r4 item 7): xplane protobuf under bench_trace/, plus a top-op
+        # table in bench_trace/top_ops.json via scripts/trace_summary.py.
+        from pos_evolution_tpu.utils.metrics import device_trace
+        n_tr = 1_000_000 if on_accel else 65_536
+        body = make_epoch_body(n_tr, aggregate_verify_batch)
+        traced = jax.jit(lambda s: body(s, jnp.int32(0)))
+        np.asarray(traced(jnp.int32(entropy)))        # compile outside
+        trace_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "bench_trace")
+        import shutil
+        shutil.rmtree(trace_dir, ignore_errors=True)  # one run per summary
+        with device_trace(trace_dir, annotation="bench_epoch"):
+            np.asarray(traced(jnp.int32(entropy + 1)))
+        try:
+            from scripts.trace_summary import summarize_path
+            top = summarize_path(trace_dir)
+            with open(os.path.join(trace_dir, "top_ops.json"), "w") as f:
+                json.dump({"backend": jax.default_backend(), "n": n_tr,
+                           "planes": top}, f, indent=1)
+            print(f"# trace: top-op table in {trace_dir}/top_ops.json",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"# trace summary failed: {e!r}", file=sys.stderr)
 
     print(json.dumps({
         "metric": "epoch_1m_validators_aggregation_plus_forkchoice",
         "value": round(t, 6),
         "unit": "s",
         "vs_baseline": round(1.0 / t, 3),
+        **extra,
     }))
 
 

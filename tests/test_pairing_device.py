@@ -58,6 +58,7 @@ class TestHardPartIdentity:
         assert e % (6 * (q**2 - 1)) == 0
 
 
+@pytest.mark.slow
 class TestPairingEndToEnd:
     def test_miller_finalexp_infinity_and_oracle_parity(self):
         """One batch=1 shape end-to-end (eager): full device pairing ==
@@ -89,6 +90,7 @@ class TestPairingEndToEnd:
         assert got_fe == g.pow(3 * oracle._FINAL_EXP)
 
 
+@pytest.mark.slow
 @_WIDE
 class TestPairingWide:
     def test_bilinearity_on_device(self):
@@ -106,6 +108,7 @@ class TestPairingWide:
         assert left == right
 
 
+@pytest.mark.slow
 class TestG1Aggregation:
     def test_masked_sum_matches_oracle(self):
         rng = np.random.default_rng(1)
@@ -146,6 +149,7 @@ class TestG1Aggregation:
         assert bool(np.asarray(inf)[0])
 
 
+@pytest.mark.slow
 @_WIDE
 class TestFastAggregateVerify:
     def test_matches_pybls(self):

@@ -122,6 +122,48 @@ class TestGossip:
         odds = sum(range(1, n, 2))
         assert out[0] == evens and out[1] == odds and out[2] == evens
 
+    def test_factored_matches_dense(self, mesh):
+        """The O(n + D^2) factored fabric equals the dense n x n mask
+        built from the same factors (VERDICT r4 item 8)."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.parallel.sharded import (
+            gossip_all_gather, gossip_factored)
+        n, d = 64, mesh.size
+        per = n // d
+        rng = np.random.default_rng(3)
+        msgs = np.arange(10, 10 + n, dtype=np.int64)
+        send_up = rng.random(n) < 0.8
+        recv_up = rng.random(n) < 0.9
+        link = rng.random((d, d)) < 0.7
+        np.fill_diagonal(link, True)
+
+        dense_mask = (recv_up[:, None] & send_up[None, :]
+                      & link[np.arange(n) // per][:, np.arange(n) // per])
+        want = np.asarray(gossip_all_gather(mesh)(
+            jnp.asarray(msgs), jnp.asarray(dense_mask)))
+        got = np.asarray(gossip_factored(mesh)(
+            jnp.asarray(msgs), jnp.asarray(send_up), jnp.asarray(recv_up),
+            jnp.asarray(link)))
+        assert np.array_equal(got, want)
+
+    def test_factored_full_partition(self, mesh):
+        """Two isolated halves: each recipient hears only its side."""
+        import jax.numpy as jnp
+        from pos_evolution_tpu.parallel.sharded import gossip_factored
+        n, d = 64, mesh.size
+        per = n // d
+        msgs = np.ones(n, dtype=np.int64)
+        up = np.ones(n, dtype=bool)
+        link = np.zeros((d, d), dtype=bool)
+        link[:d // 2, :d // 2] = True
+        link[d // 2:, d // 2:] = True
+        out = np.asarray(gossip_factored(mesh)(
+            jnp.asarray(msgs), jnp.asarray(up), jnp.asarray(up),
+            jnp.asarray(link)))
+        assert np.array_equal(out[:n // 2], np.full(n // 2, n // 2))
+        assert np.array_equal(out[n // 2:], np.full(n // 2, n // 2))
+        assert per * (d // 2) == n // 2  # the halves align with devices
+
 
 class TestNumpyCollectivesParity:
     def test_same_interface(self):

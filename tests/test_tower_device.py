@@ -7,6 +7,10 @@ import pytest
 
 jax = pytest.importorskip("jax")
 
+# Compile-dominated oracle differentials (~6 min on XLA:CPU): slow tier,
+# run with `pytest -m ""` (full) or `-m slow`.
+pytestmark = pytest.mark.slow
+
 from pos_evolution_tpu.crypto import bls12_381 as oracle  # noqa: E402
 from pos_evolution_tpu.ops import tower  # noqa: E402
 
