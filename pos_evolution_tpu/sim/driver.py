@@ -57,6 +57,17 @@ class ViewGroup:
         self.members = members
         self.queue: list[_QueuedMessage] = []
         self.pool: dict[bytes, object] = {}  # attestation root -> Attestation
+        # Attestation roots carried by each processed block (block root ->
+        # [attestation roots]): lets proposers dedup against inclusion on
+        # the CANONICAL chain only (a walk from their head), the
+        # operation-pool behavior of real clients. Without dedup each
+        # proposer re-packs the oldest in-window attestations (already
+        # on-chain), starving fresh ones once committees/slot x window >
+        # max_attestations (n >~ 20K) — which delayed justification a full
+        # epoch at 64K validators (r5 scale_demo catch). Keying by block
+        # keeps it reorg-correct: votes included only on a losing fork
+        # stay packable on the winning one.
+        self.block_atts: dict[bytes, list] = {}
         self._seq = 0
         # Device-resident dense mirror (ops/resident.py) when the sim runs
         # accelerated fork choice; handlers below forward their deltas.
@@ -81,16 +92,19 @@ class ViewGroup:
                     # block-carried attestations are part of on_block cost
                     with track("on_block"):
                         fc.on_block(self.store, msg.payload)
+                        block_root = hash_tree_root(msg.payload.message)
                         if self.resident is not None:
-                            self.resident.note_block(
-                                self.store, hash_tree_root(msg.payload.message))
+                            self.resident.note_block(self.store, block_root)
+                        carried = []
                         for att in msg.payload.message.body.attestations:
+                            carried.append(hash_tree_root(att))
                             try:
                                 idx = fc.on_attestation(self.store, att,
                                                         is_from_block=True)
                                 self._mirror_attestation(att, idx)
                             except AssertionError:
                                 pass
+                        self.block_atts[block_root] = carried
                 elif msg.kind == "attestation":
                     with track("on_attestation"):
                         idx = fc.on_attestation(self.store, msg.payload)
@@ -190,7 +204,7 @@ class Simulation:
             if not self.schedule.awake(round_index, int(proposer)):
                 continue
             proposed.add(proposer)
-            atts = self._pack_attestations(group, slot)
+            atts = self._pack_attestations(group, slot, head)
             sb = build_block(group.store.block_states[head], slot, attestations=atts)
             for dst in self.groups:
                 delay = self.schedule.block_delay(int(proposer), slot, dst.id)
@@ -198,19 +212,35 @@ class Simulation:
                     continue
                 dst.enqueue(t0 + delay, "block", sb)
 
-    def _pack_attestations(self, group: ViewGroup, slot: int) -> list:
+    def _pack_attestations(self, group: ViewGroup, slot: int,
+                           head: bytes) -> list:
         c = self.cfg
-        out = []
-        head = self._get_head(group)
-        head_state = group.store.block_states[head]
-        for att in group.pool.values():
-            a_slot = int(att.data.slot)
-            if not (a_slot + c.min_attestation_inclusion_delay <= slot
-                    <= a_slot + c.slots_per_epoch):
-                continue
-            out.append(att)
-            if len(out) >= c.max_attestations:
+        # inclusion set of the proposer's CANONICAL chain, within the
+        # attestation window: walk head ancestry while blocks are recent
+        # enough to carry still-packable attestations
+        onchain: set[bytes] = set()
+        b = head
+        while b in group.store.blocks:
+            blk = group.store.blocks[b]
+            if int(blk.slot) + c.slots_per_epoch < slot:
                 break
+            onchain.update(group.block_atts.get(b, ()))
+            b = bytes(blk.parent_root)
+        out = []
+        expired = []
+        for root, att in group.pool.items():
+            a_slot = int(att.data.slot)
+            if slot > a_slot + c.slots_per_epoch:
+                expired.append(root)           # prune: bounds the pool
+                continue
+            if a_slot + c.min_attestation_inclusion_delay > slot:
+                continue
+            if root in onchain:
+                continue                       # already on this chain
+            if len(out) < c.max_attestations:
+                out.append(att)
+        for root in expired:
+            del group.pool[root]
         return out
 
     def _attest(self, slot: int) -> None:

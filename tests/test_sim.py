@@ -38,6 +38,61 @@ class TestHonestRun:
             assert handler in s and s[handler]["count"] > 0
             assert s[handler]["p50_ms"] >= 0
 
+    def test_pack_dedups_on_canonical_chain_and_prunes_expired(self):
+        """Operation-pool semantics (r5 scale_demo catch): a proposer must
+        not re-pack attestations already included on ITS OWN chain —
+        re-packing starves fresh attestations once committees x window
+        exceed max_attestations and delays justification at scale — while
+        attestations included only on a losing fork stay packable, and
+        expired pool entries are pruned."""
+        from pos_evolution_tpu.ssz import hash_tree_root
+        sim = Simulation(64)
+        sim.run_until_slot(4)
+        # deliver the slot-4 gossip (as the slot-5 proposer's tick would)
+        # so the pool holds attestations not yet included in any block
+        sim._tick_all(sim.slot_start(sim.slot))
+        group = sim.groups[0]
+        head = sim._get_head(group)
+        assert group.pool, "pool should hold gossiped attestations"
+        assert group.block_atts, "block-carried attestations must be tracked"
+        # every attestation on the canonical chain is excluded from packing
+        onchain = set()
+        for roots in group.block_atts.values():
+            onchain.update(roots)
+        packed = sim._pack_attestations(group, sim.slot, head)
+        packed_roots = {hash_tree_root(a) for a in packed}
+        assert packed_roots.isdisjoint(onchain)
+        assert packed, "fresh pool attestations should be packable"
+        # fork-insensitivity: inclusion recorded on a NON-canonical block
+        # does not block packing on the head chain
+        victim = next(iter(packed_roots))
+        group.block_atts[b"\xaa" * 32] = [victim]   # losing-fork block
+        still = {hash_tree_root(a)
+                 for a in sim._pack_attestations(group, sim.slot, head)}
+        assert victim in still
+        # ...but inclusion on the head block itself does
+        group.block_atts.setdefault(head, []).append(victim)
+        gone = {hash_tree_root(a)
+                for a in sim._pack_attestations(group, sim.slot, head)}
+        assert victim not in gone
+        # pruning: far-future pack drops everything expired from the pool
+        horizon = sim.slot + sim.cfg.slots_per_epoch + 1
+        sim._pack_attestations(group, horizon, head)
+        assert not group.pool
+
+    @pytest.mark.slow
+    def test_mainnet_justification_timing(self):
+        """Mainnet config, honest run: the genesis guard skips the first
+        two boundaries, first justification lands at the end of epoch 2
+        (justified == 2 after 3 epochs, finalized still 0) — the timing
+        scale_demo.py asserts at 64K validators."""
+        from pos_evolution_tpu.config import mainnet_config
+        with use_config(mainnet_config()):
+            sim = Simulation(64)
+            sim.run_epochs(3)
+            assert sim.justified_epoch() == 2
+            assert sim.finalized_epoch() == 0
+
 
 class TestAcceleratedForkChoice:
     def test_accelerated_run_matches_spec_run(self):
