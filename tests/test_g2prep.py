@@ -1,0 +1,156 @@
+"""Differential tests: batched device preamble (ops/g2prep.py) vs the
+exact Python oracle — decompression, Fq2 sqrt, sign canonicalization,
+hash-to-G2 with cofactor clearing, and the twist Jacobian arithmetic.
+
+Slow tier: the sqrt/cofactor ladders are 380-760-step scans whose bodies
+compile for minutes on XLA:CPU (cheap on TPU). `pytest -m ""` runs them.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+pytestmark = pytest.mark.slow
+
+from pos_evolution_tpu.crypto import bls12_381 as o  # noqa: E402
+from pos_evolution_tpu.ops import fp  # noqa: E402
+from pos_evolution_tpu.ops import g2prep as gp  # noqa: E402
+
+jnp = jax.numpy
+
+
+def fq2_of(limbs2):
+    return o.Fq2(fp.from_limbs(limbs2[0]), fp.from_limbs(limbs2[1]))
+
+
+class TestDecompress:
+    def test_g1_batch_matches_oracle(self):
+        ks = (1, 7, 12345, 0xFEED)
+        comp = [o.g1_compress(o.ec_mul(o.G1_GEN, k)) for k in ks]
+        xs, signs = [], []
+        for d in comp:
+            bits = int.from_bytes(d, "big")
+            signs.append(bool(bits & (1 << 381)))
+            xs.append(fp.to_limbs(bits & ((1 << 381) - 1)))
+        pts, ok = gp.g1_decompress_batch(
+            jnp.asarray(np.stack(xs)), jnp.asarray(signs))
+        assert np.asarray(ok).all()
+        for i, d in enumerate(comp):
+            ox, oy = o.g1_decompress(d)
+            assert fp.from_limbs(np.asarray(pts)[i, 0]) == ox
+            assert fp.from_limbs(np.asarray(pts)[i, 1]) == oy
+
+    def test_g1_invalid_x_flagged(self):
+        # x with no curve point: find one by scanning
+        x = 1
+        while True:
+            y2 = (pow(x, 3, o.Q) + 4) % o.Q
+            if pow(y2, (o.Q - 1) // 2, o.Q) != 1:
+                break
+            x += 1
+        pts, ok = gp.g1_decompress_batch(
+            jnp.asarray(fp.to_limbs(x)[None]), jnp.asarray([False]))
+        assert not bool(np.asarray(ok)[0])
+
+    def test_g2_batch_matches_oracle(self):
+        sigs = [o.g2_compress(o.ec_mul(o.hash_to_g2(bytes([i]) * 32), 5 + i))
+                for i in range(4)]
+        xl, sg, inf = gp.g2_compressed_to_limbs(
+            np.stack([np.frombuffer(s, np.uint8) for s in sigs]))
+        assert not inf.any()
+        pts, ok = gp.g2_decompress_batch(jnp.asarray(xl), jnp.asarray(sg))
+        assert np.asarray(ok).all()
+        for i, s in enumerate(sigs):
+            X, Y = o.g2_decompress(s)
+            p = np.asarray(pts)[i]
+            assert fq2_of(p[0]) == X
+            assert fq2_of(p[1]) == Y
+
+
+class TestHashToG2:
+    def test_batch_matches_oracle(self):
+        msgs = [bytes([i]) * 32 for i in range(4)]
+        aff = np.asarray(gp.hash_to_g2_batch(msgs))
+        for i, m in enumerate(msgs):
+            X, Y = o.hash_to_g2(m)
+            assert fq2_of(aff[i, 0]) == X
+            assert fq2_of(aff[i, 1]) == Y
+
+    def test_candidate_picks_match_oracle_ctr(self):
+        # the host Legendre scan picks the same ctr the oracle's
+        # try-and-increment settles on (same x candidate)
+        msgs = [bytes([7, i]) for i in range(8)]
+        xs, picks = gp.hash_to_g2_candidates(msgs)
+        for i, m in enumerate(msgs):
+            X, _ = o.hash_to_g2(m)
+            # the oracle's point derives from the picked candidate after
+            # cofactor clearing; recompute its pre-clearing x directly
+            import hashlib
+            ctr = int(picks[i])
+            seed = hashlib.sha256(b"blsg2" + m + ctr.to_bytes(4, "little"))
+            d0 = seed.digest()
+            d1 = hashlib.sha256(d0).digest()
+            d2 = hashlib.sha256(d1).digest()
+            assert fp.from_limbs(xs[i, 0]) == int.from_bytes(
+                d0 + d1[:16], "big") % o.Q
+            assert fp.from_limbs(xs[i, 1]) == int.from_bytes(
+                d1[16:] + d2, "big") % o.Q
+
+
+class TestTwistArithmetic:
+    def test_scalar_mult_matches_oracle(self):
+        q = o.hash_to_g2(b"twist-arith")
+        for k in (1, 5, 2**63 + 5):
+            enc = np.stack([
+                np.stack([fp.to_limbs(q[0].a), fp.to_limbs(q[0].b)]),
+                np.stack([fp.to_limbs(q[1].a), fp.to_limbs(q[1].b)]),
+            ])[None]
+            # pad every schedule to 64 bits so the scan compiles ONCE
+            # across the k sweep (leading zeros double infinity: no-op)
+            bits = np.array([(k >> (63 - j)) & 1 for j in range(64)],
+                            dtype=bool)
+            jac = gp.g2_mul_static(jnp.asarray(enc), bits)
+            aff, inf = gp.g2_jac_to_affine(jac)
+            want = o.ec_mul(q, k)
+            assert not bool(np.asarray(inf)[0])
+            a = np.asarray(aff)[0]
+            assert fq2_of(a[0]) == want[0] and fq2_of(a[1]) == want[1]
+
+    def test_scalar_batch_data_bits(self):
+        q = o.hash_to_g2(b"twist-batch")
+        ks = [3, 10, 77]
+        nbits = 8
+        enc = np.stack([
+            np.stack([fp.to_limbs(q[0].a), fp.to_limbs(q[0].b)]),
+            np.stack([fp.to_limbs(q[1].a), fp.to_limbs(q[1].b)]),
+        ])
+        encs = jnp.asarray(np.stack([enc] * len(ks)))
+        bits = np.zeros((len(ks), nbits), dtype=bool)
+        for i, k in enumerate(ks):
+            bits[i] = [(k >> (nbits - 1 - j)) & 1 for j in range(nbits)]
+        aff, inf = gp.g2_jac_to_affine(
+            gp.g2_mul_scalar_batch(encs, jnp.asarray(bits)))
+        for i, k in enumerate(ks):
+            want = o.ec_mul(q, k)
+            a = np.asarray(aff)[i]
+            assert not bool(np.asarray(inf)[i])
+            assert fq2_of(a[0]) == want[0] and fq2_of(a[1]) == want[1]
+
+    def test_add_cancellation_and_inf(self):
+        q = o.hash_to_g2(b"twist-inf")
+        enc = np.stack([
+            np.stack([fp.to_limbs(q[0].a), fp.to_limbs(q[0].b)]),
+            np.stack([fp.to_limbs(q[1].a), fp.to_limbs(q[1].b)]),
+        ])[None]
+        pj = gp.g2_affine_to_jac(jnp.asarray(enc))
+        neg = jnp.concatenate(
+            [pj[:, 0:1], fp.modneg(pj[:, 1:2]), pj[:, 2:3]], axis=1)
+        _, inf = gp.g2_jac_to_affine(gp.g2_add_jac(pj, neg))
+        assert bool(np.asarray(inf)[0])
+        # inf + P = P
+        zero = jnp.zeros_like(pj)
+        aff, inf2 = gp.g2_jac_to_affine(gp.g2_add_jac(zero, pj))
+        assert not bool(np.asarray(inf2)[0])
+        a = np.asarray(aff)[0]
+        assert fq2_of(a[0]) == q[0] and fq2_of(a[1]) == q[1]
