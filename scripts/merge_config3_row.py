@@ -1,0 +1,36 @@
+"""Merge a scripts/bench_config3_real.py result (JSON on stdin or path
+argv[1]) into BENCH_ALL_r{N}.json as the config3b_real_bls_pairing row.
+
+Exists so the multi-hour single-core CPU run doesn't have to be repeated
+inside bench_all.py just to land in the recorded matrix; the row carries
+its own backend/scale labels and a provenance note.
+
+Usage: python scripts/merge_config3_row.py CFG3.json [--record N]
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    args = [a for a in sys.argv[1:]]
+    record = 5
+    if "--record" in args:
+        i = args.index("--record")
+        record = int(args[i + 1])
+        del args[i:i + 2]
+    src = args[0] if args else None
+    data = json.load(open(src)) if src else json.load(sys.stdin)
+    data["provenance"] = "scripts/bench_config3_real.py (standalone run)"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, f"BENCH_ALL_r{record:02d}.json")
+    matrix = json.load(open(path))
+    matrix["config3b_real_bls_pairing"] = data
+    with open(path, "w") as f:
+        json.dump(matrix, f, indent=1)
+    print(f"merged config3b row into {path}")
+
+
+if __name__ == "__main__":
+    main()
