@@ -86,6 +86,7 @@ class TestMerkleLevelKernel:
 
 
 class TestPallasAggregation:
+    @pytest.mark.slow
     def test_matches_fakebls_and_xla(self):
         from pos_evolution_tpu.crypto.bls import FakeBLS
         from pos_evolution_tpu.ops.aggregation import (
@@ -172,7 +173,10 @@ class TestCompiledOnAccelerator:
 
 
 class TestDeviceMerkleize:
-    @pytest.mark.parametrize("n,depth", [(8, 3), (8, 6), (1024, 10)])
+    @pytest.mark.parametrize(
+        "n,depth",
+        [(8, 3), (8, 6),
+         pytest.param(1024, 10, marks=pytest.mark.slow)])
     def test_matches_host_merkleize(self, n, depth):
         rng = np.random.default_rng(n)
         chunks = rng.integers(0, 256, (n, 32), dtype=np.uint8)
