@@ -146,6 +146,7 @@ def main():
     import jax.numpy as jnp
 
     from pos_evolution_tpu.utils.benchtime import checksum_tree, fused_measure
+    from pos_evolution_tpu.utils.watchdog import Watchdog
 
     record = None
     if "--record" in sys.argv:
@@ -154,13 +155,28 @@ def main():
         except (IndexError, ValueError):
             sys.exit("Usage: python bench_all.py [--record N]")
 
+    # Each config runs as a supervised watchdog step: results are
+    # committed to the partial-results JSON as they arrive, and one
+    # config dying (compile OOM, kernel rejection, hang under
+    # POS_BENCH_STEP_TIMEOUT) records an incident and the matrix keeps
+    # going — the run exits 0 with every config that completed.
+    wd = Watchdog.from_env(
+        "bench_all.py",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_all_partial.json"))
+
+    def _failed(name):
+        return {"error": f"step '{name}' failed; see watchdog_incidents"}
+
     entropy = int.from_bytes(os.urandom(3), "little")
     results = {"backend": jax.default_backend(),
                "n_devices": len(jax.devices()),
                "methodology": "benchtime.fused_measure (work-differenced, "
                               "transfer-synced, entropy-salted)"}
 
-    results["config1_lmd_ghost_1024_python"] = config1_forkchoice_python()
+    results["config1_lmd_ghost_1024_python"] = wd.step(
+        "config1_python", config1_forkchoice_python,
+        default=_failed("config1_python"))
 
     on_accel = jax.default_backend() != "cpu"
     n = 1_000_000 if on_accel else 65_536
@@ -168,60 +184,70 @@ def main():
     rng = np.random.default_rng(0)
     gwei = 10**9
 
-    results["config1_lmd_ghost_device"] = config1_forkchoice_device(
-        n, entropy, fused_measure, checksum_tree)
+    results["config1_lmd_ghost_device"] = wd.step(
+        "config1_device", config1_forkchoice_device,
+        n, entropy, fused_measure, checksum_tree,
+        default=_failed("config1_device"))
 
     # --- config 2: shuffle 64K (K pre-derived seeds, indexed by salt) ---
-    from pos_evolution_tpu.ops.shuffle import (
-        _seed_words, _shuffle_device, host_pivots,
-    )
-    K = 16
-    seeds = [os.urandom(32) for _ in range(K)]
-    seed_words = jnp.asarray(np.stack([_seed_words(s) for s in seeds]))
-    pivots = jnp.asarray(np.stack(
-        [host_pivots(s, 65536, 90) for s in seeds]))
+    def _config2():
+        from pos_evolution_tpu.ops.shuffle import (
+            _seed_words, _shuffle_device, host_pivots,
+        )
+        K = 16
+        seeds = [os.urandom(32) for _ in range(K)]
+        seed_words = jnp.asarray(np.stack([_seed_words(s) for s in seeds]))
+        pivots = jnp.asarray(np.stack(
+            [host_pivots(s, 65536, 90) for s in seeds]))
 
-    def shuf_body(salt, acc):
-        k = salt % K
-        perm = _shuffle_device(seed_words[k], pivots[k], 65536, 90)
-        return acc + checksum_tree(perm)
+        def shuf_body(salt, acc):
+            k = salt % K
+            perm = _shuffle_device(seed_words[k], pivots[k], 65536, 90)
+            return acc + checksum_tree(perm)
 
-    t = fused_measure(shuf_body, entropy=entropy, tag="shuffle 64k")
-    results["config2_shuffle_64k"] = {"ms": round(t * 1e3, 3)}
+        t = fused_measure(shuf_body, entropy=entropy, tag="shuffle 64k")
+        return {"ms": round(t * 1e3, 3)}
+
+    results["config2_shuffle_64k"] = wd.step(
+        "config2", _config2, default=_failed("config2"))
 
     # --- config 3: aggregation (fake crypto) ---
-    from pos_evolution_tpu.ops.aggregation import aggregate_verify_batch
-    A, C = 2048, max(n // 2048, 8)
-    pk_states = jnp.asarray(rng.integers(0, 2**32, (n, 8), dtype=np.uint64)
-                            .astype(np.uint32))
-    committees = jnp.asarray(rng.permutation(n)[:A * C].reshape(A, C).astype(np.int32))
-    bits = jnp.asarray(rng.random((A, C)) < 0.99)
-    msgs = jnp.asarray(rng.integers(0, 2**32, (A, 8), dtype=np.uint64)
-                       .astype(np.uint32))
-    sigs = jnp.asarray(rng.integers(0, 2**32, (A, 24), dtype=np.uint64)
-                       .astype(np.uint32))
+    def _config3():
+        from pos_evolution_tpu.ops.aggregation import aggregate_verify_batch
+        A, C = 2048, max(n // 2048, 8)
+        pk_states = jnp.asarray(rng.integers(0, 2**32, (n, 8), dtype=np.uint64)
+                                .astype(np.uint32))
+        committees = jnp.asarray(
+            rng.permutation(n)[:A * C].reshape(A, C).astype(np.int32))
+        bits = jnp.asarray(rng.random((A, C)) < 0.99)
+        msgs = jnp.asarray(rng.integers(0, 2**32, (A, 8), dtype=np.uint64)
+                           .astype(np.uint32))
+        sigs = jnp.asarray(rng.integers(0, 2**32, (A, 24), dtype=np.uint64)
+                           .astype(np.uint32))
 
-    def agg_body(salt, acc):
-        ok = aggregate_verify_batch(
-            pk_states, committees, bits,
-            msgs.at[0, 0].set(salt.astype(jnp.uint32)), sigs)
-        return acc + ok.sum(dtype=jnp.int32)
+        def agg_body(salt, acc):
+            ok = aggregate_verify_batch(
+                pk_states, committees, bits,
+                msgs.at[0, 0].set(salt.astype(jnp.uint32)), sigs)
+            return acc + ok.sum(dtype=jnp.int32)
 
-    t = fused_measure(agg_body, entropy=entropy, tag="aggregation fake-bls")
-    results["config3_aggregation_fakebls"] = {
-        "fake_crypto": True,
-        "note": "SHA/XOR FakeBLS pipeline shape, NOT real pairings — "
-                "~3 orders of magnitude less math than BLS12-381",
-        "aggregates": A, "signers": A * C, "ms": round(t * 1e3, 2),
-        "signer_verifies_per_s": int(A * C / t)}
+        t = fused_measure(agg_body, entropy=entropy,
+                          tag="aggregation fake-bls")
+        return {
+            "fake_crypto": True,
+            "note": "SHA/XOR FakeBLS pipeline shape, NOT real pairings — "
+                    "~3 orders of magnitude less math than BLS12-381",
+            "aggregates": A, "signers": A * C, "ms": round(t * 1e3, 2),
+            "signer_verifies_per_s": int(A * C / t)}
+
+    results["config3_aggregation_fakebls"] = wd.step(
+        "config3", _config3, default=_failed("config3"))
 
     # --- config 3b: REAL BLS12-381 batched pairing verify ---
     if on_accel:
-        try:
-            results["config3b_real_bls_pairing"] = _config3b_real_bls(
-                entropy, fused_measure)
-        except Exception as e:  # pragma: no cover - records the failure mode
-            results["config3b_real_bls_pairing"] = {"error": repr(e)[:200]}
+        results["config3b_real_bls_pairing"] = wd.step(
+            "config3b", _config3b_real_bls, entropy, fused_measure,
+            default=_failed("config3b"))
     elif os.environ.get("POS_BENCH_REAL3", "1") != "0":
         # Honest CPU measurement of the REAL pairing pipeline
         # (decompression + hash-to-G2 + batched Miller loop,
@@ -232,66 +258,94 @@ def main():
         # row is merged from the standalone run via
         # scripts/merge_config3_row.py (see the row's provenance field).
         full = os.environ.get("POS_BENCH_REAL3") == "full"
-        try:
+
+        def _config3b_cpu():
             from scripts.bench_config3_real import run as real3
-            results["config3b_real_bls_pairing"] = (
-                real3(verbose=False) if full else
-                real3(aggregates=64, signers=8192, distinct_keys=64,
-                      verbose=False))
-        except Exception as e:  # pragma: no cover - records the failure mode
-            results["config3b_real_bls_pairing"] = {"error": repr(e)[:200]}
+            return (real3(verbose=False) if full else
+                    real3(aggregates=64, signers=8192, distinct_keys=64,
+                          verbose=False))
+
+        results["config3b_real_bls_pairing"] = wd.step(
+            "config3b", _config3b_cpu, default=_failed("config3b"))
     else:
         results["config3b_real_bls_pairing"] = {
             "skipped": "POS_BENCH_REAL3=0 (CPU real-pairing run opted out)"}
 
-    # --- config 4: sharded epoch sweep at 1M ---
-    from pos_evolution_tpu.config import mainnet_config
-    from pos_evolution_tpu.ops.epoch import DenseRegistry
-    from pos_evolution_tpu.parallel.sharded import (
-        make_mesh, shard_registry, sharded_epoch_step,
-    )
-    cfg = mainnet_config()
-    reg = DenseRegistry(
-        effective_balance=jnp.asarray(np.full(n, 32 * gwei, np.int64)),
-        balance=jnp.asarray(rng.integers(31 * gwei, 33 * gwei, n).astype(np.int64)),
-        activation_epoch=jnp.zeros(n, jnp.int64),
-        exit_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
-        withdrawable_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
-        slashed=jnp.zeros(n, bool),
-        prev_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
-        cur_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
-        inactivity_scores=jnp.zeros(n, jnp.int64),
-    )
-    mesh = make_mesh()
-    step = sharded_epoch_step(mesh, cfg)
-    sharded = shard_registry(mesh, reg)
-    bits4 = jnp.zeros(4, bool)
+    # --- configs 4 + 5: sharded epoch sweep / SSF tally at 1M ---
+    _mesh_state = {}
 
-    def epoch_body(salt, acc):
-        out = step(sharded._replace(
-            balance=sharded.balance.at[0].set(31 * gwei + salt.astype(jnp.int64))),
-            jnp.int64(10), jnp.int64(8), bits4, jnp.int64(8), jnp.int64(9),
-            jnp.int64(0))
-        return acc + checksum_tree(out)
+    def _mesh_setup():
+        from pos_evolution_tpu.config import mainnet_config
+        from pos_evolution_tpu.ops.epoch import DenseRegistry
+        from pos_evolution_tpu.parallel.sharded import (
+            make_mesh, shard_registry, sharded_epoch_step,
+        )
+        cfg = mainnet_config()
+        reg = DenseRegistry(
+            effective_balance=jnp.asarray(np.full(n, 32 * gwei, np.int64)),
+            balance=jnp.asarray(
+                rng.integers(31 * gwei, 33 * gwei, n).astype(np.int64)),
+            activation_epoch=jnp.zeros(n, jnp.int64),
+            exit_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
+            withdrawable_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
+            slashed=jnp.zeros(n, bool),
+            prev_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+            cur_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+            inactivity_scores=jnp.zeros(n, jnp.int64),
+        )
+        mesh = make_mesh()
+        _mesh_state.update(cfg=cfg, reg=reg, mesh=mesh,
+                           step=sharded_epoch_step(mesh, cfg),
+                           sharded=shard_registry(mesh, reg))
+        return {"mesh": dict(zip(mesh.axis_names, mesh.devices.shape))}
 
-    t = fused_measure(epoch_body, entropy=entropy, tag="epoch sharded")
-    results["config4_epoch_1m_sharded"] = {
-        "n_validators": n, "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
-        "ms_scaled_to_1m": round(t * 1e3 * scale, 3)}
+    if wd.step("mesh_setup", _mesh_setup) is None:
+        results["config4_epoch_1m_sharded"] = _failed("mesh_setup")
+        results["config5_ssf_tally_1m"] = _failed("mesh_setup")
+    else:
+        cfg, reg, mesh, step, sharded = (
+            _mesh_state["cfg"], _mesh_state["reg"], _mesh_state["mesh"],
+            _mesh_state["step"], _mesh_state["sharded"])
+        bits4 = jnp.zeros(4, bool)
 
-    # --- config 5: SSF supermajority tally ---
-    from pos_evolution_tpu.parallel.sharded import ssf_supermajority_tally
-    tally = ssf_supermajority_tally(mesh)
-    votes = jnp.asarray(np.arange(n) % 3 != 0)
-    eff = reg.effective_balance
-    total = jnp.int64(n * 32 * gwei)
+        def _config4():
+            def epoch_body(salt, acc):
+                out = step(sharded._replace(
+                    balance=sharded.balance.at[0].set(
+                        31 * gwei + salt.astype(jnp.int64))),
+                    jnp.int64(10), jnp.int64(8), bits4, jnp.int64(8),
+                    jnp.int64(9), jnp.int64(0))
+                return acc + checksum_tree(out)
 
-    def ssf_body(salt, acc):
-        out = tally(votes.at[salt % n].set(salt % 2 == 0), eff, total)
-        return acc + checksum_tree(out)
+            t = fused_measure(epoch_body, entropy=entropy, tag="epoch sharded")
+            return {"n_validators": n,
+                    "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
+                    "ms_scaled_to_1m": round(t * 1e3 * scale, 3)}
 
-    t = fused_measure(ssf_body, entropy=entropy, tag="ssf tally")
-    results["config5_ssf_tally_1m"] = {"ms_scaled_to_1m": round(t * 1e3 * scale, 4)}
+        results["config4_epoch_1m_sharded"] = wd.step(
+            "config4", _config4, default=_failed("config4"))
+
+        def _config5():
+            from pos_evolution_tpu.parallel.sharded import (
+                ssf_supermajority_tally,
+            )
+            tally = ssf_supermajority_tally(mesh)
+            votes = jnp.asarray(np.arange(n) % 3 != 0)
+            eff = reg.effective_balance
+            total = jnp.int64(n * 32 * gwei)
+
+            def ssf_body(salt, acc):
+                out = tally(votes.at[salt % n].set(salt % 2 == 0), eff, total)
+                return acc + checksum_tree(out)
+
+            t = fused_measure(ssf_body, entropy=entropy, tag="ssf tally")
+            return {"ms_scaled_to_1m": round(t * 1e3 * scale, 4)}
+
+        results["config5_ssf_tally_1m"] = wd.step(
+            "config5", _config5, default=_failed("config5"))
+
+    if wd.incidents:
+        results["watchdog_incidents"] = wd.incidents
 
     out = json.dumps(results, indent=1)
     print(out)

@@ -441,7 +441,8 @@ def build_dense_store(store, capacity: int | None = None):
         # same voting-source viability rule as the spec layer
         leaf_viable[i] = _leaf_is_viable(store, root)
 
-    justified_state = store.checkpoint_states[jc.as_key()]
+    from pos_evolution_tpu.specs.forkchoice import justified_checkpoint_state
+    justified_state = justified_checkpoint_state(store)
     n = len(justified_state.validators)
     reg = justified_state.validators
     current_epoch = compute_epoch_at_slot(get_current_slot(store))

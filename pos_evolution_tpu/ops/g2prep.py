@@ -189,18 +189,43 @@ def g2_decompress_batch(x: jax.Array, sign_large: jax.Array):
 
 def g2_compressed_to_limbs(data: np.ndarray):
     """Host unpack of 96-byte compressed G2 signatures [B, 96] u8 ->
-    (x limbs [B, 2, 32], sign bool[B], inf bool[B])."""
+    (x limbs [B, 2, 32], sign bool[B], inf bool[B], invalid bool[B]).
+
+    Canonicality is validated per row instead of silently aliasing
+    malformed encodings (a wire signature is attacker-supplied data):
+    the ZCash compression flag (bit 383) must be set, both Fq coordinates
+    must be fully reduced (< Q — otherwise x and x - Q decode to the same
+    point and one signature has two encodings), and the infinity flag
+    must come with all-zero payload bits. Invalid rows get zeroed limbs
+    and ``invalid=True``; callers decide whether to reject or mask."""
     data = np.asarray(data, np.uint8).reshape(-1, 96)
     out_x = np.zeros((data.shape[0], 2, fp.L), np.int32)
     sign = np.zeros(data.shape[0], bool)
     inf = np.zeros(data.shape[0], bool)
+    invalid = np.zeros(data.shape[0], bool)
     for i, row in enumerate(data):
         hi = int.from_bytes(row[:48].tobytes(), "big")
+        lo = int.from_bytes(row[48:].tobytes(), "big")
+        compressed = bool(hi & (1 << 383))
         inf[i] = bool(hi & (1 << 382))
         sign[i] = bool(hi & (1 << 381))
-        out_x[i, 1] = fp.to_limbs(hi & ((1 << 381) - 1))
-        out_x[i, 0] = fp.to_limbs(int.from_bytes(row[48:].tobytes(), "big"))
-    return out_x, sign, inf
+        x_im = hi & ((1 << 381) - 1)
+        if not compressed:
+            invalid[i] = True               # uncompressed/garbage framing
+            sign[i] = False                 # don't echo garbage flag bits
+            inf[i] = False
+            continue
+        if inf[i]:
+            # canonical infinity: no sign, no coordinate bits
+            invalid[i] = sign[i] or x_im != 0 or lo != 0
+            sign[i] = False
+            continue
+        if x_im >= Q or lo >= Q:
+            invalid[i] = True               # non-reduced field element
+            continue
+        out_x[i, 1] = fp.to_limbs(x_im)
+        out_x[i, 0] = fp.to_limbs(lo)
+    return out_x, sign, inf, invalid
 
 
 # --- G2 (twist) Jacobian arithmetic ------------------------------------------
@@ -369,11 +394,21 @@ def hash_to_g2_finish(x: jax.Array):
 
 def hash_to_g2_batch(messages):
     """Full batched map: host candidate scan + device finish.
-    Returns affine [B, 2, 2, 32]; raises on the (measure-zero)
-    cofactor-to-infinity case instead of retrying."""
+    Returns affine [B, 2, 2, 32].
+
+    Graceful degradation in miniature: the (measure-zero)
+    cofactor-clears-to-infinity rows — where the device pipeline cannot
+    retry without a data-dependent rehash — fall back to the host oracle
+    for JUST those messages, keeping the batch result bit-exact with
+    ``crypto/bls12_381.hash_to_g2`` instead of aborting the whole batch."""
     x, _ = hash_to_g2_candidates(messages)
     aff, ok = hash_to_g2_finish(jnp.asarray(x))
-    if not bool(np.asarray(ok).all()):
-        raise ValueError("hash_to_g2_batch: cleared point at infinity "
-                         "(retry path not implemented on device)")
+    ok_np = np.asarray(ok)
+    if not ok_np.all():
+        from pos_evolution_tpu.ops.pairing import g2_affine_encode
+        patched = np.array(aff)
+        for i in np.nonzero(~ok_np)[0]:
+            patched[int(i)] = g2_affine_encode(
+                oracle.hash_to_g2(bytes(messages[int(i)])))
+        aff = jnp.asarray(patched)
     return aff

@@ -33,9 +33,13 @@ all three across simulated epochs.
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 import jax.numpy as jnp
+
+logger = logging.getLogger("pos_evolution_tpu.resident")
 
 from pos_evolution_tpu.ops.forkchoice import (
     apply_latest_messages,
@@ -48,11 +52,40 @@ from pos_evolution_tpu.ops.forkchoice import (
 
 
 class ResidentForkChoice:
-    """Device-resident dense mirror of one spec-level ``Store``."""
+    """Device-resident dense mirror of one spec-level ``Store``.
 
-    def __init__(self, store, capacity: int = 64):
+    Graceful degradation: the accelerated path is an *optimization* of the
+    spec walk, never a source of truth — so any device error, and any
+    divergence caught by the periodic self-check (every
+    ``selfcheck_every`` head queries, compare against
+    ``specs/forkchoice.get_head``), permanently drops this instance to the
+    host path. The event is logged and recorded in ``incidents``; the run
+    keeps going on spec fork choice (`degraded=True`) instead of dying
+    mid-simulation/bench. ``selfcheck_every=0`` disables the periodic
+    audit (the differential tests pin equality on every query anyway)."""
+
+    def __init__(self, store, capacity: int = 64, selfcheck_every: int = 64):
         self._min_capacity = capacity
-        self.rebuild(store)
+        self.selfcheck_every = selfcheck_every
+        self.degraded = False
+        self.incidents: list[str] = []
+        self._head_queries = 0
+        self._pending = []          # rebuild re-creates; safe if it dies
+        try:
+            self.rebuild(store)
+        except Exception as e:
+            # a box whose device path is broken outright (resume of a
+            # degraded checkpoint, crash-restart mid-outage) still gets a
+            # working instance: every device-touching method early-returns
+            # once degraded, and head() answers from the spec walk
+            self._degrade(f"initial rebuild failed: {e!r}")
+
+    def _degrade(self, reason: str) -> None:
+        self.degraded = True
+        self.incidents.append(reason)
+        logger.warning(
+            "resident fork choice degraded to the host spec path: %s",
+            reason)
 
     # -- full (re)build --------------------------------------------------------
 
@@ -77,9 +110,12 @@ class ResidentForkChoice:
         # effective balance under the justified-checkpoint registry, masked
         # by activation window / slashed / equivocating (pos-evolution.md
         # :322, 1438).
-        from pos_evolution_tpu.specs.forkchoice import get_current_slot
+        from pos_evolution_tpu.specs.forkchoice import (
+            get_current_slot,
+            justified_checkpoint_state,
+        )
         from pos_evolution_tpu.specs.helpers import compute_epoch_at_slot
-        jstate = store.checkpoint_states[store.justified_checkpoint.as_key()]
+        jstate = justified_checkpoint_state(store)
         reg = jstate.validators
         current_epoch = compute_epoch_at_slot(get_current_slot(store))
         active = ((reg.activation_epoch <= np.uint64(current_epoch))
@@ -133,6 +169,14 @@ class ResidentForkChoice:
         new root — recomputed host-side in O(B log B) numpy, no device
         rescan. Checkpoint moves triggered by the block are caught by the
         ``sync`` fingerprint."""
+        if self.degraded:
+            return
+        try:
+            self._note_block(store, block_root)
+        except Exception as e:
+            self._degrade(f"note_block failed: {e!r}")
+
+    def _note_block(self, store, block_root: bytes) -> None:
         if len(self.roots) + 1 > self.capacity:
             self.rebuild(store)
             return
@@ -157,6 +201,16 @@ class ResidentForkChoice:
                          beacon_block_root: bytes) -> None:
         """Queue latest-message updates; one padded scatter batch lands
         them at the next flush point (head query / slashing / sync)."""
+        if self.degraded:
+            return
+        try:
+            self._note_attestation(attesting_indices, target_epoch,
+                                   beacon_block_root)
+        except Exception as e:
+            self._degrade(f"note_attestation failed: {e!r}")
+
+    def _note_attestation(self, attesting_indices, target_epoch: int,
+                          beacon_block_root: bytes) -> None:
         idx = self.index_of.get(bytes(beacon_block_root))
         if idx is None:
             return
@@ -199,6 +253,14 @@ class ResidentForkChoice:
     def note_slashing(self, indices) -> None:
         """Mirror ``on_attester_slashing``: discount landed votes and bar
         future ones (equivocation discounting, pos-evolution.md:1438)."""
+        if self.degraded:
+            return
+        try:
+            self._note_slashing(indices)
+        except Exception as e:
+            self._degrade(f"note_slashing failed: {e!r}")
+
+    def _note_slashing(self, indices) -> None:
         idx = np.asarray(sorted(set(int(i) for i in indices)), dtype=np.int32)
         idx = idx[idx < self.weight.shape[0]]
         if idx.size == 0:
@@ -215,7 +277,29 @@ class ResidentForkChoice:
     def head(self, store) -> bytes:
         """The fast-path head query: flush pending votes, read boost
         scalars from the spec store (they are per-slot host state,
-        pos-evolution.md:942-944), descend on device."""
+        pos-evolution.md:942-944), descend on device. Once degraded —
+        device error here or in a handler, or a self-check divergence —
+        every query answers from the spec walk instead."""
+        from pos_evolution_tpu.specs.forkchoice import get_head
+        if self.degraded:
+            return get_head(store)
+        try:
+            root = self._device_head(store)
+        except Exception as e:
+            self._degrade(f"device head query failed: {e!r}")
+            return get_head(store)
+        self._head_queries += 1
+        if (self.selfcheck_every
+                and self._head_queries % self.selfcheck_every == 0):
+            spec_root = get_head(store)
+            if spec_root != root:
+                self._degrade(
+                    f"divergence self-check at query {self._head_queries}: "
+                    f"device={root.hex()[:8]} spec={spec_root.hex()[:8]}")
+                return spec_root
+        return root
+
+    def _device_head(self, store) -> bytes:
         from pos_evolution_tpu.specs.forkchoice import get_proposer_boost
         self.sync(store)
         self.flush()

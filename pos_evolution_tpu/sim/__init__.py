@@ -1,4 +1,15 @@
 """Network & adversary simulation layer (L6)."""
 
 from pos_evolution_tpu.sim.driver import Simulation, ViewGroup
-from pos_evolution_tpu.sim.schedule import Schedule, honest_schedule, partition_schedule
+from pos_evolution_tpu.sim.faults import (
+    CrashWindow,
+    FaultPlan,
+    chaos_plan,
+    lossy_plan,
+)
+from pos_evolution_tpu.sim.schedule import (
+    Schedule,
+    faulty_schedule,
+    honest_schedule,
+    partition_schedule,
+)

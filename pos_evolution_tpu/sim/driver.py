@@ -55,6 +55,11 @@ class ViewGroup:
         self.id = group_id
         self.store = store
         self.members = members
+        # Crash-fault state (sim/faults.py CrashWindow): a crashed group
+        # processes nothing and receives nothing until it rejoins via
+        # weak-subjectivity checkpoint sync. Always recomputable from the
+        # FaultPlan and the current slot (never serialized).
+        self.crashed = False
         self.queue: list[_QueuedMessage] = []
         self.pool: dict[bytes, object] = {}  # attestation root -> Attestation
         # Attestation roots carried by each processed block (block root ->
@@ -83,7 +88,31 @@ class ViewGroup:
                 indices, int(att.data.target.epoch),
                 bytes(att.data.beacon_block_root))
 
-    def deliver_due(self, now: float, timer) -> None:
+    def _process_block(self, signed_block) -> None:
+        """One ``on_block`` plus its carried attestations and the resident
+        mirror — shared by gossip delivery and ancestor backfill."""
+        block_root = hash_tree_root(signed_block.message)
+        if block_root in self.store.blocks:
+            # redelivery (FaultPlan duplicate_p, or a backfilled block
+            # arriving again via gossip): reprocessing would re-run the
+            # state transition AND append a duplicate row to the resident
+            # mirror, splitting its vote weights — gossip dedup is part of
+            # every real client's pipeline
+            return
+        fc.on_block(self.store, signed_block)
+        if self.resident is not None:
+            self.resident.note_block(self.store, block_root)
+        carried = []
+        for att in signed_block.message.body.attestations:
+            carried.append(hash_tree_root(att))
+            try:
+                idx = fc.on_attestation(self.store, att, is_from_block=True)
+                self._mirror_attestation(att, idx)
+            except AssertionError:
+                pass
+        self.block_atts[block_root] = carried
+
+    def deliver_due(self, now: float, timer, resolver=None) -> None:
         track = timer.track
         while self.queue and self.queue[0].time <= now:
             msg = heapq.heappop(self.queue)
@@ -91,20 +120,9 @@ class ViewGroup:
                 if msg.kind == "block":
                     # block-carried attestations are part of on_block cost
                     with track("on_block"):
-                        fc.on_block(self.store, msg.payload)
-                        block_root = hash_tree_root(msg.payload.message)
-                        if self.resident is not None:
-                            self.resident.note_block(self.store, block_root)
-                        carried = []
-                        for att in msg.payload.message.body.attestations:
-                            carried.append(hash_tree_root(att))
-                            try:
-                                idx = fc.on_attestation(self.store, att,
-                                                        is_from_block=True)
-                                self._mirror_attestation(att, idx)
-                            except AssertionError:
-                                pass
-                        self.block_atts[block_root] = carried
+                        if resolver is not None:
+                            resolver(self, msg.payload)
+                        self._process_block(msg.payload)
                 elif msg.kind == "attestation":
                     with track("on_attestation"):
                         idx = fc.on_attestation(self.store, msg.payload)
@@ -118,7 +136,10 @@ class ViewGroup:
             except AssertionError:
                 # Invalid-at-this-time messages are dropped (the reference
                 # permits re-queueing, pos-evolution.md:967-968; the driver
-                # keeps the simple policy).
+                # keeps the simple policy). Pre-anchor walks in a
+                # checkpoint-synced view land here too via the handlers'
+                # own asserts (get_ancestor clamps to the anchor instead
+                # of raising, so a genuine KeyError stays a loud bug).
                 continue
 
 
@@ -129,6 +150,8 @@ class Simulation:
                  genesis_time: int = 0, accelerated_forkchoice: bool = False):
         self.cfg = cfg()
         self.schedule = schedule or honest_schedule(n_validators)
+        self.n_validators = n_validators
+        self.genesis_time = genesis_time
         state, anchor = make_genesis(n_validators, genesis_time)
         self.genesis_state = state
         self.anchor_root = hash_tree_root(anchor)
@@ -149,6 +172,12 @@ class Simulation:
         self.groups = [_make_group(g) for g in range(self.schedule.n_groups)]
         self.slot = 0
         self.metrics: list[dict] = []
+        # Every block ever broadcast, by root — the "some peer has it"
+        # pool backing block-by-root req/resp sync (``_sync_ancestors``).
+        # Without a catch-up path one dropped block would fork a view
+        # PERMANENTLY, making post-GST recovery impossible by
+        # construction; real clients re-fetch missing parents.
+        self.block_archive: dict[bytes, object] = {}
         # Device fork choice: every head query runs on the persistent
         # device store (ops/resident.py) — incremental bucket updates as
         # messages arrive, O(B log B) head_from_buckets per query, no
@@ -180,8 +209,118 @@ class Simulation:
 
     def _tick_all(self, time: float) -> None:
         for g in self.groups:
+            if g.crashed:
+                continue
             fc.on_tick(g.store, int(time))
-            g.deliver_due(time, timer=self.timer)
+            g.deliver_due(time, timer=self.timer,
+                          resolver=self._sync_ancestors)
+
+    def _sync_ancestors(self, dst: ViewGroup, signed_block) -> None:
+        """Block-by-root backfill (the req/resp sync of real clients):
+        when a gossiped block's ancestry is missing from ``dst``'s view,
+        pull the gap from the archive and process oldest-first. This is
+        what makes faults *transient*: a dropped block becomes a delayed
+        one the moment any descendant arrives, and a checkpoint-synced
+        rejoiner catches up from its anchor the same way. Deterministic
+        (the archive is part of the checkpointed state), so resume
+        replays it exactly."""
+        missing = []
+        parent = bytes(signed_block.message.parent_root)
+        while parent not in dst.store.blocks:
+            sb = self.block_archive.get(parent)
+            if sb is None:
+                return  # unconnectable (pre-anchor history): let on_block fail
+            missing.append(sb)
+            parent = bytes(sb.message.parent_root)
+        for sb in reversed(missing):
+            dst._process_block(sb)
+
+    # -- fault layer (sim/faults.py) -------------------------------------------
+
+    def _send(self, dst: ViewGroup, base_time: float, delay: float | None,
+              kind: str, payload, slot: int, src: int, msg_id: int) -> None:
+        """Deliver one message copy-set to ``dst``, routed through the
+        ``FaultPlan`` (drop / duplicate / reorder) when one is attached.
+        Crashed groups receive nothing (the wire has no mailbox for them:
+        whatever is sent during the outage is lost, pos-evolution.md:191)."""
+        if delay is None or dst.crashed:
+            return
+        t = base_time + delay
+        plan = self.schedule.faults
+        if plan is None:
+            dst.enqueue(t, kind, payload)
+            return
+        for extra in plan.delivery_offsets(kind, slot, src, msg_id, dst.id, t):
+            dst.enqueue(t + extra, kind, payload)
+
+    def _apply_fault_transitions(self, slot: int) -> None:
+        """Crash / rejoin view groups at slot boundaries per the plan's
+        ``CrashWindow``s. Crash state is a pure function of the slot, so a
+        checkpoint taken mid-outage resumes into the same state."""
+        plan = self.schedule.faults
+        if plan is None or not plan.crashes:
+            return
+        for g in self.groups:
+            down = plan.crashed(g.id, slot)
+            if down and not g.crashed:
+                g.crashed = True
+                # the process died: in-flight messages and the op pool go
+                # with it (the store survives on disk — rejoin discards it
+                # anyway in favor of the synced checkpoint)
+                g.queue.clear()
+                g.pool.clear()
+                g.block_atts.clear()
+            elif g.crashed and not down:
+                self._rejoin_group(g, slot)
+
+    def _rejoin_group(self, group: ViewGroup, slot: int) -> None:
+        """Checkpoint sync: the restarted group boots from a live peer's
+        JUSTIFIED checkpoint — the reference's own resume mechanism
+        ("checkpoints that act as new genesis", pos-evolution.md:1216) —
+        after passing the weak-subjectivity gate (:1293-1302). History
+        before the checkpoint is gone; blocks since it arrive via
+        ``_sync_ancestors`` backfill.
+
+        The anchor must be a checkpoint, never a raw head: store init
+        marks the anchor justified at its own current epoch, and a head
+        snapshot would claim a justified epoch the chain never reached —
+        every later leaf then fails the viability filter's voting-source
+        check (specs/forkchoice._leaf_is_viable) and the synced store
+        freezes at its anchor forever. The justified checkpoint is
+        exactly the newest point whose descendants' voting sources keep
+        the filter satisfied."""
+        from pos_evolution_tpu.specs.weak_subjectivity import (
+            checkpoint_for_state,
+            is_within_weak_subjectivity_period,
+        )
+        from pos_evolution_tpu.utils.snapshot import (
+            load_anchor,
+            resume_store,
+            save_anchor,
+        )
+        donors = [g for g in self.groups if g is not group and not g.crashed]
+        if not donors:
+            raise RuntimeError("crash-restart: no live peer to sync from")
+        donor = donors[0].store
+        jroot = bytes(donor.justified_checkpoint.root)
+        snap = save_anchor(donor.block_states[jroot], donor.blocks[jroot])
+        store = resume_store(snap, pow_chain=self.pow_chain)
+        fc.on_tick(store, self.slot_start(slot))
+        ws_state, ws_checkpoint = checkpoint_for_state(load_anchor(snap)[0])
+        if not is_within_weak_subjectivity_period(store, ws_state,
+                                                  ws_checkpoint):
+            raise RuntimeError(
+                "crash-restart: checkpoint outside the weak-subjectivity "
+                "period — a rejoin would be vulnerable to long-range forks "
+                "(pos-evolution.md:1200)")
+        group.store = store
+        group.queue.clear()
+        group.pool.clear()
+        group.block_atts = {}
+        group.crashed = False
+        if group.resident is not None:
+            from pos_evolution_tpu.ops.resident import ResidentForkChoice
+            group.resident = ResidentForkChoice(store)
 
     # -- duties --
     def _head_state(self, group: ViewGroup, slot: int):
@@ -192,6 +331,8 @@ class Simulation:
         t0 = self.slot_start(slot)
         proposed: set[int] = set()
         for group in self.groups:
+            if group.crashed:
+                continue  # its members' processes are down
             head, head_state = self._head_state(group, slot)
             proposer = get_beacon_proposer_index(head_state)
             if proposer in proposed:
@@ -204,16 +345,61 @@ class Simulation:
             if not self.schedule.awake(round_index, int(proposer)):
                 continue
             proposed.add(proposer)
-            atts = self._pack_attestations(group, slot, head)
-            sb = build_block(group.store.block_states[head], slot, attestations=atts)
+            atts = self._pack_attestations(group, slot, head,
+                                           head_state=head_state)
+            try:
+                sb = build_block(group.store.block_states[head], slot,
+                                 attestations=atts)
+            except AssertionError:
+                # Rare fault-era residue: an attestation that passed the
+                # cheap packing filter is still unincludable (e.g. a
+                # committee reshuffled across an epoch-crossing fork).
+                # A real proposer drops the op, not the proposal.
+                sb = build_block(group.store.block_states[head], slot,
+                                 attestations=[])
+            self.block_archive[hash_tree_root(sb.message)] = sb
             for dst in self.groups:
                 delay = self.schedule.block_delay(int(proposer), slot, dst.id)
-                if delay is None:
-                    continue
-                dst.enqueue(t0 + delay, "block", sb)
+                self._send(dst, t0, delay, "block", sb, slot,
+                           src=int(proposer), msg_id=0)
+
+    def _includable(self, state, att) -> bool:
+        """Cheap op-pool validity filter mirroring process_attestation's
+        asserts that can fail for a STALE pool entry under faults: target
+        epoch outside the state's window, an FFG source that no longer
+        matches the proposal state's justified checkpoint (justification
+        moved while the attestation sat in the pool), a committee index
+        out of range, or a committee size mismatch. Real clients validate
+        ops at packing time; without this, one stale vote aborts the
+        whole proposal."""
+        from pos_evolution_tpu.specs.helpers import (
+            get_beacon_committee,
+            get_current_epoch,
+            get_previous_epoch,
+        )
+        data = att.data
+        target_epoch = int(data.target.epoch)
+        if target_epoch not in (get_previous_epoch(state),
+                                get_current_epoch(state)):
+            return False
+        if int(data.index) >= get_committee_count_per_slot(state,
+                                                           target_epoch):
+            return False
+        expected = (state.current_justified_checkpoint
+                    if target_epoch == get_current_epoch(state)
+                    else state.previous_justified_checkpoint)
+        if (int(data.source.epoch) != int(expected.epoch)
+                or bytes(data.source.root) != bytes(expected.root)):
+            return False
+        try:
+            committee = get_beacon_committee(state, int(data.slot),
+                                             int(data.index))
+        except (AssertionError, IndexError):
+            return False
+        return np.asarray(att.aggregation_bits).shape[0] == committee.shape[0]
 
     def _pack_attestations(self, group: ViewGroup, slot: int,
-                           head: bytes) -> list:
+                           head: bytes, head_state=None) -> list:
         c = self.cfg
         # inclusion set of the proposer's CANONICAL chain, within the
         # attestation window: walk head ancestry while blocks are recent
@@ -238,7 +424,11 @@ class Simulation:
             if root in onchain:
                 continue                       # already on this chain
             if len(out) < c.max_attestations:
-                out.append(att)
+                # validity filter LAST, only for entries actually packed
+                # (it computes a committee — O(max_attestations) per
+                # proposal, not O(pool))
+                if head_state is None or self._includable(head_state, att):
+                    out.append(att)
         for root in expired:
             del group.pool[root]
         return out
@@ -246,6 +436,8 @@ class Simulation:
     def _attest(self, slot: int) -> None:
         t_next = self.slot_start(slot + 1)
         for group in self.groups:
+            if group.crashed:
+                continue
             head, head_state = self._head_state(group, slot)
             honest = set(int(v) for v in self.schedule.honest_members(group.id))
             if not honest:
@@ -264,14 +456,14 @@ class Simulation:
                     continue  # no awake member in this committee
                 for dst in self.groups:
                     delay = self.schedule.attestation_delay(group.id, slot, dst.id)
-                    if delay is None:
-                        continue
-                    dst.enqueue(t_next + delay, "attestation", att)
+                    self._send(dst, t_next, delay, "attestation", att, slot,
+                               src=group.id, msg_id=index)
 
     # -- main loop --
     def run_slot(self) -> None:
         slot = self.slot
         t0 = self.slot_start(slot)
+        self._apply_fault_transitions(slot)
         self._tick_all(t0)
         if slot > 0:
             self._propose(slot)
@@ -302,6 +494,28 @@ class Simulation:
             "n_blocks": len(g0.blocks),
             "equivocators": len(g0.equivocating_indices),
         })
+
+    # -- whole-simulation checkpoint / resume ----------------------------------
+    def checkpoint(self) -> bytes:
+        """Serialize the ENTIRE simulation — every view group's store,
+        message queue, attestation pool and inclusion index, plus the slot
+        cursor and per-slot metrics — such that ``Simulation.resume``
+        continues the run bit-identically (property-pinned by
+        tests/test_faults.py). Wall-clock handler timings are the one
+        thing deliberately excluded (they are not simulation state)."""
+        from pos_evolution_tpu.utils.snapshot import save_simulation
+        return save_simulation(self)
+
+    @classmethod
+    def resume(cls, data: bytes,
+               schedule: Schedule | None = None) -> "Simulation":
+        """Rebuild a checkpointed simulation mid-run. ``schedule`` must be
+        the same delivery/fault policy the original run used (schedules
+        hold callables, which do not serialize); None resumes an honest
+        synchronous run. Crash state re-derives from the FaultPlan, so a
+        checkpoint taken during an outage resumes into the outage."""
+        from pos_evolution_tpu.utils.snapshot import load_simulation
+        return load_simulation(data, schedule=schedule)
 
     # -- accessors --
     def store(self, group: int = 0) -> fc.Store:

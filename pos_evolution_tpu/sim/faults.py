@@ -1,0 +1,153 @@
+"""Fault injection as data, beyond delivery delays (pos-evolution.md:183-199).
+
+``Schedule`` (sim/schedule.py) already expresses Byzantine corruption,
+per-round sleepiness, and adversary-chosen *delays*. The reference's
+adversary is richer: messages can be lost, duplicated, and reordered
+arbitrarily before GST (partial synchrony, :197-199), and validators can
+crash outright and later rejoin by syncing from a weak-subjectivity
+checkpoint (:1198-1317, "checkpoints that act as new genesis" :1216).
+
+A ``FaultPlan`` captures that as *data* composable with any ``Schedule``:
+
+- per-(message, recipient-group) drop / duplicate / reorder probabilities,
+  decided by a **stateless seeded hash** of the message identity — no RNG
+  cursor, so a simulation checkpointed and resumed mid-run replays the
+  exact same fault pattern (the bit-identical-resume contract of
+  ``Simulation.checkpoint``);
+- a GST (global stabilization time) after which the network is synchronous
+  and all message faults switch off (:199); finalization must then resume
+  — the ebb-and-flow claim (:1184-1190) the fault tests pin;
+- ``CrashWindow``\\ s: a view group that stops processing entirely for a
+  slot range, loses its in-flight messages, and rejoins via the
+  weak-subjectivity checkpoint-sync path (``utils/snapshot.resume_store``
+  gated by ``is_within_weak_subjectivity_period``) — the driver performs
+  the sync; the plan only declares the window, so crash state needs no
+  serialization (it is a pure function of the current slot).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+
+# integer tags for message kinds (stable fault-decision identity)
+_KIND_TAG = {"block": 0, "attestation": 1, "slashing": 2}
+
+
+@dataclass(frozen=True)
+class CrashWindow:
+    """View group ``group`` is down for slots [crash_slot, rejoin_slot):
+    it processes nothing, receives nothing (messages in flight are lost),
+    and at ``rejoin_slot`` rejoins by checkpoint sync from a live peer."""
+
+    group: int
+    crash_slot: int
+    rejoin_slot: int
+
+    def __post_init__(self):
+        assert self.crash_slot < self.rejoin_slot, "empty crash window"
+
+
+@dataclass
+class FaultPlan:
+    """Composable message-fault policy; attach via ``Schedule.faults``."""
+
+    seed: int = 0
+    # Per-(message, recipient-group) probabilities, active before GST.
+    drop_p: float = 0.0
+    duplicate_p: float = 0.0
+    reorder_p: float = 0.0
+    # A reordered (or duplicated) copy lands up to this many seconds late —
+    # the adversary's "target a message for delivery ... just before a
+    # certain point in time" capability (pos-evolution.md:1328) expressed
+    # as bounded jitter.
+    reorder_max_delay: float = 4.0
+    # Global stabilization time in seconds since genesis; None = faults
+    # stay active for the whole run (no partial-synchrony window).
+    gst: float | None = None
+    crashes: tuple = ()
+    # Observability: when True, every non-trivial fault decision appends a
+    # dict to ``log`` (tests assert drop invariants against it). The log
+    # is NOT part of simulation state: a resumed run re-records only
+    # post-resume decisions.
+    record_log: bool = False
+    log: list = field(default_factory=list)
+
+    # -- stateless randomness --------------------------------------------------
+
+    def _unit(self, *key: int) -> float:
+        """Uniform [0, 1) from a hash of (seed, key): no RNG stream, no
+        call-order dependence — the same message identity always draws the
+        same number, before or after a checkpoint/resume."""
+        h = hashlib.blake2b(
+            struct.pack(f"<{len(key) + 1}q", self.seed, *key),
+            digest_size=8).digest()
+        return int.from_bytes(h, "little") / 2.0**64
+
+    # -- message faults --------------------------------------------------------
+
+    def active(self, time: float) -> bool:
+        """Message faults apply only before GST (pos-evolution.md:199)."""
+        return self.gst is None or time < self.gst
+
+    def delivery_offsets(self, kind: str, slot: int, src: int, msg_id: int,
+                         dst_group: int, base_time: float) -> list[float]:
+        """Extra delays (seconds, added to the scheduled delivery time) for
+        each copy of one (message, recipient-group) delivery. ``[]`` means
+        dropped; two entries mean duplicated; a single nonzero entry is a
+        reorder past later-sent messages."""
+        if not self.active(base_time):
+            return [0.0]
+        tag = _KIND_TAG.get(kind, 3)
+        key = (tag, slot, src, msg_id, dst_group)
+        if self.drop_p > 0.0 and self._unit(0, *key) < self.drop_p:
+            self._log("drop", kind, slot, src, msg_id, dst_group)
+            return []
+        offsets = [0.0]
+        if self.reorder_p > 0.0 and self._unit(1, *key) < self.reorder_p:
+            offsets = [self._unit(2, *key) * self.reorder_max_delay]
+            self._log("reorder", kind, slot, src, msg_id, dst_group)
+        if self.duplicate_p > 0.0 and self._unit(3, *key) < self.duplicate_p:
+            offsets.append(self._unit(4, *key) * self.reorder_max_delay)
+            self._log("duplicate", kind, slot, src, msg_id, dst_group)
+        return offsets
+
+    def _log(self, action: str, kind: str, slot: int, src: int, msg_id: int,
+             dst_group: int) -> None:
+        if self.record_log:
+            self.log.append({"action": action, "kind": kind, "slot": slot,
+                             "src": src, "msg_id": msg_id, "dst": dst_group})
+
+    def dropped(self, kind: str | None = None) -> list[dict]:
+        """Recorded drop events (requires ``record_log=True``)."""
+        return [e for e in self.log if e["action"] == "drop"
+                and (kind is None or e["kind"] == kind)]
+
+    # -- crash windows ---------------------------------------------------------
+
+    def crashed(self, group: int, slot: int) -> bool:
+        """Pure function of the slot — no crash state to checkpoint."""
+        return any(w.group == group and w.crash_slot <= slot < w.rejoin_slot
+                   for w in self.crashes)
+
+    def rejoins(self, group: int, slot: int) -> bool:
+        """True exactly at the slot where ``group`` comes back up (and is
+        not immediately re-crashed by an overlapping window)."""
+        return (any(w.group == group and w.rejoin_slot == slot
+                    for w in self.crashes)
+                and not self.crashed(group, slot))
+
+
+def lossy_plan(seed: int = 0, drop_p: float = 0.1,
+               gst: float | None = None) -> FaultPlan:
+    """Message loss only — the minimal ebb-and-flow adversary."""
+    return FaultPlan(seed=seed, drop_p=drop_p, gst=gst)
+
+
+def chaos_plan(seed: int = 0, drop_p: float = 0.05, duplicate_p: float = 0.05,
+               reorder_p: float = 0.1, gst: float | None = None,
+               crashes: tuple = ()) -> FaultPlan:
+    """Drops + duplicates + reorders + optional crash windows."""
+    return FaultPlan(seed=seed, drop_p=drop_p, duplicate_p=duplicate_p,
+                     reorder_p=reorder_p, gst=gst, crashes=tuple(crashes))

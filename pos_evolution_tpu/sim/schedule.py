@@ -41,6 +41,10 @@ class Schedule:
     # attestation_delay(attester_group, slot, group) -> seconds after the
     # *next* slot start (wire attestations are only usable from slot+1).
     attestation_delay: Callable[[int, int, int], float | None] = None
+    # Message-level fault policy (sim/faults.py): per-message drop /
+    # duplicate / reorder, GST windows, crash-restart view groups. None =
+    # faithful delivery at exactly the scheduled delays (the model above).
+    faults: "FaultPlan | None" = None
 
     def __post_init__(self):
         if self.group_of is None:
@@ -80,3 +84,13 @@ def partition_schedule(n_validators: int, n_groups: int,
         group_of=np.arange(n_validators, dtype=np.int64) % n_groups,
         corrupted=corrupted or set(),
     )
+
+
+def faulty_schedule(n_validators: int, faults, n_groups: int = 1,
+                    corrupted: set | None = None) -> Schedule:
+    """A partitioned (or single-view) schedule with a ``FaultPlan``
+    attached — the composition point for the sim/faults.py adversary."""
+    sched = (honest_schedule(n_validators) if n_groups == 1 else
+             partition_schedule(n_validators, n_groups, corrupted))
+    sched.faults = faults
+    return sched

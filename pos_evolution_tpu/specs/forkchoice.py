@@ -104,11 +104,22 @@ def compute_slots_since_epoch_start(slot: int) -> int:
 # --- tree walks ---------------------------------------------------------------
 
 def get_ancestor(store: Store, root: bytes, slot: int) -> bytes:
-    """Walk parents until ``slot`` (pos-evolution.md:953, 1005, 1058)."""
+    """Walk parents until ``slot`` (pos-evolution.md:953, 1005, 1058).
+
+    A store initialized from a weak-subjectivity checkpoint (:1216) is
+    anchored mid-chain: history below the anchor does not exist in this
+    view. Asking for an ancestor older than the anchor answers with the
+    anchor itself — the deepest known ancestor — rather than crashing on
+    the missing parent (every known block descends from the anchor, so
+    checkpoint-descent checks against it remain correct). Genesis-anchored
+    stores never take this branch: the walk stops at slot 0 first."""
     root = bytes(root)
     block = store.blocks[root]
     while int(block.slot) > slot:
-        root = bytes(block.parent_root)
+        parent = bytes(block.parent_root)
+        if parent not in store.blocks:
+            return root
+        root = parent
         block = store.blocks[root]
     return root
 
@@ -119,10 +130,27 @@ def get_checkpoint_block(store: Store, root: bytes, epoch: int) -> bytes:
 
 # --- weights ------------------------------------------------------------------
 
+def justified_checkpoint_state(store: Store) -> BeaconState:
+    """The justified checkpoint's state, materialized on demand.
+
+    The cache is normally filled by ``on_attestation`` (whose targets led
+    justification there), but a checkpoint-synced store can have its
+    justified checkpoint advanced by BACKFILLED blocks before any
+    attestation targeting it arrives — compute and commit the state then,
+    exactly as ``compute_target_checkpoint_state`` would have."""
+    key = store.justified_checkpoint.as_key()
+    state = store.checkpoint_states.get(key)
+    if state is None:
+        state = compute_target_checkpoint_state(store,
+                                                store.justified_checkpoint)
+        store.checkpoint_states[key] = state
+    return state
+
+
 def get_proposer_boost(store: Store) -> int:
     """Boost fraction of one slot's committee weight W (pos-evolution.md:1355:
     W/4 mainline; the attack analyses use 0.7W/0.8W)."""
-    justified_state = store.checkpoint_states[store.justified_checkpoint.as_key()]
+    justified_state = justified_checkpoint_state(store)
     committee_weight = get_total_active_balance(justified_state) // cfg().slots_per_epoch
     return committee_weight * cfg().proposer_score_boost_percent // 100
 
@@ -132,7 +160,7 @@ def get_latest_attesting_balance(store: Store, root: bytes) -> int:
     skipping equivocators, plus proposer boost (pos-evolution.md:322, 916,
     1116, 1438)."""
     root = bytes(root)
-    state = store.checkpoint_states[store.justified_checkpoint.as_key()]
+    state = justified_checkpoint_state(store)
     block_slot = int(store.blocks[root].slot)
     reg = state.validators
     current_epoch = compute_epoch_at_slot(get_current_slot(store))

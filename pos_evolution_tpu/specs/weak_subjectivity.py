@@ -69,3 +69,20 @@ def is_within_weak_subjectivity_period(store, ws_state: BeaconState,
     ws_state_epoch = compute_epoch_at_slot(int(ws_state.slot))
     current_epoch = compute_epoch_at_slot(get_current_slot(store))
     return current_epoch <= ws_state_epoch + ws_period
+
+
+def checkpoint_for_state(ws_state: BeaconState):
+    """(state', checkpoint) pair satisfying the sync-gate contract for a
+    raw anchor state — the client-side half of checkpoint sync. A state
+    fresh off a transition has an EMPTY header state-root cache (it is
+    filled by the next ``process_slot``, pos-evolution.md's state-root
+    deferral); mimic that here (hash first, then fill) so the gate's
+    ``header.state_root == checkpoint.root`` assert (:1295) holds."""
+    from pos_evolution_tpu.ssz import hash_tree_root
+    if bytes(ws_state.latest_block_header.state_root) == b"\x00" * 32:
+        root = hash_tree_root(ws_state)
+        ws_state = ws_state.copy()
+        ws_state.latest_block_header.state_root = root
+    return ws_state, Checkpoint(
+        epoch=compute_epoch_at_slot(int(ws_state.slot)),
+        root=bytes(ws_state.latest_block_header.state_root))

@@ -1,4 +1,4 @@
-"""Auxiliary subsystems: snapshots, metrics/tracing, invariants."""
+"""Auxiliary subsystems: snapshots, metrics/tracing, invariants, watchdog."""
 
 from pos_evolution_tpu.utils.metrics import (
     HandlerTimer,
@@ -8,10 +8,13 @@ from pos_evolution_tpu.utils.metrics import (
 from pos_evolution_tpu.utils.snapshot import (
     load_anchor,
     load_dense,
+    load_simulation,
     load_store,
     resume_store,
     save_anchor,
     save_dense,
+    save_simulation,
     save_store,
     snapshot_head,
 )
+from pos_evolution_tpu.utils.watchdog import Watchdog, WatchdogTimeout

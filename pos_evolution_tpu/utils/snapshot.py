@@ -147,6 +147,127 @@ def load_store(data: bytes, pow_chain=None):
     )
 
 
+# --- whole-simulation snapshots (sim/driver.py checkpoint/resume) -------------
+
+# message kind -> SSZ payload class for queue/pool serialization
+def _payload_class(kind: str):
+    from pos_evolution_tpu.specs.containers import (
+        Attestation,
+        AttesterSlashing,
+        SignedBeaconBlock,
+    )
+    return {"block": SignedBeaconBlock, "attestation": Attestation,
+            "slashing": AttesterSlashing}[kind]
+
+
+def save_simulation(sim) -> bytes:
+    """Serialize a running ``sim.driver.Simulation`` so that ``resume``
+    continues it bit-identically: per group the full Store
+    (``save_store``), the pending message queue (times + arrival sequence
+    + SSZ payloads), the attestation pool, and the per-block inclusion
+    index; plus the slot cursor and recorded per-slot metrics.
+
+    Not serialized, by design: the Schedule/FaultPlan (callables — the
+    caller passes the same one to ``resume``; fault decisions are
+    stateless hashes so they replay identically), the PoW-chain view
+    (``load_store`` contract), and wall-clock handler timings."""
+    out = io.BytesIO()
+    meta = {
+        "version": 1,
+        "n_validators": sim.n_validators,
+        "genesis_time": sim.genesis_time,
+        "slot": sim.slot,
+        "accelerated": sim.accelerated_forkchoice,
+        "metrics": sim.metrics,
+        "archive_roots": [r.hex() for r in sim.block_archive],
+        "groups": [{
+            "id": g.id,
+            "seq": g._seq,
+            "queue": [[m.time, m.seq, m.kind] for m in sorted(g.queue)],
+            "n_pool": len(g.pool),
+            "block_atts": {r.hex(): [a.hex() for a in atts]
+                           for r, atts in g.block_atts.items()},
+            # resident mirror supervision state: a degradation must
+            # survive resume (the uninterrupted run answers from the host
+            # path after one; a resurrected device path would break the
+            # bit-identical contract in exactly the diverging case)
+            "resident": None if g.resident is None else {
+                "degraded": g.resident.degraded,
+                "incidents": list(g.resident.incidents),
+                "selfcheck_every": g.resident.selfcheck_every,
+                "head_queries": g.resident._head_queries,
+                "min_capacity": g.resident._min_capacity,
+            },
+        } for g in sim.groups],
+    }
+    _frame(out, json.dumps(meta).encode())
+    for sb in sim.block_archive.values():
+        _frame(out, serialize(sb))
+    for g in sim.groups:
+        _frame(out, save_store(g.store))
+        for m in sorted(g.queue):
+            _frame(out, serialize(m.payload))
+        for att in g.pool.values():
+            _frame(out, serialize(att))
+    return out.getvalue()
+
+
+def load_simulation(data: bytes, schedule=None):
+    """Rebuild a ``save_simulation`` checkpoint into a live Simulation.
+    ``schedule`` must be the run's original Schedule (with its FaultPlan)
+    for faithful replay; crash flags re-derive from the plan + slot."""
+    from pos_evolution_tpu.sim.driver import Simulation, _QueuedMessage
+    buf = io.BytesIO(data)
+    meta = json.loads(_unframe(buf).decode())
+    assert meta["version"] == 1, f"unknown snapshot version {meta['version']}"
+    # build the skeleton WITHOUT residents: __init__ would densify every
+    # genesis store only for the mirrors to be rebuilt from the restored
+    # stores below — at registry scale that doubles resume latency
+    sim = Simulation(meta["n_validators"], schedule=schedule,
+                     genesis_time=meta["genesis_time"],
+                     accelerated_forkchoice=False)
+    sim.accelerated_forkchoice = meta["accelerated"]
+    assert len(sim.groups) == len(meta["groups"]), \
+        "schedule shape does not match the checkpointed run"
+    sim.slot = meta["slot"]
+    sim.metrics = list(meta["metrics"])
+    sim.block_archive = {}
+    for root_hex in meta["archive_roots"]:
+        sb = deserialize(_unframe(buf), _payload_class("block"))
+        sim.block_archive[bytes.fromhex(root_hex)] = sb
+    plan = sim.schedule.faults
+    for g, gm in zip(sim.groups, meta["groups"]):
+        g.store = load_store(_unframe(buf), pow_chain=sim.pow_chain)
+        g._seq = gm["seq"]
+        g.queue = []
+        for time_, seq, kind in gm["queue"]:
+            payload = deserialize(_unframe(buf), _payload_class(kind))
+            g.queue.append(_QueuedMessage(time_, seq, kind, payload))
+        # entries were framed in sorted order, which is already heap order
+        g.pool = {}
+        for _ in range(gm["n_pool"]):
+            att = deserialize(_unframe(buf), _payload_class("attestation"))
+            g.pool[hash_tree_root(att)] = att
+        g.block_atts = {bytes.fromhex(r): [bytes.fromhex(a) for a in atts]
+                        for r, atts in gm["block_atts"].items()}
+        g.crashed = bool(plan.crashed(g.id, sim.slot)) if plan else False
+        if meta["accelerated"]:
+            from pos_evolution_tpu.ops.resident import ResidentForkChoice
+            rm = gm.get("resident") or {}
+            g.resident = ResidentForkChoice(
+                g.store,
+                capacity=rm.get("min_capacity", 64),
+                selfcheck_every=rm.get("selfcheck_every", 64))
+            # merge saved supervision state with anything the rebuild
+            # itself just recorded (a still-broken device stays degraded)
+            g.resident.degraded = g.resident.degraded or rm.get("degraded",
+                                                                False)
+            g.resident.incidents = (list(rm.get("incidents", []))
+                                    + g.resident.incidents)
+            g.resident._head_queries = rm.get("head_queries", 0)
+    return sim
+
+
 # --- dense-array host offload -------------------------------------------------
 
 def save_dense(path: str, registry) -> None:

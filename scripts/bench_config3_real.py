@@ -36,7 +36,8 @@ import numpy as np
 def run(aggregates: int = 2048, signers: int = 262_144,
         distinct_keys: int = 256, verbose: bool = True,
         preamble: str = "device", chunk: int = 0,
-        negctl_slice: int = 0) -> dict:
+        negctl_slice: int = 0, watchdog_path: str | None = None,
+        chunk_timeout: float = 0.0) -> dict:
     """``preamble='oracle'`` creates/hashes/decompresses points with the
     exact host oracle instead of the batched device kernels — on a
     single-core XLA:CPU box the limb ladders run ~3-4x slower than
@@ -45,7 +46,14 @@ def run(aggregates: int = 2048, signers: int = 262_144,
     is still what gets timed. On TPU leave the default. ``chunk`` splits
     the pairing batch (progress visibility + bounded memory);
     ``negctl_slice`` runs the swapped-signature control on a prefix
-    slice instead of the full batch."""
+    slice instead of the full batch.
+
+    Watchdog supervision (utils/watchdog.py): each pairing chunk runs as
+    a supervised step — its cumulative result is committed to
+    ``watchdog_path`` the moment the chunk lands, and a chunk that dies
+    (or exceeds ``chunk_timeout`` seconds) records an incident and the
+    run returns the PARTIAL result instead of losing everything (the
+    round-5 failure mode: an LLVM OOM on chunk 4 of 8 erased the run)."""
     import jax
     import jax.numpy as jnp
 
@@ -53,6 +61,13 @@ def run(aggregates: int = 2048, signers: int = 262_144,
     from pos_evolution_tpu.ops import fp
     from pos_evolution_tpu.ops import g2prep as gp
     from pos_evolution_tpu.ops.pairing import fast_aggregate_verify_batch
+    from pos_evolution_tpu.utils.watchdog import Watchdog
+
+    # direct construction, not from_env: run() is an API with explicit
+    # params, and a nested call from bench_all must not inherit the
+    # outer harness's POS_BENCH_PARTIAL path and clobber its file
+    wd = Watchdog(path=watchdog_path, tag="bench_config3_real",
+                  timeout_s=chunk_timeout or None)
 
     def log(msg):
         if verbose:
@@ -153,7 +168,8 @@ def run(aggregates: int = 2048, signers: int = 262_144,
     # 1) signature decompression
     if preamble == "device":
         t0 = time.perf_counter()
-        xl, sg, inf = gp.g2_compressed_to_limbs(sig_bytes)
+        xl, sg, inf, noncanon = gp.g2_compressed_to_limbs(sig_bytes)
+        assert not noncanon.any(), "non-canonical compressed signature encoding"
         sig_g2, sig_ok = gp.g2_decompress_batch(
             jnp.asarray(xl), jnp.asarray(sg))
         sig_g2 = jax.block_until_ready(sig_g2)
@@ -180,37 +196,86 @@ def run(aggregates: int = 2048, signers: int = 262_144,
             [g2_affine_encode(o.hash_to_g2(m)) for m in messages]))
         t_hash = time.perf_counter() - t0
 
-    # 3) the batched pairing — the device kernel under test, always
+    # 3) the batched pairing — the device kernel under test, always.
+    # Every chunk is a supervised watchdog step: completed chunks are
+    # committed on arrival, a dead/over-budget chunk records an incident
+    # and the run reports the partial result instead of dying.
     committees_j = jnp.asarray(committees)
     bits_j = jnp.asarray(bits)
     inf_j = jnp.asarray(inf)
     step = chunk if chunk else B
     verdicts = []
     t_pair = 0.0
-    for lo in range(0, B, step):
-        hi = min(lo + step, B)
+
+    def _pair_chunk(lo, hi):
+        """Returns JSON-small facts only (plain bool list, no numpy repr
+        in the committed file). The verdict rides the return value so a
+        chunk counts toward ``b_done`` if and ONLY if its step completed
+        — an append-from-inside would leak a half-done chunk into the
+        tally when the supervisor kills the step after the pairing but
+        before the return, or double-count under step retries."""
         t0 = time.perf_counter()
         v = fast_aggregate_verify_batch(
             pk_table, committees_j[lo:hi], bits_j[lo:hi],
             msg_g2[lo:hi], sig_g2[lo:hi], inf_j[lo:hi])
         v = np.asarray(jax.block_until_ready(v))
-        t_pair += time.perf_counter() - t0
-        verdicts.append(v)
+        return {"aggregates": int(hi - lo),
+                "seconds": time.perf_counter() - t0,
+                "verdicts": v.tolist()}
+
+    for lo in range(0, B, step):
+        hi = min(lo + step, B)
+        res = wd.step(f"pairing_chunk_{lo}_{hi}", _pair_chunk, lo, hi)
+        if res is None:
+            log(f"pairing chunk {lo}..{hi} DIED; keeping {lo} completed "
+                f"aggregates (incident recorded)")
+            break
+        verdicts.append(np.asarray(res["verdicts"], dtype=bool))
+        t_pair += res["seconds"]
+        # overwrite-commit the cumulative tally so a later kill -9 still
+        # leaves the progress on disk, not just the per-chunk verdicts
+        wd.completed["pairing_progress"] = {
+            "aggregates_done": hi, "pairing_s": round(t_pair, 3)}
+        wd.commit()
         if chunk:
             log(f"pairing chunk {lo}..{hi}: cumulative {t_pair:.1f}s")
-    verdict = np.concatenate(verdicts)
-    assert verdict.all(), "a valid aggregate failed to verify"
+    b_done = sum(v.shape[0] for v in verdicts)
+    partial = b_done < B
+    if b_done:
+        verdict = np.concatenate(verdicts)
+        assert verdict.all(), "a valid aggregate failed to verify"
 
     total = t_decomp + t_hash + t_pair
-    n_signed = int(bits.sum())
+    n_signed = int(bits[:b_done].sum())
     out.update({
         "sig_decompress_s": round(t_decomp, 3),
         "hash_to_g2_s": round(t_hash, 3),
         "pairing_s": round(t_pair, 3),
         "verify_total_s": round(total, 3),
+        "participating_signers": n_signed,
+    })
+    if partial:
+        out.update({
+            "partial": True,
+            "aggregates_completed": b_done,
+            "watchdog_incidents": wd.incidents,
+        })
+        if b_done:
+            # decomp/hash covered the FULL batch; prorate them to the
+            # completed fraction so partial rates stay comparable to
+            # complete rows instead of biasing low
+            frac = b_done / B
+            t_part = (t_decomp + t_hash) * frac + t_pair
+            out["rate_note"] = ("decomp/hash prorated to completed "
+                                "fraction for the rates")
+            out["aggregates_per_s"] = round(b_done / t_part, 1)
+            out["attestations_per_s"] = round(n_signed / t_part, 1)
+        log(f"PARTIAL verify: {b_done}/{B} aggregates in {total:.1f}s "
+            f"({len(wd.incidents)} incident(s) recorded)")
+        return out
+    out.update({
         "aggregates_per_s": round(B / total, 1),
         "attestations_per_s": round(n_signed / total, 1),
-        "participating_signers": n_signed,
     })
     log(f"verify: decomp {t_decomp:.1f}s + hash {t_hash:.1f}s + "
         f"pairing {t_pair:.1f}s = {total:.1f}s "
@@ -239,10 +304,19 @@ if __name__ == "__main__":
             return int(argv[argv.index(name) + 1])
         return default
 
+    default_partial = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "config3_real_partial.json")
     res = run(aggregates=_arg("--aggregates", 2048),
               signers=_arg("--signers", 262_144),
               preamble=("oracle" if "--preamble-oracle" in argv
                         else "device"),
               chunk=_arg("--chunk", 0),
-              negctl_slice=_arg("--negctl-slice", 0))
+              negctl_slice=_arg("--negctl-slice", 0),
+              watchdog_path=os.environ.get("POS_BENCH_PARTIAL",
+                                           default_partial),
+              chunk_timeout=float(_arg("--chunk-timeout", 0)))
+    # a watchdog-supervised chunk death returns a partial dict from run()
+    # (exit 0 through here); unsupervised setup-phase failures still
+    # raise, but the commit-on-arrival file has whatever completed
     print(json.dumps(res, indent=1))

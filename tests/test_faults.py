@@ -1,0 +1,290 @@
+"""Fault-injection layer tests (sim/faults.py + driver integration):
+message loss / duplication / reorder under partial synchrony, crash-restart
+view groups rejoining via weak-subjectivity checkpoint sync, and the
+bit-identical whole-simulation checkpoint/resume contract.
+
+The protocol claims under test are the reference's own: finalization under
+≤Δ-bounded faults with an honest supermajority resumes once the network
+stabilizes (ebb-and-flow, pos-evolution.md:1184-1190), and crashed
+validators rejoin through "checkpoints that act as new genesis" (:1216).
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config
+from pos_evolution_tpu.sim import (
+    CrashWindow,
+    FaultPlan,
+    Simulation,
+    chaos_plan,
+    faulty_schedule,
+    lossy_plan,
+)
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+def _gst_seconds(epochs: int) -> int:
+    c = minimal_config()
+    return epochs * c.slots_per_epoch * c.seconds_per_slot
+
+
+class TrackingSim(Simulation):
+    """Records attestations the fault layer dropped (single-group runs:
+    a drop means the attestation was delivered to NO ONE)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.dropped_atts = []
+
+    def _send(self, dst, base_time, delay, kind, payload, slot, src, msg_id):
+        n0 = len(dst.queue)
+        super()._send(dst, base_time, delay, kind, payload, slot, src, msg_id)
+        if (kind == "attestation" and delay is not None and not dst.crashed
+                and len(dst.queue) == n0):
+            self.dropped_atts.append(payload)
+
+
+class TestFaultPlanDecisions:
+    def test_stateless_and_seeded(self):
+        """The same message identity always draws the same fate — across
+        plan instances (what makes checkpoint/resume replay exact)."""
+        a = FaultPlan(seed=3, drop_p=0.5, duplicate_p=0.3, reorder_p=0.3)
+        b = FaultPlan(seed=3, drop_p=0.5, duplicate_p=0.3, reorder_p=0.3)
+        for slot in range(40):
+            key = ("attestation", slot, 0, slot % 4, 0, 0.0)
+            assert a.delivery_offsets(*key) == b.delivery_offsets(*key)
+        c = FaultPlan(seed=4, drop_p=0.5)
+        fates_a = [bool(a.delivery_offsets("block", s, 1, 0, 0, 0.0))
+                   for s in range(64)]
+        fates_c = [bool(c.delivery_offsets("block", s, 1, 0, 0, 0.0))
+                   for s in range(64)]
+        assert fates_a != fates_c, "seed must matter"
+
+    def test_probabilities_roughly_respected(self):
+        plan = FaultPlan(seed=11, drop_p=0.2)
+        n = 2000
+        drops = sum(not plan.delivery_offsets("block", s, 0, 0, 0, 0.0)
+                    for s in range(n))
+        assert 0.15 * n < drops < 0.25 * n
+
+    def test_gst_switches_faults_off(self):
+        plan = FaultPlan(seed=0, drop_p=1.0, gst=100.0)
+        assert plan.delivery_offsets("block", 1, 0, 0, 0, 99.0) == []
+        assert plan.delivery_offsets("block", 1, 0, 0, 0, 100.0) == [0.0]
+
+    def test_crash_windows_pure_function_of_slot(self):
+        plan = FaultPlan(crashes=(CrashWindow(2, 8, 16),))
+        assert not plan.crashed(2, 7)
+        assert plan.crashed(2, 8) and plan.crashed(2, 15)
+        assert not plan.crashed(2, 16) and plan.rejoins(2, 16)
+        assert not plan.crashed(1, 10)
+
+
+class TestMessageDropInvariants:
+    def test_finalization_resumes_after_gst(self):
+        """≤Δ-bounded faults + honest supermajority: heavy loss before
+        GST, then the chain must re-finalize (pos-evolution.md:1184-1190).
+        """
+        c = minimal_config()
+        plan = lossy_plan(seed=5, drop_p=0.35, gst=_gst_seconds(3))
+        sim = Simulation(64, schedule=faulty_schedule(64, plan))
+        sim.run_epochs(6)
+        # post-GST epochs finalize: by the end of epoch 6 the finalized
+        # checkpoint sits at least two epochs past GST
+        assert sim.finalized_epoch() >= 4
+        # and the head keeps advancing every slot after GST
+        post = [m for m in sim.metrics
+                if m["slot"] >= 3 * c.slots_per_epoch]
+        head_slots = [m["head_slot"] for m in post]
+        assert head_slots == sorted(head_slots)
+
+    def test_dropped_attestations_never_enter_latest_messages(self):
+        """A dropped attestation was delivered to no view: none of its
+        participants may carry a latest message for that epoch (each
+        validator attests exactly once per epoch in the duty loop), and
+        it must not have been packed into any block either."""
+        from pos_evolution_tpu.specs.helpers import get_indexed_attestation
+        from pos_evolution_tpu.ssz import hash_tree_root
+        plan = FaultPlan(seed=9, drop_p=0.15, record_log=True)
+        sim = TrackingSim(64, schedule=faulty_schedule(64, plan))
+        sim.run_epochs(3)
+        assert sim.dropped_atts, "fault plan should have dropped something"
+        assert plan.dropped("attestation"), "plan log should record drops"
+        store = sim.store()
+        onchain = set()
+        for atts in sim.groups[0].block_atts.values():
+            onchain.update(atts)
+        for att in sim.dropped_atts:
+            target_key = (int(att.data.target.epoch),
+                          bytes(att.data.target.root))
+            state = store.checkpoint_states.get(target_key)
+            if state is None:
+                continue
+            indexed = get_indexed_attestation(state, att)
+            epoch = int(att.data.target.epoch)
+            for v in np.asarray(indexed.attesting_indices):
+                m = store.latest_messages.get(int(v))
+                assert m is None or int(m.epoch) != epoch, \
+                    f"validator {v}'s dropped epoch-{epoch} vote landed"
+            assert hash_tree_root(att) not in onchain, \
+                "a dropped attestation was packed into a block"
+
+    def test_duplicates_and_reorders_are_harmless(self):
+        """Duplication and bounded reorder are semantically absorbed by
+        the handlers (latest-message semantics dedup): the run finalizes
+        on schedule like the honest run."""
+        plan = FaultPlan(seed=2, duplicate_p=0.3, reorder_p=0.3,
+                         reorder_max_delay=3.0)
+        sim = Simulation(64, schedule=faulty_schedule(64, plan))
+        sim.run_epochs(5)
+        ref = Simulation(64)
+        ref.run_epochs(5)
+        assert sim.finalized_epoch() >= ref.finalized_epoch() - 1
+        assert sim.finalized_epoch() >= 3
+
+
+class TestCrashRestart:
+    def test_crashed_group_rejoins_and_refinalizes(self):
+        """25% of validators (one of four view groups) crash, miss two
+        epochs, rejoin via weak-subjectivity checkpoint sync, and the
+        whole network — including the rejoined group — finalizes past the
+        outage; with 10% message loss on top until GST (the acceptance
+        scenario scaled to the fast tier; the @slow variant runs the full
+        64 epochs)."""
+        c = minimal_config()
+        spe = c.slots_per_epoch
+        # drops heal at epoch 2, the crash at epoch 5: by rejoin time the
+        # live 3/4 of the stake has justified real epochs, so the sync
+        # anchor is a post-genesis justified checkpoint
+        plan = FaultPlan(
+            seed=1, drop_p=0.10, gst=_gst_seconds(2),
+            crashes=(CrashWindow(group=3, crash_slot=3 * spe,
+                                 rejoin_slot=5 * spe),))
+        sim = Simulation(64, schedule=faulty_schedule(64, plan, n_groups=4))
+        sim.run_epochs(8)
+        # every group, including the rejoined one, finalized past the heal
+        for g in range(4):
+            assert sim.finalized_epoch(g) >= 5, f"group {g} stuck"
+        # the rejoined group's store was anchored at the sync checkpoint:
+        # history before it is gone (new-genesis sync), and the group
+        # kept following the chain afterwards (it did not freeze at the
+        # anchor — the head-snapshot-anchor failure mode)
+        g3 = sim.groups[3]
+        anchor_slot = min(int(b.slot) for b in g3.store.blocks.values())
+        assert anchor_slot >= spe, "rejoin kept pre-crash history"
+        head = sim._get_head(g3)
+        assert int(g3.store.blocks[head].slot) >= 7 * spe, \
+            "rejoined group froze at its sync anchor"
+
+    def test_rejoin_is_weak_subjectivity_gated(self):
+        """A rejoin whose checkpoint fails the WS gate must refuse to
+        sync (long-range defense, pos-evolution.md:1200)."""
+        import pos_evolution_tpu.sim.driver as drv
+        c = minimal_config()
+        plan = FaultPlan(crashes=(CrashWindow(1, c.slots_per_epoch,
+                                              2 * c.slots_per_epoch),))
+        sim = Simulation(64, schedule=faulty_schedule(64, plan, n_groups=2))
+        orig = drv.fc.on_tick
+
+        def stale_gate(store, time):  # age the rejoiner's clock instead
+            return orig(store, time)
+
+        from pos_evolution_tpu.specs import weak_subjectivity as ws
+        real = ws.is_within_weak_subjectivity_period
+        try:
+            ws.is_within_weak_subjectivity_period = \
+                lambda *a, **kw: False
+            with pytest.raises(RuntimeError, match="weak-subjectivity"):
+                sim.run_epochs(3)
+        finally:
+            ws.is_within_weak_subjectivity_period = real
+
+    @pytest.mark.slow
+    def test_acceptance_64_epochs_loss_plus_crash(self):
+        """The ISSUE acceptance scenario at full scale: a 64-epoch
+        minimal-config run with 10% message loss plus a crash-restart of
+        25% of validators (rejoining via checkpoint sync) re-finalizes
+        after the faults heal."""
+        c = minimal_config()
+        spe = c.slots_per_epoch
+        plan = FaultPlan(
+            seed=42, drop_p=0.10, gst=_gst_seconds(6),
+            crashes=(CrashWindow(group=3, crash_slot=2 * spe,
+                                 rejoin_slot=5 * spe),))
+        sim = Simulation(64, schedule=faulty_schedule(64, plan, n_groups=4))
+        sim.run_epochs(64)
+        for g in range(4):
+            assert sim.finalized_epoch(g) >= 62, f"group {g} stuck"
+
+
+class TestCheckpointResume:
+    def _plan(self):
+        c = minimal_config()
+        return FaultPlan(
+            seed=13, drop_p=0.12, duplicate_p=0.05, reorder_p=0.1,
+            gst=_gst_seconds(3),
+            crashes=(CrashWindow(group=1, crash_slot=c.slots_per_epoch,
+                                 rejoin_slot=2 * c.slots_per_epoch),))
+
+    def test_resume_reproduces_uninterrupted_metrics_exactly(self):
+        """Property: for every checkpoint slot k — including one inside
+        the crash window — resume(checkpoint at k) continues to produce
+        the uninterrupted run's per-slot metrics EXACTLY."""
+        c = minimal_config()
+        end_slot = 4 * c.slots_per_epoch
+        ref = Simulation(32, schedule=faulty_schedule(32, self._plan(),
+                                                      n_groups=2))
+        ref.run_until_slot(end_slot)
+        # k=11 is mid-crash for group 1; k=17 is just after rejoin
+        for k in (5, 11, 17, 25):
+            sim = Simulation(32, schedule=faulty_schedule(32, self._plan(),
+                                                          n_groups=2))
+            sim.run_until_slot(k)
+            data = sim.checkpoint()
+            resumed = Simulation.resume(
+                data, schedule=faulty_schedule(32, self._plan(), n_groups=2))
+            assert resumed.slot == k + 1
+            resumed.run_until_slot(end_slot)
+            assert resumed.metrics == ref.metrics, f"divergence from k={k}"
+
+    def test_resume_restores_queues_pools_and_stores(self):
+        sim = Simulation(32, schedule=faulty_schedule(32, self._plan(),
+                                                      n_groups=2))
+        sim.run_until_slot(9)
+        data = sim.checkpoint()
+        back = Simulation.resume(
+            data, schedule=faulty_schedule(32, self._plan(), n_groups=2))
+        for g0, g1 in zip(sim.groups, back.groups):
+            assert sorted((m.time, m.seq, m.kind) for m in g0.queue) == \
+                sorted((m.time, m.seq, m.kind) for m in g1.queue)
+            assert list(g0.pool.keys()) == list(g1.pool.keys())
+            assert g0.block_atts == g1.block_atts
+            assert g0.store.blocks.keys() == g1.store.blocks.keys()
+            assert g0.store.latest_messages == g1.store.latest_messages
+            assert g0.crashed == g1.crashed
+        assert back.metrics == sim.metrics
+
+    def test_resume_preserves_resident_degradation(self):
+        """A degraded device mirror must STAY degraded across resume —
+        resurrecting it would re-trust the device exactly in the case it
+        was caught diverging (and would drop the incident record)."""
+        pytest.importorskip("jax")
+        sim = Simulation(32, accelerated_forkchoice=True)
+        sim.run_until_slot(4)
+        sim.groups[0].resident._degrade("test-injected divergence")
+        back = Simulation.resume(sim.checkpoint())
+        assert back.groups[0].resident.degraded
+        assert back.groups[0].resident.incidents == \
+            ["test-injected divergence"]
+        back.run_until_slot(8)                 # keeps running on host path
+
+    def test_honest_run_resume_without_schedule(self):
+        ref = Simulation(32)
+        ref.run_until_slot(20)
+        sim = Simulation(32)
+        sim.run_until_slot(8)
+        back = Simulation.resume(sim.checkpoint())
+        back.run_until_slot(20)
+        assert back.metrics == ref.metrics

@@ -56,9 +56,10 @@ class TestDecompress:
     def test_g2_batch_matches_oracle(self):
         sigs = [o.g2_compress(o.ec_mul(o.hash_to_g2(bytes([i]) * 32), 5 + i))
                 for i in range(4)]
-        xl, sg, inf = gp.g2_compressed_to_limbs(
+        xl, sg, inf, bad = gp.g2_compressed_to_limbs(
             np.stack([np.frombuffer(s, np.uint8) for s in sigs]))
         assert not inf.any()
+        assert not bad.any()
         pts, ok = gp.g2_decompress_batch(jnp.asarray(xl), jnp.asarray(sg))
         assert np.asarray(ok).all()
         for i, s in enumerate(sigs):

@@ -1,0 +1,118 @@
+"""Watchdog tests (utils/watchdog.py): supervised steps, bounded retries,
+timeouts, and commit-on-arrival partial results surviving step death."""
+
+import json
+import time
+
+import pytest
+
+from pos_evolution_tpu.utils.watchdog import (
+    Watchdog,
+    WatchdogTimeout,
+    _call_with_timeout,
+)
+
+
+class TestSteps:
+    def test_success_records_and_returns(self, tmp_path):
+        p = str(tmp_path / "wd.json")
+        wd = Watchdog(path=p, tag="t")
+        assert wd.step("add", lambda a, b: a + b, 2, 3) == 5
+        on_disk = json.load(open(p))
+        assert on_disk["completed"]["add"] == 5
+        assert on_disk["incidents"] == []
+        assert on_disk["tag"] == "t"
+
+    def test_failure_records_incident_and_returns_default(self, tmp_path):
+        p = str(tmp_path / "wd.json")
+        wd = Watchdog(path=p)
+
+        def boom():
+            raise ValueError("kaput")
+
+        assert wd.step("bad", boom, default="fallback") == "fallback"
+        assert wd.failed("bad")
+        on_disk = json.load(open(p))
+        assert "bad" not in on_disk["completed"]
+        assert on_disk["incidents"][0]["step"] == "bad"
+        assert "kaput" in on_disk["incidents"][0]["error"]
+
+    def test_retries_with_backoff_then_succeeds(self):
+        wd = Watchdog(backoff_s=0.01)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("transient")
+            return "ok"
+
+        assert wd.step("flaky", flaky, retries=3) == "ok"
+        assert len(calls) == 3
+        assert len(wd.incidents) == 2          # the two failed attempts
+        assert not wd.failed("flaky")
+
+    def test_commit_on_arrival_survives_later_death(self, tmp_path):
+        """The round-5 failure mode: step N dies after steps 1..N-1
+        completed — their results must already be on disk."""
+        p = str(tmp_path / "wd.json")
+        wd = Watchdog(path=p)
+        wd.step("chunk_0", lambda: 11)
+        wd.step("chunk_1", lambda: 22)
+        with pytest.raises(KeyboardInterrupt):
+            # simulated kill: escapes step() entirely, no commit happens
+            wd.step("chunk_2", _raise_interrupt)
+        on_disk = json.load(open(p))
+        assert on_disk["completed"] == {"chunk_0": 11, "chunk_1": 22}
+
+    def test_atomic_commit_never_leaves_partial_file(self, tmp_path):
+        p = str(tmp_path / "wd.json")
+        wd = Watchdog(path=p)
+        for i in range(20):
+            wd.step(f"s{i}", lambda i=i: i)
+            json.load(open(p))                 # parseable after every commit
+
+
+def _raise_interrupt():
+    raise KeyboardInterrupt
+
+
+class TestTimeout:
+    def test_timeout_raises_and_is_recorded(self):
+        wd = Watchdog(timeout_s=0.2)
+        t0 = time.time()
+        out = wd.step("sleepy", time.sleep, 30, default="dead")
+        assert out == "dead"
+        assert time.time() - t0 < 5
+        assert "WatchdogTimeout" in wd.incidents[0]["error"]
+
+    def test_timeout_cleared_after_step(self):
+        wd = Watchdog(timeout_s=0.2)
+        wd.step("sleepy", time.sleep, 30)
+        # a later slow-but-under-budget step must not inherit the alarm
+        assert wd.step("fine", lambda: time.sleep(0.05) or "ok",
+                       timeout_s=10) == "ok"
+
+    def test_no_timeout_passthrough(self):
+        assert _call_with_timeout(lambda: 7, (), {}, None) == 7
+
+    def test_nested_watchdogs_defer_to_outer_timer(self):
+        """A nested Watchdog (bench_all's config3b step runs a script
+        with its own) must neither clobber the outer SIGALRM timer nor
+        swallow the outer timeout as an inner incident."""
+        outer = Watchdog(timeout_s=0.3)
+        inner = Watchdog(timeout_s=60)       # would mask outer if armed
+
+        def outer_step():
+            # inner step sleeps past the OUTER budget; the timeout must
+            # surface as the OUTER step's incident, not the inner's
+            return inner.step("inner", time.sleep, 30, default="inner-dead")
+
+        assert outer.step("outer", outer_step, default="outer-dead") == \
+            "outer-dead"
+        assert [i["step"] for i in outer.incidents] == ["outer"]
+        assert inner.incidents == []
+
+    def test_timeout_exception_type(self):
+        with pytest.raises(WatchdogTimeout):
+            _call_with_timeout(time.sleep, (30,), {}, 0.1)

@@ -89,3 +89,22 @@ class TestLongRangeAttack:
         state = sim.store().block_states[fc.get_head(sim.store())]
         epoch = get_latest_weak_subjectivity_checkpoint_epoch(state)
         assert 0 <= epoch <= int(state.finalized_checkpoint.epoch)
+
+    def test_checkpoint_for_state_satisfies_gate(self):
+        """checkpoint_for_state builds a (state, checkpoint) pair that
+        passes the sync gate for a raw head-anchor state — the driver's
+        crash-restart rejoin path (sim/driver._rejoin_group)."""
+        from pos_evolution_tpu.specs.weak_subjectivity import (
+            checkpoint_for_state,
+        )
+        from pos_evolution_tpu.utils.snapshot import (
+            load_anchor, resume_store, snapshot_head,
+        )
+        sim = Simulation(32)
+        sim.run_epochs(2)
+        snap = snapshot_head(sim.store())
+        store = resume_store(snap)
+        ws_state, ws_checkpoint = checkpoint_for_state(load_anchor(snap)[0])
+        # the pair satisfies both gate asserts and the period check
+        assert is_within_weak_subjectivity_period(store, ws_state,
+                                                  ws_checkpoint)
