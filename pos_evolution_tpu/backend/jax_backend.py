@@ -34,6 +34,14 @@ def segment_sum(values: np.ndarray, segment_ids: np.ndarray,
         jnp.asarray(values), jnp.asarray(segment_ids), num_segments=num_segments))
 
 
+def sync_update_verify(batch):
+    """Light-client update batch verification on device: the attestation
+    aggregation kernel over committee-lane pk states + the vectorized
+    merkle walk (bit-identical to numpy_backend.sync_update_verify)."""
+    from pos_evolution_tpu.ops.sync_verify import verify_batch_device
+    return verify_batch_device(batch)
+
+
 def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
     """Same contract as numpy_backend.subtree_weights (parent[i] < i)."""
     w = node_weight.astype(np.int64).copy()

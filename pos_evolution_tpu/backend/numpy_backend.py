@@ -55,6 +55,13 @@ def segment_sum(values: np.ndarray, segment_ids: np.ndarray, num_segments: int) 
     return out
 
 
+def sync_update_verify(batch):
+    """Light-client update batch verification (ops/sync_verify.py contract):
+    hashlib FakeBLS aggregate checks + NumPy merkle walks."""
+    from pos_evolution_tpu.ops.sync_verify import verify_batch_host
+    return verify_batch_host(batch)
+
+
 def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
     """Accumulate each node's weight into all ancestors.
 

@@ -1,0 +1,205 @@
+"""Batched light-client update verification on device (sync-protocol ops).
+
+A light client's per-update work is (a) one participation-weighted
+sync-aggregate verification and (b) two merkle-branch checks into the
+attested state. This module runs *batches* of updates through both checks:
+
+- signatures reuse the attestation pipeline (``precompute_pk_states`` +
+  ``aggregate_verify_batch``, ops/aggregation.py): one committee lane per
+  signer, XOR segment reduction, compare against the provided aggregates —
+  the fake-scheme analogue of the batched pairing check a BLS12-381
+  crypto-processor performs (arxiv 2201.07496);
+- participation counts/weights come from ``aggregate_bits_and_weights``;
+- merkle branches run as a vectorized device walk over the SHA-256 op
+  (``sha256_pair_words``): per level, select (sibling‖value) or
+  (value‖sibling) by the index bit across the whole batch — the device
+  analogue of ``ssz.merkle.is_valid_merkle_branch``.
+
+A pure-NumPy host path implements the identical contract behind the same
+``ExecutionBackend`` dispatch (``verify_sync_update_batch``); the two are
+bit-exact (tests/test_lightclient.py pins every output array).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from pos_evolution_tpu.crypto.bls import FakeBLS  # noqa: E402
+from pos_evolution_tpu.ops.aggregation import (  # noqa: E402
+    aggregate_bits_and_weights,
+    aggregate_verify_batch,
+    messages_to_words,
+    pack_signature_words,
+    precompute_pk_states,
+)
+from pos_evolution_tpu.ops.sha256 import sha256_pair_words  # noqa: E402
+from pos_evolution_tpu.ssz.hash import sha256_pairs  # noqa: E402
+
+__all__ = [
+    "SyncUpdateBatch",
+    "verify_sync_update_batch",
+    "verify_batch_host",
+    "verify_batch_device",
+    "merkle_roots_host",
+    "merkle_roots_device",
+]
+
+
+@dataclass
+class SyncUpdateBatch:
+    """Dense form of B light-client updates over S-lane sync committees.
+
+    Array-level only (no container types) so the same batch feeds either
+    backend. Branch groups with ``*_present == False`` still flow through
+    the hash walk (lanes are cheap); their verdicts are masked off.
+    """
+
+    pubkeys: np.ndarray       # (B, S, 48) u8 — committee pubkeys per update
+    bits: np.ndarray          # (B, S) bool  — participation bits
+    weights: np.ndarray       # (B, S) i64   — per-lane weight (1 = count)
+    messages: np.ndarray      # (B, 32) u8   — signing roots
+    signatures: np.ndarray    # (B, 96) u8   — aggregate signatures
+    fin_leaf: np.ndarray      # (B, 32) u8   — finalized header roots
+    fin_branch: np.ndarray    # (B, FD, 32) u8
+    fin_index: np.ndarray     # (B,) i64
+    fin_root: np.ndarray      # (B, 32) u8   — attested state roots
+    fin_present: np.ndarray   # (B,) bool
+    sc_leaf: np.ndarray       # (B, 32) u8   — next-sync-committee roots
+    sc_branch: np.ndarray     # (B, SD, 32) u8
+    sc_index: np.ndarray      # (B,) i64
+    sc_root: np.ndarray       # (B, 32) u8
+    sc_present: np.ndarray    # (B,) bool
+
+    @property
+    def size(self) -> int:
+        return self.bits.shape[0]
+
+
+def _words_to_rows(words) -> np.ndarray:
+    """(B, 8) u32 digest words -> (B, 32) u8 rows."""
+    w = np.asarray(words, dtype=np.uint32)
+    return w.astype(">u4").view(np.uint8).reshape(w.shape[0], 32)
+
+
+def _index_bits(index: np.ndarray, depth: int) -> np.ndarray:
+    """(B,) indices -> (B, depth) bool: bit d selects right-child at level d."""
+    idx = np.asarray(index, dtype=np.int64)
+    return ((idx[:, None] >> np.arange(depth, dtype=np.int64)[None, :]) & 1).astype(bool)
+
+
+# --- merkle walk: host / device ----------------------------------------------
+
+def merkle_roots_host(leaf: np.ndarray, branch: np.ndarray,
+                      index: np.ndarray) -> np.ndarray:
+    """Recompute the branch roots for a batch of proofs (NumPy path)."""
+    value = np.ascontiguousarray(leaf, dtype=np.uint8)
+    branch = np.asarray(branch, dtype=np.uint8)
+    bits = _index_bits(index, branch.shape[1])
+    for d in range(branch.shape[1]):
+        sib = branch[:, d]
+        right_child = bits[:, d][:, None]
+        left = np.where(right_child, sib, value)
+        right = np.where(right_child, value, sib)
+        value = sha256_pairs(np.ascontiguousarray(left), np.ascontiguousarray(right))
+    return value
+
+
+@jax.jit
+def _merkle_walk_device(leaf_words, branch_words, index_bits):
+    # scan over tree levels: one compiled compression pair regardless of
+    # depth (an unrolled level loop cost ~D× the compile time on XLA:CPU)
+    def level(value, xs):
+        sib, right_child = xs
+        left = jnp.where(right_child[:, None], sib, value)
+        right = jnp.where(right_child[:, None], value, sib)
+        return sha256_pair_words(left, right), None
+
+    xs = (jnp.swapaxes(branch_words, 0, 1), jnp.swapaxes(index_bits, 0, 1))
+    value, _ = jax.lax.scan(level, leaf_words, xs)
+    return value
+
+
+def merkle_roots_device(leaf: np.ndarray, branch: np.ndarray,
+                        index: np.ndarray) -> np.ndarray:
+    """Device counterpart of ``merkle_roots_host`` (bit-identical)."""
+    b = leaf.shape[0]
+    depth = branch.shape[1]
+    leaf_words = messages_to_words(np.ascontiguousarray(leaf, dtype=np.uint8))
+    branch_words = messages_to_words(
+        np.ascontiguousarray(branch, dtype=np.uint8).reshape(b * depth, 32)
+    ).reshape(b, depth, 8)
+    out = _merkle_walk_device(jnp.asarray(leaf_words), jnp.asarray(branch_words),
+                              jnp.asarray(_index_bits(index, depth)))
+    return _words_to_rows(out)
+
+
+# --- whole-batch verification -------------------------------------------------
+
+def _result(sig_ok, participation, weight, fin_root, fin_ok, sc_root, sc_ok) -> dict:
+    return {
+        "sig_ok": np.asarray(sig_ok, dtype=bool),
+        "participation": np.asarray(participation, dtype=np.int32),
+        "weight": np.asarray(weight, dtype=np.int64),
+        "fin_root": np.asarray(fin_root, dtype=np.uint8),
+        "fin_ok": np.asarray(fin_ok, dtype=bool),
+        "sc_root": np.asarray(sc_root, dtype=np.uint8),
+        "sc_ok": np.asarray(sc_ok, dtype=bool),
+    }
+
+
+def verify_batch_host(batch: SyncUpdateBatch) -> dict:
+    """NumPy/hashlib reference path (the oracle the device path must match)."""
+    b = batch.size
+    sig_ok = np.zeros(b, dtype=bool)
+    for i in range(b):
+        lanes = np.nonzero(batch.bits[i])[0]
+        pks = [batch.pubkeys[i, j].tobytes() for j in lanes]
+        sig_ok[i] = bool(pks) and FakeBLS.FastAggregateVerify(
+            pks, batch.messages[i].tobytes(), batch.signatures[i].tobytes())
+    participation = batch.bits.sum(axis=1, dtype=np.int32)
+    weight = np.where(batch.bits, batch.weights, 0).sum(axis=1, dtype=np.int64)
+    fin_root = merkle_roots_host(batch.fin_leaf, batch.fin_branch, batch.fin_index)
+    fin_ok = (fin_root == batch.fin_root).all(axis=1) & batch.fin_present
+    sc_root = merkle_roots_host(batch.sc_leaf, batch.sc_branch, batch.sc_index)
+    sc_ok = (sc_root == batch.sc_root).all(axis=1) & batch.sc_present
+    return _result(sig_ok, participation, weight, fin_root, fin_ok, sc_root, sc_ok)
+
+
+def verify_batch_device(batch: SyncUpdateBatch) -> dict:
+    """JAX/XLA path: committee lanes become their own pk-state table, so the
+    attestation kernel verifies sync aggregates unchanged."""
+    b, s = batch.bits.shape
+    pk_states = precompute_pk_states(
+        np.ascontiguousarray(batch.pubkeys, dtype=np.uint8).reshape(b * s, 48))
+    committees = np.arange(b * s, dtype=np.int32).reshape(b, s)
+    msg_words = messages_to_words(np.ascontiguousarray(batch.messages, dtype=np.uint8))
+    sig_words = pack_signature_words([batch.signatures[i].tobytes() for i in range(b)])
+    bits = jnp.asarray(batch.bits)
+    sig_ok = aggregate_verify_batch(pk_states, jnp.asarray(committees), bits,
+                                    jnp.asarray(msg_words), jnp.asarray(sig_words))
+    participation, weight = aggregate_bits_and_weights(
+        bits, jnp.asarray(batch.weights, dtype=jnp.int64))
+    fin_root = merkle_roots_device(batch.fin_leaf, batch.fin_branch, batch.fin_index)
+    fin_ok = (fin_root == batch.fin_root).all(axis=1) & batch.fin_present
+    sc_root = merkle_roots_device(batch.sc_leaf, batch.sc_branch, batch.sc_index)
+    sc_ok = (sc_root == batch.sc_root).all(axis=1) & batch.sc_present
+    return _result(np.asarray(sig_ok), np.asarray(participation), np.asarray(weight),
+                   fin_root, fin_ok, sc_root, sc_ok)
+
+
+def verify_sync_update_batch(batch: SyncUpdateBatch) -> dict:
+    """Verify a batch through the active ``ExecutionBackend``."""
+    from pos_evolution_tpu.backend import get_backend
+    backend = get_backend()
+    fn = getattr(backend, "sync_update_verify", None)
+    if fn is None:
+        return verify_batch_host(batch)
+    return fn(batch)

@@ -33,6 +33,7 @@ from pos_evolution_tpu.specs.validator import (
     advance_state_to_slot,
     build_block,
     make_committee_attestation,
+    make_sync_aggregate,
 )
 from pos_evolution_tpu.sim.schedule import Schedule, honest_schedule
 from pos_evolution_tpu.ssz import hash_tree_root
@@ -188,6 +189,12 @@ class Simulation:
         # get_head / on_block / on_attestation via utils.metrics.
         from pos_evolution_tpu.utils.metrics import HandlerTimer
         self.timer = HandlerTimer()
+        # Light clients following this simulation via sync-protocol updates
+        # (lightclient/): attached with ``attach_light_client``, served one
+        # update per slot from the serving group's head, subject to the
+        # run's FaultPlan. Not simulation state: a resumed run re-attaches.
+        self.light_clients: list = []
+        self._lc_group = 0
 
     def _get_head(self, group: ViewGroup) -> bytes:
         with self.timer.track("get_head"):
@@ -347,21 +354,35 @@ class Simulation:
             proposed.add(proposer)
             atts = self._pack_attestations(group, slot, head,
                                            head_state=head_state)
+            sync_agg = self._make_sync_aggregate(group, slot, head,
+                                                 head_state, round_index)
             try:
                 sb = build_block(group.store.block_states[head], slot,
-                                 attestations=atts)
+                                 attestations=atts, sync_aggregate=sync_agg)
             except AssertionError:
                 # Rare fault-era residue: an attestation that passed the
                 # cheap packing filter is still unincludable (e.g. a
                 # committee reshuffled across an epoch-crossing fork).
                 # A real proposer drops the op, not the proposal.
                 sb = build_block(group.store.block_states[head], slot,
-                                 attestations=[])
+                                 attestations=[], sync_aggregate=sync_agg)
             self.block_archive[hash_tree_root(sb.message)] = sb
             for dst in self.groups:
                 delay = self.schedule.block_delay(int(proposer), slot, dst.id)
                 self._send(dst, t0, delay, "block", sb, slot,
                            src=int(proposer), msg_id=0)
+
+    def _make_sync_aggregate(self, group: ViewGroup, slot: int, head: bytes,
+                             head_state, round_index: int):
+        """Sync-committee duty at proposal time: the committee members the
+        proposer's view group can reach — honest and awake this round —
+        sign the head the block builds on (pos-evolution.md:548-557)."""
+        honest = self.schedule.honest_members(group.id)
+        participants = set(int(v) for v in honest
+                           if self.schedule.awake(round_index, int(v)))
+        if not participants:
+            return None
+        return make_sync_aggregate(head_state, head, participants=participants)
 
     def _includable(self, state, att) -> bool:
         """Cheap op-pool validity filter mirroring process_attestation's
@@ -472,6 +493,7 @@ class Simulation:
             self._attest(slot)
             self._tick_all(t0 + 2 * self.delta)
         self._record_metrics(slot)
+        self._serve_light_clients(slot)
         self.slot += 1
 
     def run_until_slot(self, slot: int) -> None:
@@ -494,6 +516,94 @@ class Simulation:
             "n_blocks": len(g0.blocks),
             "equivocators": len(g0.equivocating_indices),
         })
+
+    # -- light clients (lightclient/) ------------------------------------------
+
+    def attach_light_client(self, group: int = 0):
+        """Bootstrap a ``LightClientNode`` from ``group``'s finalized
+        (weak-subjectivity) checkpoint and register it for per-slot update
+        serving. The serving group is fixed to the first attach."""
+        from pos_evolution_tpu.lightclient import (
+            LightClientNode,
+            bootstrap_from_store,
+        )
+        g = self.groups[group]
+        assert not g.crashed, "cannot bootstrap from a crashed group"
+        assert not self.light_clients or group == self._lc_group, \
+            "light clients are all served from one group; re-attach uses " \
+            f"group {self._lc_group}"
+        trusted_root, bootstrap = bootstrap_from_store(g.store)
+        state = g.store.block_states[bytes(g.store.finalized_checkpoint.root)]
+        node = LightClientNode.from_bootstrap(
+            trusted_root, bootstrap,
+            fork_version=bytes(state.fork.current_version),
+            genesis_validators_root=bytes(state.genesis_validators_root),
+            node_id=len(self.light_clients))
+        self._lc_group = group
+        self.light_clients.append(node)
+        return node
+
+    def _serve_light_clients(self, slot: int) -> None:
+        """End-of-slot update serving: derive the best update from the
+        serving group's head and offer it to every attached client, routed
+        through the FaultPlan (a dropped update is simply never seen — the
+        client survives on the force-update path)."""
+        if not self.light_clients:
+            return
+        group = self.groups[self._lc_group]
+        # A crashed server stops SERVING, but the clients are independent
+        # processes: their force-update timeout still ticks and their lag
+        # is measured against the server's frozen view.
+        head = self._get_head(group)
+        update = None
+        if not group.crashed:
+            from pos_evolution_tpu.lightclient import build_update
+            update = build_update(group.store, head,
+                                  archive=self.block_archive)
+        full_head_slot = int(group.store.blocks[head].slot)
+        full_finalized_epoch = int(group.store.finalized_checkpoint.epoch)
+        plan = self.schedule.faults
+        t = self.slot_start(slot)
+        for node in self.light_clients:
+            if update is not None:
+                delivered = (plan is None
+                             or plan.delivery_offsets("lc_update", slot,
+                                                      self._lc_group, 0,
+                                                      1_000_000 + node.id, t))
+                if delivered:
+                    node.on_update(update, current_slot=slot)
+            node.advance(slot, full_head_slot, full_finalized_epoch)
+
+    def flush_light_clients(self) -> None:
+        """Serve one off-chain finality update for the serving group's
+        CURRENT head: the sync committee's signatures over the head exist
+        before any block includes them (real networks gossip them as
+        FinalityUpdates), so attached clients converge to the full node's
+        exact finalized head instead of trailing one inclusion round."""
+        if not self.light_clients:
+            return
+        group = self.groups[self._lc_group]
+        if group.crashed:
+            return
+        from pos_evolution_tpu.lightclient import build_head_update
+        head = self._get_head(group)
+        head_state = group.store.block_states[head]
+        signature_slot = int(group.store.blocks[head].slot) + 1
+        signing_state = advance_state_to_slot(head_state, signature_slot)
+        round_index = signature_slot * self.cfg.intervals_per_slot
+        aggregate = self._make_sync_aggregate(group, signature_slot, head,
+                                              signing_state, round_index)
+        if aggregate is None:
+            return
+        update = build_head_update(group.store, head, aggregate,
+                                   signature_slot, archive=self.block_archive)
+        if update is None:
+            return
+        full_head_slot = int(group.store.blocks[head].slot)
+        full_finalized_epoch = int(group.store.finalized_checkpoint.epoch)
+        for node in self.light_clients:
+            node.on_update(update, current_slot=signature_slot)
+            node.advance(signature_slot, full_head_slot, full_finalized_epoch)
 
     # -- whole-simulation checkpoint / resume ----------------------------------
     def checkpoint(self) -> bytes:

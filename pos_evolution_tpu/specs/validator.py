@@ -14,6 +14,7 @@ import numpy as np
 from pos_evolution_tpu.config import (
     DOMAIN_BEACON_ATTESTER,
     DOMAIN_RANDAO,
+    DOMAIN_SYNC_COMMITTEE,
     cfg,
 )
 from pos_evolution_tpu.crypto.bls import bls
@@ -25,6 +26,7 @@ from pos_evolution_tpu.specs.containers import (
     BeaconState,
     Checkpoint,
     SignedBeaconBlock,
+    SyncAggregate,
 )
 from pos_evolution_tpu.specs.genesis import validator_secret_key
 from pos_evolution_tpu.specs.helpers import (
@@ -80,10 +82,46 @@ def sign_block(state: BeaconState, block: BeaconBlock) -> SignedBeaconBlock:
     return SignedBeaconBlock(message=block, signature=bls.Sign(sk, signing_root))
 
 
+def make_sync_aggregate(state: BeaconState, block_root: bytes,
+                        participants=None) -> SyncAggregate:
+    """Sync-committee duty (pos-evolution.md:548-557): current committee
+    members sign the head ``block_root`` for inclusion in the next block.
+
+    ``state`` must be advanced to the including block's slot, so the signed
+    root is what ``process_sync_aggregate`` reconstructs (the block root at
+    the previous slot — the proposal's parent). ``participants`` restricts
+    signing to a validator-index subset (sleepy/corrupted members abstain);
+    None signs with the full committee. Bits are container-width (the
+    mainnet 512 limit) with one lane per committee pubkey.
+    """
+    previous_slot = max(int(state.slot), 1) - 1
+    domain = get_domain(state, DOMAIN_SYNC_COMMITTEE,
+                        compute_epoch_at_slot(previous_slot))
+    from pos_evolution_tpu.specs.transition import compute_signing_root_bytes
+    signing_root = compute_signing_root_bytes(bytes(block_root), domain)
+    width = SyncAggregate._fields["sync_committee_bits"].length
+    bits = np.zeros(width, dtype=bool)
+    participant_set = (set(int(v) for v in participants)
+                       if participants is not None else None)
+    sigs = []
+    for lane, pubkey in enumerate(state.current_sync_committee.pubkeys):
+        index = state.validators.find_pubkey(bytes(pubkey))
+        if index is None:
+            continue
+        if participant_set is not None and index not in participant_set:
+            continue
+        bits[lane] = True
+        sigs.append(bls.Sign(validator_secret_key(index), signing_root))
+    if not sigs:
+        return SyncAggregate()
+    return SyncAggregate(sync_committee_bits=bits,
+                         sync_committee_signature=bls.Aggregate(sigs))
+
+
 def build_block(parent_state: BeaconState, slot: int, attestations=(),
                 attester_slashings=(), deposits=(), voluntary_exits=(),
                 graffiti: bytes = b"\x00" * 32,
-                execution_payload=None) -> SignedBeaconBlock:
+                execution_payload=None, sync_aggregate=None) -> SignedBeaconBlock:
     """Produce a valid signed block for ``slot`` on top of ``parent_state``.
 
     Follows the proposer duty of pos-evolution.md:597: run the state forward,
@@ -109,6 +147,8 @@ def build_block(parent_state: BeaconState, slot: int, attestations=(),
     )
     if execution_payload is not None:
         body.execution_payload = execution_payload
+    if sync_aggregate is not None:
+        body.sync_aggregate = sync_aggregate
     block = BeaconBlock(
         slot=slot,
         proposer_index=proposer_index,

@@ -503,10 +503,25 @@ class Container(metaclass=ContainerMeta):
         return cls(**values)
 
     @classmethod
-    def htr(cls, value: "Container") -> bytes:
+    def _field_chunks(cls, value: "Container") -> np.ndarray:
+        """Zero-copy (n_fields, 32) view over the per-field chunk roots."""
         roots = b"".join(_sedes_of(s).htr(getattr(value, f)) for f, s in cls._fields.items())
-        arr = np.frombuffer(roots, dtype=np.uint8).reshape(-1, 32)
-        return merkleize_chunks(arr)
+        return np.frombuffer(roots, dtype=np.uint8).reshape(-1, 32)
+
+    @classmethod
+    def field_roots(cls, value: "Container") -> np.ndarray:
+        """(n_fields, 32) per-field chunk roots — the leaves of ``htr``.
+
+        Exposed so merkle *proofs into a container's field tree* (light-client
+        finality / sync-committee branches) can be built from the same chunks
+        the root hashes over. Returns a writable copy; ``htr`` itself stays
+        on the zero-copy view (it is the hottest path in the codebase).
+        """
+        return cls._field_chunks(value).copy()
+
+    @classmethod
+    def htr(cls, value: "Container") -> bytes:
+        return merkleize_chunks(cls._field_chunks(value))
 
     @classmethod
     def default(cls) -> "Container":

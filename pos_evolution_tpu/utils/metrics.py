@@ -80,6 +80,32 @@ def slot_record(store, slot: int) -> dict:
     }
 
 
+def light_client_lag_record(lc_store, slot: int, full_head_slot: int,
+                            full_finalized_epoch: int) -> dict:
+    """Per-slot lag of a light client behind the full node it follows:
+    ``head_lag`` in slots (full head vs optimistic header) and
+    ``finality_lag`` in epochs (full finalized epoch vs the epoch of the
+    client's finalized header). The structured complement of ``slot_record``
+    for the thin-client side of the sync protocol."""
+    from pos_evolution_tpu.config import cfg
+    spe = cfg().slots_per_epoch
+    head_slot = int(lc_store.optimistic_header.slot)
+    # A checkpoint's block can sit BEFORE its epoch boundary (skipped
+    # boundary slot), so round the block slot UP to the epoch it anchors —
+    # floor division would report a phantom one-epoch lag. Force-updated
+    # headers are arbitrary mid-epoch attested headers, for which the
+    # rounding over-credits by at most one epoch; clamp at zero so the lag
+    # never goes negative in exactly those lossy scenarios.
+    finalized_epoch = (int(lc_store.finalized_header.slot) + spe - 1) // spe
+    return {
+        "slot": int(slot),
+        "lc_head_slot": head_slot,
+        "lc_finalized_slot": int(lc_store.finalized_header.slot),
+        "head_lag": int(full_head_slot) - head_slot,
+        "finality_lag": max(int(full_finalized_epoch) - finalized_epoch, 0),
+    }
+
+
 class StoreInvariantChecker:
     """Wraps fork-choice handlers; on handler exception, verifies the store
     is unchanged (pos-evolution.md:1041) and re-raises."""

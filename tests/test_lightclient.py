@@ -1,0 +1,450 @@
+"""Sync-committee & light-client subsystem tests (lightclient/ +
+ops/sync_verify.py + driver integration).
+
+Covers the acceptance contract of the subsystem: a light client
+bootstrapped from a weak-subjectivity checkpoint follows a 64+-slot faulted
+simulation to the same finalized head as a full node, and the
+``ops/sync_verify`` device path is bit-identical to the NumPy host path on
+every output array.
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config
+from pos_evolution_tpu.ssz import hash_tree_root, is_valid_merkle_branch, merkleize_chunks
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+def _branch_list(branch) -> list:
+    return [branch[i].tobytes() for i in range(branch.shape[0])]
+
+
+# ---------------------------------------------------------------------------
+# Proof layer: branches into the BeaconState field tree
+# ---------------------------------------------------------------------------
+
+class TestProofs:
+    def test_state_field_roots_merkleize_to_state_root(self):
+        from pos_evolution_tpu.lightclient import state_field_roots
+        from pos_evolution_tpu.specs.genesis import make_genesis_state
+        state = make_genesis_state(16)
+        chunks = state_field_roots(state)
+        assert merkleize_chunks(chunks) == hash_tree_root(state)
+
+    def test_sync_committee_branches_verify(self):
+        from pos_evolution_tpu.lightclient import (
+            CURRENT_SYNC_COMMITTEE_INDEX,
+            NEXT_SYNC_COMMITTEE_INDEX,
+            STATE_TREE_DEPTH,
+            current_sync_committee_branch,
+            next_sync_committee_branch,
+        )
+        from pos_evolution_tpu.specs.genesis import make_genesis_state
+        state = make_genesis_state(16)
+        # genesis seeds both committees identically; distinguish them so the
+        # wrong-index negative check below is meaningful
+        state.next_sync_committee.aggregate_pubkey = b"\x11" * 48
+        root = hash_tree_root(state)
+        cur = current_sync_committee_branch(state)
+        assert is_valid_merkle_branch(
+            hash_tree_root(state.current_sync_committee), _branch_list(cur),
+            STATE_TREE_DEPTH, CURRENT_SYNC_COMMITTEE_INDEX, root)
+        nxt = next_sync_committee_branch(state)
+        assert is_valid_merkle_branch(
+            hash_tree_root(state.next_sync_committee), _branch_list(nxt),
+            STATE_TREE_DEPTH, NEXT_SYNC_COMMITTEE_INDEX, root)
+        # a branch for the wrong field index must not verify
+        assert not is_valid_merkle_branch(
+            hash_tree_root(state.current_sync_committee), _branch_list(cur),
+            STATE_TREE_DEPTH, NEXT_SYNC_COMMITTEE_INDEX, root)
+
+    def test_finality_branch_verifies(self):
+        from pos_evolution_tpu.lightclient import (
+            FINALIZED_ROOT_DEPTH,
+            FINALIZED_ROOT_INDEX,
+            finality_branch,
+        )
+        from pos_evolution_tpu.specs.genesis import make_genesis_state
+        state = make_genesis_state(16)
+        state.finalized_checkpoint.epoch = 3
+        state.finalized_checkpoint.root = b"\x42" * 32
+        branch = finality_branch(state)
+        assert is_valid_merkle_branch(
+            b"\x42" * 32, _branch_list(branch),
+            FINALIZED_ROOT_DEPTH, FINALIZED_ROOT_INDEX, hash_tree_root(state))
+        # leaf is the checkpoint ROOT, not its epoch
+        assert not is_valid_merkle_branch(
+            (3).to_bytes(32, "little"), _branch_list(branch),
+            FINALIZED_ROOT_DEPTH, FINALIZED_ROOT_INDEX, hash_tree_root(state))
+
+    def test_header_for_block_matches_block_root(self):
+        from pos_evolution_tpu.lightclient import header_for_block
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.transition import state_transition
+        from pos_evolution_tpu.specs.validator import build_block
+        state, anchor = make_genesis(16)
+        assert hash_tree_root(header_for_block(anchor)) == hash_tree_root(anchor)
+        sb = build_block(state, 1)
+        state_transition(state, sb, True)
+        assert hash_tree_root(header_for_block(sb.message)) == \
+            hash_tree_root(sb.message)
+
+
+# ---------------------------------------------------------------------------
+# Sync-aggregate duty (specs/validator.make_sync_aggregate)
+# ---------------------------------------------------------------------------
+
+class TestSyncAggregateDuty:
+    def test_full_participation_block_passes_transition(self):
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.transition import state_transition
+        from pos_evolution_tpu.specs.validator import (
+            advance_state_to_slot,
+            build_block,
+            make_sync_aggregate,
+        )
+        state, anchor = make_genesis(16)
+        head = hash_tree_root(anchor)
+        agg = make_sync_aggregate(advance_state_to_slot(state, 1), head)
+        assert np.asarray(agg.sync_committee_bits, dtype=bool).any()
+        sb = build_block(state, 1, sync_aggregate=agg)
+        state_transition(state, sb, True)  # signature verified in-transition
+        assert np.array_equal(
+            np.asarray(sb.message.body.sync_aggregate.sync_committee_bits),
+            np.asarray(agg.sync_committee_bits))
+
+    def test_participant_subset_limits_bits(self):
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import (
+            advance_state_to_slot,
+            make_sync_aggregate,
+        )
+        state, anchor = make_genesis(16)
+        head = hash_tree_root(anchor)
+        advanced = advance_state_to_slot(state, 1)
+        full = make_sync_aggregate(advanced, head)
+        half = make_sync_aggregate(advanced, head, participants=range(8))
+        n_full = int(np.asarray(full.sync_committee_bits, dtype=bool).sum())
+        n_half = int(np.asarray(half.sync_committee_bits, dtype=bool).sum())
+        assert 0 < n_half < n_full
+
+    def test_empty_participants_gives_empty_aggregate(self):
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import (
+            advance_state_to_slot,
+            make_sync_aggregate,
+        )
+        state, anchor = make_genesis(16)
+        agg = make_sync_aggregate(advance_state_to_slot(state, 1),
+                                  hash_tree_root(anchor), participants=())
+        assert not np.asarray(agg.sync_committee_bits, dtype=bool).any()
+
+
+# ---------------------------------------------------------------------------
+# Bootstrap + store state machine
+# ---------------------------------------------------------------------------
+
+class TestBootstrapAndStore:
+    def _sim(self, epochs=0):
+        from pos_evolution_tpu.sim import Simulation
+        sim = Simulation(64)
+        if epochs:
+            sim.run_epochs(epochs)
+        return sim
+
+    def test_bootstrap_initializes_store(self):
+        from pos_evolution_tpu.lightclient import (
+            bootstrap_from_store,
+            initialize_light_client_store,
+        )
+        sim = self._sim()
+        trusted_root, bootstrap = bootstrap_from_store(sim.store(0))
+        state = sim.genesis_state
+        store = initialize_light_client_store(
+            trusted_root, bootstrap, bytes(state.fork.current_version),
+            bytes(state.genesis_validators_root))
+        assert hash_tree_root(store.finalized_header) == trusted_root
+        assert store.next_sync_committee is None
+
+    def test_bootstrap_rejects_tampered_committee_proof(self):
+        from pos_evolution_tpu.lightclient import (
+            bootstrap_from_store,
+            initialize_light_client_store,
+        )
+        sim = self._sim()
+        trusted_root, bootstrap = bootstrap_from_store(sim.store(0))
+        bootstrap.current_sync_committee_branch[0, 0] ^= 1
+        state = sim.genesis_state
+        with pytest.raises(AssertionError):
+            initialize_light_client_store(
+                trusted_root, bootstrap, bytes(state.fork.current_version),
+                bytes(state.genesis_validators_root))
+
+    def test_update_validation_rejects_tampering(self):
+        from pos_evolution_tpu.lightclient import build_update, validate_light_client_update
+        sim = self._sim()
+        node = sim.attach_light_client()
+        sim.run_epochs(4)
+        g = sim.groups[0]
+        update = build_update(g.store, sim._get_head(g), archive=sim.block_archive)
+        assert update is not None
+        current_slot = sim.slot
+        validate_light_client_update(node.store, update, current_slot)
+        # future update
+        with pytest.raises(AssertionError):
+            validate_light_client_update(node.store, update,
+                                         int(update.signature_slot) - 1)
+        # corrupted aggregate signature
+        bad = update.copy()
+        sig = bytearray(bytes(bad.sync_aggregate.sync_committee_signature))
+        sig[0] ^= 0xFF
+        bad.sync_aggregate.sync_committee_signature = bytes(sig)
+        with pytest.raises(AssertionError):
+            validate_light_client_update(node.store, bad, current_slot)
+        # corrupted finality branch
+        bad2 = update.copy()
+        bad2.finality_branch[1, 0] ^= 1
+        with pytest.raises(AssertionError):
+            validate_light_client_update(node.store, bad2, current_slot)
+
+    def test_force_update_after_timeout(self):
+        """Liveness escape hatch: with every finality proof stripped, the
+        best-seen valid update force-applies after one sync-committee
+        period without finality."""
+        from pos_evolution_tpu.lightclient import (
+            LightClientUpdate,
+            bootstrap_from_store,
+            build_update,
+            initialize_light_client_store,
+            is_finality_update,
+            process_light_client_store_force_update,
+            process_light_client_update,
+            update_timeout_slots,
+        )
+        sim = self._sim(epochs=4)
+        genesis = sim.genesis_state
+        trusted_root, bootstrap = bootstrap_from_store(sim.store(0))
+        store = initialize_light_client_store(
+            trusted_root, bootstrap, bytes(genesis.fork.current_version),
+            bytes(genesis.genesis_validators_root))
+        base = int(store.finalized_header.slot)
+        g = sim.groups[0]
+        update = build_update(g.store, sim._get_head(g), archive=sim.block_archive)
+        stripped = LightClientUpdate(
+            attested_header=update.attested_header,
+            next_sync_committee=update.next_sync_committee,
+            next_sync_committee_branch=update.next_sync_committee_branch,
+            sync_aggregate=update.sync_aggregate,
+            signature_slot=int(update.signature_slot),
+        )
+        assert not is_finality_update(stripped)
+        process_light_client_update(store, stripped, current_slot=sim.slot)
+        assert store.best_valid_update is not None
+        assert int(store.finalized_header.slot) == base  # no finality proof
+        # before the timeout nothing happens; after it, force-apply
+        process_light_client_store_force_update(store, base + update_timeout_slots())
+        assert int(store.finalized_header.slot) == base
+        process_light_client_store_force_update(
+            store, base + update_timeout_slots() + 1)
+        assert int(store.finalized_header.slot) == \
+            int(stripped.attested_header.beacon.slot)
+        assert store.best_valid_update is None
+
+
+# ---------------------------------------------------------------------------
+# ops/sync_verify: device path bit-identical to the host path
+# ---------------------------------------------------------------------------
+
+class TestOpsParity:
+    def _collect_updates(self, slots=16):
+        from pos_evolution_tpu.lightclient import build_update
+        from pos_evolution_tpu.sim import Simulation
+        sim = Simulation(64)
+        updates = []
+        for _ in range(slots):
+            sim.run_slot()
+            g = sim.groups[0]
+            u = build_update(g.store, sim._get_head(g), archive=sim.block_archive)
+            if u is not None:
+                updates.append(u)
+        return sim, updates
+
+    def test_device_and_host_bit_identical(self):
+        from pos_evolution_tpu.lightclient import updates_to_batch
+        from pos_evolution_tpu.ops.sync_verify import (
+            verify_batch_device,
+            verify_batch_host,
+        )
+        sim, updates = self._collect_updates()
+        assert len(updates) >= 8
+        genesis = sim.genesis_state
+        committees = [genesis.current_sync_committee] * len(updates)
+        batch = updates_to_batch(
+            updates, committees, bytes(genesis.fork.current_version),
+            bytes(genesis.genesis_validators_root))
+        # corrupt one signature and one branch so False verdicts are
+        # exercised on both paths too
+        batch.signatures[1, 0] ^= 0xFF
+        if batch.fin_present.any():
+            i = int(np.nonzero(batch.fin_present)[0][0])
+            batch.fin_branch[i, 0, 0] ^= 1
+        host = verify_batch_host(batch)
+        dev = verify_batch_device(batch)
+        assert set(host) == set(dev)
+        for key in host:
+            assert host[key].dtype == dev[key].dtype, key
+            assert np.array_equal(host[key], dev[key]), key
+        # sanity on the verdicts themselves
+        assert not host["sig_ok"][1] and host["sig_ok"][0]
+        assert (host["participation"][host["sig_ok"]] > 0).all()
+
+    def test_backend_dispatch_routes_to_device(self):
+        from pos_evolution_tpu.backend import set_backend
+        from pos_evolution_tpu.lightclient import updates_to_batch
+        from pos_evolution_tpu.ops.sync_verify import verify_sync_update_batch
+        sim, updates = self._collect_updates(slots=6)
+        genesis = sim.genesis_state
+        committees = [genesis.current_sync_committee] * len(updates)
+        batch = updates_to_batch(
+            updates, committees, bytes(genesis.fork.current_version),
+            bytes(genesis.genesis_validators_root))
+        try:
+            set_backend("numpy")
+            host = verify_sync_update_batch(batch)
+            set_backend("jax")
+            dev = verify_sync_update_batch(batch)
+        finally:
+            set_backend("numpy")
+        for key in host:
+            assert np.array_equal(host[key], dev[key]), key
+        assert host["sig_ok"].all()
+
+    def test_weighted_participation(self):
+        """Stake weighting: per-lane weights flow into the weight output."""
+        from pos_evolution_tpu.lightclient import updates_to_batch
+        from pos_evolution_tpu.ops.sync_verify import (
+            verify_batch_device,
+            verify_batch_host,
+        )
+        sim, updates = self._collect_updates(slots=4)
+        genesis = sim.genesis_state
+        committees = [genesis.current_sync_committee] * len(updates)
+        lanes = len(genesis.current_sync_committee.pubkeys)
+        weights = np.arange(1, lanes + 1, dtype=np.int64)[None, :].repeat(
+            len(updates), axis=0)
+        batch = updates_to_batch(
+            updates, committees, bytes(genesis.fork.current_version),
+            bytes(genesis.genesis_validators_root), weights=weights)
+        host = verify_batch_host(batch)
+        dev = verify_batch_device(batch)
+        assert np.array_equal(host["weight"], dev["weight"])
+        full = int(np.arange(1, lanes + 1, dtype=np.int64).sum())
+        assert (host["weight"] <= full).all() and (host["weight"] > 0).all()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: checkpoint-synced light client follows a faulted simulation
+# ---------------------------------------------------------------------------
+
+class TestAcceptanceE2E:
+    def test_light_client_follows_faulted_chain_to_full_node_finality(self):
+        """64+-slot faulted run: drops before GST, a sync-committee period
+        boundary crossing (minimal period = 64 slots), then exact
+        convergence with the full node's finalized head."""
+        from pos_evolution_tpu.sim import Simulation, faulty_schedule, lossy_plan
+        c = minimal_config()
+        gst = 7 * c.slots_per_epoch * c.seconds_per_slot
+        plan = lossy_plan(seed=7, drop_p=0.10, gst=gst)
+        sim = Simulation(64, schedule=faulty_schedule(64, plan))
+        node = sim.attach_light_client()
+        sim.run_until_slot(9 * c.slots_per_epoch)  # 72 slots > 64
+        sim.flush_light_clients()
+
+        full = sim.store(0)
+        assert sim.finalized_epoch() >= 5, "full node must finalize post-GST"
+        # same finalized head, exactly
+        assert node.finalized_root() == bytes(full.finalized_checkpoint.root)
+        assert node.finalized_slot == \
+            int(full.blocks[bytes(full.finalized_checkpoint.root)].slot)
+        # the run crossed a sync-committee period; the client kept verifying
+        assert node.updates_applied > 0 and node.updates_rejected == 0
+        # lag metrics recorded every slot and converged
+        assert len(node.records) >= 72
+        assert node.records[-1]["head_lag"] == 0
+        assert node.records[-1]["finality_lag"] == 0
+        assert all(r["finality_lag"] >= 0 for r in node.records)
+
+    def test_force_update_substitutes_attested_for_stale_finality_proof(self):
+        """During a finality stall every served update re-proves the OLD
+        checkpoint; the force-update path must fall back to the attested
+        header or the client wedges behind the chain forever."""
+        from pos_evolution_tpu.lightclient import (
+            bootstrap_from_store,
+            build_update,
+            initialize_light_client_store,
+            is_finality_update,
+            process_light_client_store_force_update,
+            process_light_client_update,
+            update_timeout_slots,
+        )
+        from pos_evolution_tpu.sim import Simulation
+        sim = Simulation(64)
+        sim.run_epochs(6)
+        genesis = sim.genesis_state
+        trusted_root, bootstrap = bootstrap_from_store(sim.store(0))
+        store = initialize_light_client_store(
+            trusted_root, bootstrap, bytes(genesis.fork.current_version),
+            bytes(genesis.genesis_validators_root))
+        base = int(store.finalized_header.slot)
+        g = sim.groups[0]
+        update = build_update(g.store, sim._get_head(g), archive=sim.block_archive)
+        # the head's attested state finalizes one step behind the store's
+        # own finalized checkpoint: the update's proof is genuinely stale
+        assert is_finality_update(update)
+        assert int(update.finalized_header.beacon.slot) < base
+        # first process legitimately applies to LEARN the next committee
+        # (and clears the best-update slot); the second models the stall:
+        # no finality progress, so the update is only retained as best
+        process_light_client_update(store, update, current_slot=sim.slot)
+        assert store.next_sync_committee is not None
+        process_light_client_update(store, update, current_slot=sim.slot)
+        assert int(store.finalized_header.slot) == base  # no progress: kept
+        assert store.best_valid_update is not None
+        process_light_client_store_force_update(
+            store, base + update_timeout_slots() + 1)
+        assert int(store.finalized_header.slot) == \
+            int(update.attested_header.beacon.slot)
+
+    def test_client_clock_ticks_while_server_group_crashed(self):
+        """A crashed serving group stops serving, but the client is an
+        independent process: its per-slot housekeeping (force-update
+        timeout, lag records) must keep running through the outage."""
+        from pos_evolution_tpu.sim import (
+            CrashWindow,
+            Simulation,
+            chaos_plan,
+            faulty_schedule,
+        )
+        plan = chaos_plan(seed=1, drop_p=0.0, duplicate_p=0.0, reorder_p=0.0,
+                          crashes=(CrashWindow(group=0, crash_slot=10,
+                                               rejoin_slot=14),))
+        sim = Simulation(64, schedule=faulty_schedule(64, plan, n_groups=2))
+        node = sim.attach_light_client(group=0)
+        sim.run_until_slot(20)
+        # one lag record per slot, no gaps across the outage
+        assert [r["slot"] for r in node.records] == list(range(21))
+
+    def test_dropped_updates_are_survivable(self):
+        """A client whose update feed is heavily lossy pre-GST still
+        advances (the updates that do arrive carry finality proofs)."""
+        from pos_evolution_tpu.sim import Simulation, faulty_schedule, lossy_plan
+        c = minimal_config()
+        gst = 3 * c.slots_per_epoch * c.seconds_per_slot
+        plan = lossy_plan(seed=3, drop_p=0.5, gst=gst)
+        sim = Simulation(64, schedule=faulty_schedule(64, plan))
+        node = sim.attach_light_client()
+        sim.run_epochs(6)
+        assert node.updates_applied < sim.slot  # some updates were dropped
+        assert node.finalized_slot > 0  # but finality still advanced

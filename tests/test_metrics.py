@@ -1,0 +1,130 @@
+"""utils/metrics.py coverage: HandlerTimer percentiles/summary, the
+structured slot_record, the light-client lag record, and the
+StoreInvariantChecker contract."""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.utils.metrics import (
+    HandlerTimer,
+    StoreInvariantChecker,
+    light_client_lag_record,
+    slot_record,
+)
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+class TestHandlerTimer:
+    def test_track_collects_samples(self):
+        t = HandlerTimer()
+        for _ in range(5):
+            with t.track("h"):
+                pass
+        assert len(t.samples["h"]) == 5
+        assert all(x >= 0 for x in t.samples["h"])
+
+    def test_track_records_on_exception(self):
+        t = HandlerTimer()
+        with pytest.raises(ValueError):
+            with t.track("boom"):
+                raise ValueError()
+        assert len(t.samples["boom"]) == 1
+
+    def test_percentile_matches_numpy(self):
+        t = HandlerTimer()
+        t.samples["h"] = [0.1, 0.2, 0.3, 0.4]
+        assert t.percentile("h", 50) == pytest.approx(float(np.percentile(t.samples["h"], 50)))
+        assert t.percentile("h", 95) == pytest.approx(float(np.percentile(t.samples["h"], 95)))
+
+    def test_percentile_of_unknown_handler_is_nan(self):
+        assert np.isnan(HandlerTimer().percentile("nope", 50))
+
+    def test_summary_shape_and_totals(self):
+        t = HandlerTimer()
+        t.samples["a"] = [0.001, 0.002, 0.003]
+        t.samples["b"] = [0.5]
+        s = t.summary()
+        assert set(s) == {"a", "b"}
+        for name, row in s.items():
+            assert set(row) == {"count", "p50_ms", "p95_ms", "total_s"}
+        assert s["a"]["count"] == 3
+        assert s["a"]["total_s"] == pytest.approx(0.006, abs=1e-6)
+        assert s["b"]["p50_ms"] == pytest.approx(500.0)
+
+    def test_wrap_preserves_return_value(self):
+        t = HandlerTimer()
+        fn = t.wrap("f", lambda x: x * 2)
+        assert fn(21) == 42
+        assert len(t.samples["f"]) == 1
+
+
+class TestSlotRecord:
+    def test_fields_and_values_from_live_store(self):
+        from pos_evolution_tpu.sim import Simulation
+        sim = Simulation(32)
+        sim.run_epochs(3)
+        store = sim.store(0)
+        rec = slot_record(store, sim.slot)
+        expected_keys = {
+            "slot", "head_root", "head_slot", "justified_epoch",
+            "finalized_epoch", "justification_bits", "participation",
+            "n_blocks", "n_latest_messages", "equivocators",
+        }
+        assert set(rec) == expected_keys
+        assert rec["slot"] == sim.slot
+        assert rec["finalized_epoch"] == sim.finalized_epoch()
+        assert 0.0 <= rec["participation"] <= 1.0
+        assert rec["n_blocks"] == len(store.blocks)
+        assert len(rec["justification_bits"]) == 4
+
+
+class TestLightClientLagRecord:
+    def test_lags_computed_against_full_node(self):
+        from pos_evolution_tpu.config import cfg
+        from pos_evolution_tpu.lightclient import LightClientStore
+        from pos_evolution_tpu.specs.containers import (
+            BeaconBlockHeader,
+            SyncCommittee,
+        )
+        spe = cfg().slots_per_epoch
+        store = LightClientStore(
+            finalized_header=BeaconBlockHeader(slot=2 * spe),
+            current_sync_committee=SyncCommittee(),
+            optimistic_header=BeaconBlockHeader(slot=3 * spe + 1),
+        )
+        rec = light_client_lag_record(store, slot=3 * spe + 2,
+                                      full_head_slot=3 * spe + 2,
+                                      full_finalized_epoch=3)
+        assert rec["head_lag"] == 1
+        assert rec["finality_lag"] == 1
+        assert rec["lc_finalized_slot"] == 2 * spe
+
+
+class TestStoreInvariantChecker:
+    def test_clean_failed_handler_records_no_violation(self):
+        from pos_evolution_tpu.sim import Simulation
+        sim = Simulation(32)
+        sim.run_epochs(1)
+        checker = StoreInvariantChecker(sim.store(0))
+
+        def failing_handler(store):
+            raise AssertionError("rejects without mutating")
+
+        with pytest.raises(AssertionError):
+            checker.call(failing_handler)
+        assert checker.violations == []
+
+    def test_mutating_failed_handler_is_flagged(self):
+        from pos_evolution_tpu.sim import Simulation
+        sim = Simulation(32)
+        sim.run_epochs(1)
+        checker = StoreInvariantChecker(sim.store(0))
+
+        def dirty_handler(store):
+            store.time += 1
+            raise AssertionError("mutated before failing")
+
+        with pytest.raises(AssertionError):
+            checker.call(dirty_handler)
+        assert len(checker.violations) == 1

@@ -196,3 +196,43 @@ class TestMerkleBranch:
         root = merkleize_chunks(leaves, limit=8)
         branch = merkle_tree_branch(leaves, 2, 3)
         assert is_valid_merkle_branch(leaves[2].tobytes(), branch, 3, 2, root)
+
+    def test_branch_roundtrip_property(self):
+        """merkle_tree_branch ↔ is_valid_merkle_branch round-trip for random
+        leaf counts and indices, including padding-to-power-of-two (odd leaf
+        counts and depths deeper than the natural tree)."""
+        from pos_evolution_tpu.ssz.merkle import next_pow_of_two
+        rng = np.random.default_rng(7)
+        for _ in range(40):
+            n = int(rng.integers(1, 50))
+            natural_depth = (next_pow_of_two(n) - 1).bit_length()
+            depth = natural_depth + int(rng.integers(0, 3))  # virtual padding
+            index = int(rng.integers(0, n))
+            leaves = rng.integers(0, 256, size=(n, 32), dtype=np.uint8)
+            root = merkleize_chunks(leaves, limit=1 << depth)
+            branch = merkle_tree_branch(leaves, index, depth)
+            assert len(branch) == depth
+            assert is_valid_merkle_branch(
+                leaves[index].tobytes(), branch, depth, index, root), \
+                f"n={n} depth={depth} index={index}"
+            # wrong leaf and wrong index must fail (a sibling index only
+            # collides when its leaf happens to be identical — random
+            # leaves make that negligible)
+            assert not is_valid_merkle_branch(
+                b"\x99" * 32, branch, depth, index, root)
+            wrong = (index + 1) % n
+            if wrong != index:
+                assert not is_valid_merkle_branch(
+                    leaves[wrong].tobytes(), branch, depth, wrong, root)
+
+    def test_branch_at_padding_boundary(self):
+        """The pad-to-power-of-two edge exactly: the last real leaf of an
+        odd count proves against zero-hash siblings."""
+        for n in (3, 5, 7, 9, 33):
+            leaves = np.arange(n * 32, dtype=np.uint64).astype(np.uint8).reshape(n, 32)
+            from pos_evolution_tpu.ssz.merkle import next_pow_of_two
+            depth = (next_pow_of_two(n) - 1).bit_length()
+            root = merkleize_chunks(leaves, limit=1 << depth)
+            branch = merkle_tree_branch(leaves, n - 1, depth)
+            assert is_valid_merkle_branch(
+                leaves[n - 1].tobytes(), branch, depth, n - 1, root)
