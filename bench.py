@@ -65,7 +65,14 @@ def main():
     from pos_evolution_tpu.ops.aggregation import aggregate_verify_batch
     from pos_evolution_tpu.ops.epoch import DenseRegistry, process_epoch_dense
     from pos_evolution_tpu.ops.forkchoice import DenseStore, head_and_weights
+    from pos_evolution_tpu.telemetry import MetricsRegistry, jaxrt
     from pos_evolution_tpu.utils.benchtime import checksum_tree, fused_measure
+
+    # JAX runtime telemetry for the whole bench: recompile counts, timed
+    # dispatches, checksum transfer bytes — folded into the emitted JSON
+    # so scripts/perf_gate.py can gate the NEXT run's counts against it.
+    registry = MetricsRegistry()
+    jaxrt.install(registry)
 
     on_accel = jax.default_backend() not in ("cpu",)
     # Per-invocation entropy folded into every salt: the relay's execution
@@ -193,6 +200,7 @@ def main():
                 "metric": "epoch_1m_validators_aggregation_plus_forkchoice",
                 "error": "no aggregation path completed",
                 "incidents": wd.incidents,
+                "telemetry": {"counts": registry.counts()},
             }))
             return
         t = float(min(candidates))
@@ -218,6 +226,7 @@ def main():
                 "error": "size ladder incomplete, cannot fit exponent",
                 "measured_n_seconds": [[ni, round(ti, 6)] for ni, ti in pairs],
                 "incidents": wd.incidents,
+                "telemetry": {"counts": registry.counts()},
             }))
             return
         slope = float(np.polyfit(np.log([p[0] for p in pairs]),
@@ -291,6 +300,7 @@ def main():
         "value": round(t, 6),
         "unit": "s",
         "vs_baseline": round(1.0 / t, 3),
+        "telemetry": {"counts": registry.counts()},
         **extra,
     }))
 

@@ -145,6 +145,7 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from pos_evolution_tpu.telemetry import MetricsRegistry, jaxrt
     from pos_evolution_tpu.utils.benchtime import checksum_tree, fused_measure
     from pos_evolution_tpu.utils.watchdog import Watchdog
 
@@ -167,6 +168,11 @@ def main():
 
     def _failed(name):
         return {"error": f"step '{name}' failed; see watchdog_incidents"}
+
+    # runtime telemetry across the whole matrix (recompiles, dispatches,
+    # transfer bytes) — emitted under "telemetry" for scripts/perf_gate.py
+    registry = MetricsRegistry()
+    jaxrt.install(registry)
 
     entropy = int.from_bytes(os.urandom(3), "little")
     results = {"backend": jax.default_backend(),
@@ -346,6 +352,7 @@ def main():
 
     if wd.incidents:
         results["watchdog_incidents"] = wd.incidents
+    results["telemetry"] = {"counts": registry.counts()}
 
     out = json.dumps(results, indent=1)
     print(out)

@@ -2,7 +2,12 @@
 through a faulty simulation and watch it converge on the full node's
 finalized head.
 
-Run: python examples/light_client_demo.py
+Run: python examples/light_client_demo.py [--events events.jsonl]
+
+``--events`` records the whole run on the telemetry bus (message
+lifecycle spans, fault attribution, per-slot records, light-client lag)
+as schema-versioned JSONL; feed it to ``scripts/run_report.py`` for the
+finality timeline / fault / handler-percentile report.
 
 What happens:
 1. A 64-validator simulation runs with a lossy network (10% of all
@@ -25,12 +30,25 @@ from pos_evolution_tpu.config import minimal_config, use_config
 
 
 def main():
+    events_path = None
+    if "--events" in sys.argv:
+        try:
+            events_path = sys.argv[sys.argv.index("--events") + 1]
+        except IndexError:
+            sys.exit("Usage: python examples/light_client_demo.py "
+                     "[--events events.jsonl]")
     with use_config(minimal_config()) as c:
         from pos_evolution_tpu.sim import Simulation, faulty_schedule, lossy_plan
 
+        telemetry = None
+        if events_path is not None:
+            from pos_evolution_tpu.telemetry import Telemetry
+            telemetry = Telemetry.to_file(events_path)
+
         gst = 6 * c.slots_per_epoch * c.seconds_per_slot
         plan = lossy_plan(seed=11, drop_p=0.10, gst=gst)
-        sim = Simulation(64, schedule=faulty_schedule(64, plan))
+        sim = Simulation(64, schedule=faulty_schedule(64, plan),
+                         telemetry=telemetry)
 
         print("== Light client over a faulty 8-epoch simulation ==")
         node = sim.attach_light_client()
@@ -61,6 +79,11 @@ def main():
             "light client must converge on the full node's finalized head"
         print("converged: light client finalized head == full node "
               "finalized head ✓")
+        if telemetry is not None:
+            telemetry.close()
+            print(f"\ntelemetry: {len(telemetry.bus.events)} events -> "
+                  f"{events_path}\n  next: python scripts/run_report.py "
+                  f"{events_path}")
 
 
 if __name__ == "__main__":

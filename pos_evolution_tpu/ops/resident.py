@@ -49,6 +49,7 @@ from pos_evolution_tpu.ops.forkchoice import (
     rebuild_buckets,
     remove_latest_messages,
 )
+from pos_evolution_tpu.telemetry import jaxrt
 
 
 class ResidentForkChoice:
@@ -86,6 +87,10 @@ class ResidentForkChoice:
         logger.warning(
             "resident fork choice degraded to the host spec path: %s",
             reason)
+        from pos_evolution_tpu.telemetry import emit_global
+        emit_global("degradation", component="resident_forkchoice",
+                    reason=reason[:400], fallback="host_spec_walk",
+                    head_queries=self._head_queries)
 
     # -- full (re)build --------------------------------------------------------
 
@@ -237,6 +242,7 @@ class ResidentForkChoice:
         blocks = np.concatenate(
             [np.full(p[0].shape[0], p[2], np.int32) for p in self._pending])
         self._pending.clear()
+        jaxrt.record_dispatch(site="resident_flush")
         k = next_pow2(val_idx.shape[0])
         pad = k - val_idx.shape[0]
         # padded entries: new_block = -1 never lands; epoch 0 + later
@@ -311,8 +317,11 @@ class ResidentForkChoice:
                 boost_idx = bi
                 boost_amount = get_proposer_boost(store)
         justified_idx = self.index_of[bytes(store.justified_checkpoint.root)]
+        jaxrt.record_dispatch(site="resident_head")
         head_idx, _ = head_from_buckets(
             self.parent, self.real, self.rank, self.leaf_viable,
             jnp.int32(justified_idx), self.buckets, jnp.int32(boost_idx),
             jnp.int64(boost_amount), self.capacity)
+        # the int() readback is the query's one device->host transfer
+        jaxrt.record_transfer(4, direction="d2h", site="resident_head")
         return self.roots[int(head_idx)]

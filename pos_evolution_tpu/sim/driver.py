@@ -17,6 +17,7 @@ partition, so honest runs cost one store and attack runs cost a handful.
 from __future__ import annotations
 
 import heapq
+import time as _time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -45,6 +46,23 @@ class _QueuedMessage:
     seq: int
     kind: str = field(compare=False)     # "block" | "attestation" | "slashing"
     payload: object = field(compare=False)
+    # telemetry lineage: the gossip-edge span this copy belongs to (None
+    # when telemetry is off or after resume — spans are not sim state)
+    span: str | None = field(compare=False, default=None)
+
+
+def _span_id(kind: str, slot: int, src: int, msg_id: int) -> str:
+    """Deterministic message-span identity: the same run always names the
+    same spans (no uuids), so lineage is replayable and test-pinnable."""
+    if kind == "block":
+        return f"blk-{slot}-{src}"
+    if kind == "attestation":
+        return f"att-{slot}-g{src}-c{msg_id}"
+    return f"{kind}-{slot}-{src}-{msg_id}"
+
+
+_HANDLER_OF = {"block": "on_block", "attestation": "on_attestation",
+               "slashing": "on_attester_slashing"}
 
 
 class ViewGroup:
@@ -52,10 +70,19 @@ class ViewGroup:
     + an attestation pool for proposals made from this view."""
 
     def __init__(self, group_id: int, store: fc.Store, members: np.ndarray,
-                 resident=None):
+                 resident=None, telemetry=None):
         self.id = group_id
         self.store = store
         self.members = members
+        # Telemetry (pos_evolution_tpu/telemetry): when attached, every
+        # delivery emits a lifecycle event; when its debug flag is set,
+        # every handler call runs under the StoreInvariantChecker
+        # (failed-handler-must-not-mutate, pos-evolution.md:1041).
+        self.telemetry = telemetry
+        self.invariants = None
+        if telemetry is not None and telemetry.debug:
+            from pos_evolution_tpu.utils.metrics import StoreInvariantChecker
+            self.invariants = StoreInvariantChecker(store)
         # Crash-fault state (sim/faults.py CrashWindow): a crashed group
         # processes nothing and receives nothing until it rejoins via
         # weak-subjectivity checkpoint sync. Always recomputable from the
@@ -79,9 +106,29 @@ class ViewGroup:
         # accelerated fork choice; handlers below forward their deltas.
         self.resident = resident
 
-    def enqueue(self, time: float, kind: str, payload) -> None:
-        heapq.heappush(self.queue, _QueuedMessage(time, self._seq, kind, payload))
+    def enqueue(self, time: float, kind: str, payload,
+                span: str | None = None) -> None:
+        heapq.heappush(self.queue,
+                       _QueuedMessage(time, self._seq, kind, payload, span))
         self._seq += 1
+
+    def _call_handler(self, handler, *args, **kwargs):
+        """Route one fork-choice handler call through the debug-gated
+        ``StoreInvariantChecker``; a violation (a FAILED handler that
+        mutated the store) is surfaced as a telemetry event before the
+        assertion propagates to the caller's drop policy."""
+        if self.invariants is None:
+            return handler(self.store, *args, **kwargs)
+        n0 = len(self.invariants.violations)
+        try:
+            return self.invariants.call(handler, *args, **kwargs)
+        except AssertionError:
+            if len(self.invariants.violations) > n0:
+                self.telemetry.bus.emit(
+                    "invariant_violation", group=self.id,
+                    handler=getattr(handler, "__name__", str(handler)),
+                    detail=self.invariants.violations[-1])
+            raise
 
     def _mirror_attestation(self, att, indices) -> None:
         if self.resident is not None and indices is not None:
@@ -100,23 +147,34 @@ class ViewGroup:
             # mirror, splitting its vote weights — gossip dedup is part of
             # every real client's pipeline
             return
-        fc.on_block(self.store, signed_block)
+        self._call_handler(fc.on_block, signed_block)
         if self.resident is not None:
             self.resident.note_block(self.store, block_root)
         carried = []
         for att in signed_block.message.body.attestations:
             carried.append(hash_tree_root(att))
             try:
-                idx = fc.on_attestation(self.store, att, is_from_block=True)
+                idx = self._call_handler(fc.on_attestation, att,
+                                         is_from_block=True)
                 self._mirror_attestation(att, idx)
             except AssertionError:
-                pass
+                # block-carried attestation rejects are counted, not
+                # per-event (a block carries up to max_attestations of
+                # them; the interesting signal is the rate)
+                if self.telemetry is not None:
+                    self.telemetry.registry.counter(
+                        "carried_attestation_rejects_total",
+                        "on_attestation(is_from_block=True) asserts",
+                    ).inc(group=self.id)
         self.block_atts[block_root] = carried
 
     def deliver_due(self, now: float, timer, resolver=None) -> None:
         track = timer.track
+        bus = self.telemetry.bus if self.telemetry is not None else None
         while self.queue and self.queue[0].time <= now:
             msg = heapq.heappop(self.queue)
+            t0 = _time.perf_counter()
+            status, reason = "accept", None
             try:
                 if msg.kind == "block":
                     # block-carried attestations are part of on_block cost
@@ -126,33 +184,66 @@ class ViewGroup:
                         self._process_block(msg.payload)
                 elif msg.kind == "attestation":
                     with track("on_attestation"):
-                        idx = fc.on_attestation(self.store, msg.payload)
+                        idx = self._call_handler(fc.on_attestation,
+                                                 msg.payload)
                         self._mirror_attestation(msg.payload, idx)
                     self.pool[hash_tree_root(msg.payload)] = msg.payload
                 elif msg.kind == "slashing":
                     with track("on_attester_slashing"):
-                        evil = fc.on_attester_slashing(self.store, msg.payload)
+                        evil = self._call_handler(fc.on_attester_slashing,
+                                                  msg.payload)
                         if self.resident is not None:
                             self.resident.note_slashing(evil)
-            except AssertionError:
+            except AssertionError as e:
                 # Invalid-at-this-time messages are dropped (the reference
                 # permits re-queueing, pos-evolution.md:967-968; the driver
                 # keeps the simple policy). Pre-anchor walks in a
                 # checkpoint-synced view land here too via the handlers'
                 # own asserts (get_ancestor clamps to the anchor instead
                 # of raising, so a genuine KeyError stays a loud bug).
-                continue
+                status = "reject"
+                reason = (str(e) or "assertion failed")[:200]
+            if bus is not None:
+                handler = _HANDLER_OF[msg.kind]
+                extra = {"reason": reason} if reason is not None else {}
+                bus.emit(
+                    "deliver",
+                    span=(f"{msg.span}/d{self.id}" if msg.span else None),
+                    parent=msg.span, group=self.id, kind=msg.kind,
+                    handler=handler, t=msg.time, status=status,
+                    duration_ms=round((_time.perf_counter() - t0) * 1e3, 4),
+                    **extra)
+                self.telemetry.registry.counter(
+                    "handler_calls_total",
+                    "fork-choice handler invocations from delivery",
+                ).inc(handler=handler, status=status)
 
 
 class Simulation:
     """Round-based multi-validator simulation over a Schedule."""
 
     def __init__(self, n_validators: int, schedule: Schedule | None = None,
-                 genesis_time: int = 0, accelerated_forkchoice: bool = False):
+                 genesis_time: int = 0, accelerated_forkchoice: bool = False,
+                 telemetry=None):
         self.cfg = cfg()
         self.schedule = schedule or honest_schedule(n_validators)
         self.n_validators = n_validators
         self.genesis_time = genesis_time
+        # Telemetry (pos_evolution_tpu/telemetry.Telemetry): opt-in event
+        # bus + metrics registry. NOT simulation state — checkpoint()
+        # excludes it (like wall-clock timings); pass it again to resume()
+        # to keep recording. Fault attribution flows through the plan's
+        # sink: the Simulation OWNS the sink of the plan it runs — set to
+        # this run's bus, or cleared when no telemetry is attached — so a
+        # reused schedule never leaks fault events onto a previous run's
+        # (possibly closed) bus. To use a custom sink without Telemetry,
+        # set plan.sink AFTER constructing the Simulation. A plan shared
+        # across CONCURRENT sims is not supported (its log would
+        # interleave anyway).
+        self.telemetry = telemetry
+        if self.schedule.faults is not None:
+            self.schedule.faults.sink = (telemetry.bus
+                                         if telemetry is not None else None)
         state, anchor = make_genesis(n_validators, genesis_time)
         self.genesis_state = state
         self.anchor_root = hash_tree_root(anchor)
@@ -168,7 +259,8 @@ class Simulation:
             if accelerated_forkchoice:
                 from pos_evolution_tpu.ops.resident import ResidentForkChoice
                 resident = ResidentForkChoice(store)
-            return ViewGroup(g, store, self.schedule.members(g), resident)
+            return ViewGroup(g, store, self.schedule.members(g), resident,
+                             telemetry=telemetry)
 
         self.groups = [_make_group(g) for g in range(self.schedule.n_groups)]
         self.slot = 0
@@ -195,12 +287,29 @@ class Simulation:
         # run's FaultPlan. Not simulation state: a resumed run re-attaches.
         self.light_clients: list = []
         self._lc_group = 0
+        if telemetry is not None:
+            telemetry.bus.emit(
+                "run_start", n_validators=n_validators,
+                n_groups=self.schedule.n_groups, genesis_time=genesis_time,
+                accelerated_forkchoice=accelerated_forkchoice,
+                debug=telemetry.debug)
 
     def _get_head(self, group: ViewGroup) -> bytes:
+        t0 = _time.perf_counter()
         with self.timer.track("get_head"):
             if group.resident is not None:
-                return group.resident.head(group.store)
-            return fc.get_head(group.store)
+                head = group.resident.head(group.store)
+            else:
+                head = fc.get_head(group.store)
+        if self.telemetry is not None:
+            self.telemetry.bus.emit(
+                "handler", handler="get_head", group=group.id,
+                duration_ms=round((_time.perf_counter() - t0) * 1e3, 4))
+            self.telemetry.registry.counter(
+                "handler_calls_total",
+                "fork-choice handler invocations from delivery",
+            ).inc(handler="get_head", status="accept")
+        return head
 
     def trace_summary(self) -> dict:
         """Per-handler timing percentiles for this run."""
@@ -253,12 +362,22 @@ class Simulation:
         if delay is None or dst.crashed:
             return
         t = base_time + delay
+        span = None
+        if self.telemetry is not None:
+            # one gossip-edge span per (message, recipient group); a drop
+            # leaves this span childless — run_report counts fault events
+            # against exactly these edges ("counts vs. effects")
+            root_span = _span_id(kind, slot, src, msg_id)
+            span = f"{root_span}/g{dst.id}"
+            self.telemetry.bus.emit("gossip", span=span, parent=root_span,
+                                    kind=kind, slot=slot, src=src,
+                                    msg_id=msg_id, dst=dst.id, t=t)
         plan = self.schedule.faults
         if plan is None:
-            dst.enqueue(t, kind, payload)
+            dst.enqueue(t, kind, payload, span=span)
             return
         for extra in plan.delivery_offsets(kind, slot, src, msg_id, dst.id, t):
-            dst.enqueue(t + extra, kind, payload)
+            dst.enqueue(t + extra, kind, payload, span=span)
 
     def _apply_fault_transitions(self, slot: int) -> None:
         """Crash / rejoin view groups at slot boundaries per the plan's
@@ -274,11 +393,23 @@ class Simulation:
                 # the process died: in-flight messages and the op pool go
                 # with it (the store survives on disk — rejoin discards it
                 # anyway in favor of the synced checkpoint)
+                n_inflight = len(g.queue)
                 g.queue.clear()
                 g.pool.clear()
                 g.block_atts.clear()
+                if self.telemetry is not None:
+                    self.telemetry.bus.emit("crash", group=g.id, slot=slot,
+                                            lost_in_flight=n_inflight)
             elif g.crashed and not down:
                 self._rejoin_group(g, slot)
+                if self.telemetry is not None:
+                    store = g.store
+                    self.telemetry.bus.emit(
+                        "rejoin", group=g.id, slot=slot,
+                        sync_checkpoint_epoch=int(
+                            store.justified_checkpoint.epoch),
+                        sync_checkpoint_root=bytes(
+                            store.justified_checkpoint.root).hex()[:16])
 
     def _rejoin_group(self, group: ViewGroup, slot: int) -> None:
         """Checkpoint sync: the restarted group boots from a live peer's
@@ -325,6 +456,11 @@ class Simulation:
         group.pool.clear()
         group.block_atts = {}
         group.crashed = False
+        if group.invariants is not None:
+            # the checker fingerprints ONE store; re-anchor it on the
+            # freshly synced one or every later check reads stale state
+            from pos_evolution_tpu.utils.metrics import StoreInvariantChecker
+            group.invariants = StoreInvariantChecker(store)
         if group.resident is not None:
             from pos_evolution_tpu.ops.resident import ResidentForkChoice
             group.resident = ResidentForkChoice(store)
@@ -366,7 +502,16 @@ class Simulation:
                 # A real proposer drops the op, not the proposal.
                 sb = build_block(group.store.block_states[head], slot,
                                  attestations=[], sync_aggregate=sync_agg)
-            self.block_archive[hash_tree_root(sb.message)] = sb
+            block_root = hash_tree_root(sb.message)
+            self.block_archive[block_root] = sb
+            if self.telemetry is not None:
+                # lifecycle root span: propose -> per-group gossip edges
+                # -> per-group deliveries hang off this id
+                self.telemetry.bus.emit(
+                    "propose", span=_span_id("block", slot, int(proposer), 0),
+                    slot=slot, proposer=int(proposer), group=group.id,
+                    block_root=block_root.hex()[:16],
+                    n_attestations=len(atts))
             for dst in self.groups:
                 delay = self.schedule.block_delay(int(proposer), slot, dst.id)
                 self._send(dst, t0, delay, "block", sb, slot,
@@ -475,6 +620,12 @@ class Simulation:
                         participants=np.array(sorted(awake), dtype=np.int64))
                 except ValueError:
                     continue  # no awake member in this committee
+                if self.telemetry is not None:
+                    self.telemetry.bus.emit(
+                        "attest",
+                        span=_span_id("attestation", slot, group.id, index),
+                        slot=slot, group=group.id, committee=index,
+                        head=head.hex()[:16])
                 for dst in self.groups:
                     delay = self.schedule.attestation_delay(group.id, slot, dst.id)
                     self._send(dst, t_next, delay, "attestation", att, slot,
@@ -505,17 +656,27 @@ class Simulation:
 
     # -- observability (SURVEY.md §5: structured per-slot log) --
     def _record_metrics(self, slot: int) -> None:
-        g0 = self.groups[0].store
-        head = self._get_head(self.groups[0])
-        self.metrics.append({
-            "slot": slot,
-            "head": head.hex()[:8],
-            "head_slot": int(g0.blocks[head].slot),
-            "justified_epoch": int(g0.justified_checkpoint.epoch),
-            "finalized_epoch": int(g0.finalized_checkpoint.epoch),
-            "n_blocks": len(g0.blocks),
-            "equivocators": len(g0.equivocating_indices),
-        })
+        """One ``utils.metrics.slot_record`` per slot — the driver no
+        longer hand-rolls a subset (the old copy silently lacked
+        ``participation``/``justification_bits``/``n_latest_messages``).
+        The legacy ``head`` key (8-hex prefix) is kept so ``metrics``
+        entries stay a superset of every pre-telemetry consumer's keys,
+        and everything remains JSON-round-trippable for
+        ``checkpoint()``/``resume()`` snapshots."""
+        from pos_evolution_tpu.utils.metrics import slot_record
+        group = self.groups[0]
+        head = self._get_head(group)
+        rec = slot_record(group.store, slot, head=head)
+        rec["head"] = rec["head_root"][:8]
+        self.metrics.append(rec)
+        if self.telemetry is not None:
+            self.telemetry.bus.emit("slot", **rec)
+            self.telemetry.registry.gauge(
+                "finalized_epoch", "group-0 finalized epoch").set(
+                rec["finalized_epoch"])
+            self.telemetry.registry.gauge(
+                "justified_epoch", "group-0 justified epoch").set(
+                rec["justified_epoch"])
 
     # -- light clients (lightclient/) ------------------------------------------
 
@@ -572,7 +733,10 @@ class Simulation:
                                                       1_000_000 + node.id, t))
                 if delivered:
                     node.on_update(update, current_slot=slot)
-            node.advance(slot, full_head_slot, full_finalized_epoch)
+            record = node.advance(slot, full_head_slot, full_finalized_epoch)
+            if self.telemetry is not None:
+                self.telemetry.bus.emit("light_client_lag", node=node.id,
+                                        **record)
 
     def flush_light_clients(self) -> None:
         """Serve one off-chain finality update for the serving group's
@@ -603,7 +767,11 @@ class Simulation:
         full_finalized_epoch = int(group.store.finalized_checkpoint.epoch)
         for node in self.light_clients:
             node.on_update(update, current_slot=signature_slot)
-            node.advance(signature_slot, full_head_slot, full_finalized_epoch)
+            record = node.advance(signature_slot, full_head_slot,
+                                  full_finalized_epoch)
+            if self.telemetry is not None:
+                self.telemetry.bus.emit("light_client_lag", node=node.id,
+                                        offchain=True, **record)
 
     # -- whole-simulation checkpoint / resume ----------------------------------
     def checkpoint(self) -> bytes:
@@ -617,15 +785,17 @@ class Simulation:
         return save_simulation(self)
 
     @classmethod
-    def resume(cls, data: bytes,
-               schedule: Schedule | None = None) -> "Simulation":
+    def resume(cls, data: bytes, schedule: Schedule | None = None,
+               telemetry=None) -> "Simulation":
         """Rebuild a checkpointed simulation mid-run. ``schedule`` must be
         the same delivery/fault policy the original run used (schedules
         hold callables, which do not serialize); None resumes an honest
         synchronous run. Crash state re-derives from the FaultPlan, so a
-        checkpoint taken during an outage resumes into the outage."""
+        checkpoint taken during an outage resumes into the outage.
+        ``telemetry`` re-attaches an event bus/registry (telemetry is not
+        sim state; the resumed run records only post-resume events)."""
         from pos_evolution_tpu.utils.snapshot import load_simulation
-        return load_simulation(data, schedule=schedule)
+        return load_simulation(data, schedule=schedule, telemetry=telemetry)
 
     # -- accessors --
     def store(self, group: int = 0) -> fc.Store:

@@ -73,6 +73,14 @@ class FaultPlan:
     # post-resume decisions.
     record_log: bool = False
     log: list = field(default_factory=list)
+    # Telemetry sink (an ``EventBus`` or anything with ``.emit``): every
+    # non-trivial decision also lands as a ``fault`` event carrying the
+    # seeded hash inputs that decided it — (seed, kind tag, slot, src,
+    # msg_id, dst) plus the drawn uniform and its threshold — so a run
+    # report can attribute "why did THIS message vanish" without the live
+    # plan. Like ``log``, the sink is not simulation state (the driver
+    # re-attaches it on resume alongside the schedule).
+    sink: object = None
 
     # -- stateless randomness --------------------------------------------------
 
@@ -101,23 +109,50 @@ class FaultPlan:
             return [0.0]
         tag = _KIND_TAG.get(kind, 3)
         key = (tag, slot, src, msg_id, dst_group)
-        if self.drop_p > 0.0 and self._unit(0, *key) < self.drop_p:
-            self._log("drop", kind, slot, src, msg_id, dst_group)
-            return []
+        if self.drop_p > 0.0:
+            u = self._unit(0, *key)
+            if u < self.drop_p:
+                self._log("drop", kind, slot, src, msg_id, dst_group,
+                          u=u, p=self.drop_p)
+                return []
         offsets = [0.0]
-        if self.reorder_p > 0.0 and self._unit(1, *key) < self.reorder_p:
-            offsets = [self._unit(2, *key) * self.reorder_max_delay]
-            self._log("reorder", kind, slot, src, msg_id, dst_group)
-        if self.duplicate_p > 0.0 and self._unit(3, *key) < self.duplicate_p:
-            offsets.append(self._unit(4, *key) * self.reorder_max_delay)
-            self._log("duplicate", kind, slot, src, msg_id, dst_group)
+        if self.reorder_p > 0.0:
+            u = self._unit(1, *key)
+            if u < self.reorder_p:
+                offsets = [self._unit(2, *key) * self.reorder_max_delay]
+                self._log("reorder", kind, slot, src, msg_id, dst_group,
+                          u=u, p=self.reorder_p, delay_s=offsets[0])
+        if self.duplicate_p > 0.0:
+            u = self._unit(3, *key)
+            if u < self.duplicate_p:
+                extra = self._unit(4, *key) * self.reorder_max_delay
+                offsets.append(extra)
+                self._log("duplicate", kind, slot, src, msg_id, dst_group,
+                          u=u, p=self.duplicate_p, delay_s=extra)
         return offsets
 
     def _log(self, action: str, kind: str, slot: int, src: int, msg_id: int,
-             dst_group: int) -> None:
+             dst_group: int, u: float | None = None, p: float | None = None,
+             delay_s: float | None = None) -> None:
         if self.record_log:
             self.log.append({"action": action, "kind": kind, "slot": slot,
                              "src": src, "msg_id": msg_id, "dst": dst_group})
+        if self.sink is not None:
+            # fault attribution: the full seeded-hash identity that decided
+            # this fate, replayable via _unit(seed, tag, slot, src, msg_id,
+            # dst) — enough for run_report to explain any one lost message
+            ev = {"action": action, "kind": kind, "slot": slot, "src": src,
+                  "msg_id": msg_id, "dst": dst_group, "seed": self.seed,
+                  "tag": _KIND_TAG.get(kind, 3)}
+            if u is not None:
+                # unrounded: JSON round-trips doubles losslessly, and the
+                # replay contract (DESIGN.md §11) is EXACT equality with
+                # re-drawing this identity through _unit
+                ev["u"] = u
+                ev["threshold"] = p
+            if delay_s is not None:
+                ev["delay_s"] = round(delay_s, 6)
+            self.sink.emit("fault", **ev)
 
     def dropped(self, kind: str | None = None) -> list[dict]:
         """Recorded drop events (requires ``record_log=True``)."""

@@ -1,0 +1,108 @@
+"""Unified telemetry subsystem (ISSUE 3; SURVEY.md §5).
+
+One bundle, three legs:
+
+- ``MetricsRegistry`` (``registry.py``): counters / gauges / histograms
+  with labels, Prometheus-text + JSON export, and the flattened
+  ``counts()`` emission ``scripts/perf_gate.py`` gates on;
+- ``EventBus`` (``events.py``): schema-versioned JSONL — message
+  lifecycle spans from ``sim/driver.py``, fault attribution from
+  ``sim/faults.py``, degradation/fallback from ``ops/resident.py`` and
+  ``utils/watchdog.py``; consumed offline by ``scripts/run_report.py``;
+- JAX runtime telemetry (``jaxrt.py``): recompile/trace/lowering counts,
+  compile-duration histograms, dispatch + transfer-byte counters, folded
+  into the same registry.
+
+Two attachment modes:
+
+- **scoped**: pass a ``Telemetry`` to ``Simulation(telemetry=...)`` — the
+  driver emits spans/slot records to that bus only (parallel sims don't
+  interleave);
+- **global sink**: components with no natural handle to a bus
+  (``ops/resident.py`` degradation, ``utils/watchdog.py`` incidents)
+  call ``emit_global``, a no-op until some harness calls
+  ``set_global``/``Telemetry.install_global``.
+
+Telemetry is **not simulation state**: ``Simulation.checkpoint`` excludes
+it (exactly like wall-clock handler timings), and a resumed run records
+only post-resume events.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from pos_evolution_tpu.telemetry.events import (
+    SCHEMA_VERSION,
+    EventBus,
+    read_jsonl,
+)
+from pos_evolution_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+__all__ = [
+    "SCHEMA_VERSION", "EventBus", "read_jsonl",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "Telemetry", "set_global", "get_global", "emit_global",
+]
+
+
+@dataclass
+class Telemetry:
+    """The bundle a harness threads through a run: one bus, one registry,
+    and the debug flag that arms ``StoreInvariantChecker`` in the driver
+    (snapshot/compare around every handler call — too slow for benches,
+    exactly right for fault hunts)."""
+
+    bus: EventBus = field(default_factory=EventBus)
+    registry: MetricsRegistry = field(default_factory=MetricsRegistry)
+    debug: bool = False
+
+    @classmethod
+    def to_file(cls, path, debug: bool = False,
+                keep_in_memory: bool = True) -> "Telemetry":
+        return cls(bus=EventBus(path, keep_in_memory=keep_in_memory),
+                   debug=debug)
+
+    def install_jax_runtime(self) -> bool:
+        """Fold JAX compiler/dispatch/transfer telemetry into this
+        bundle's registry (process-global listeners; last install wins)."""
+        from pos_evolution_tpu.telemetry import jaxrt
+        return jaxrt.install(self.registry)
+
+    def install_global(self) -> "Telemetry":
+        """Also make this bundle the global sink for bus-less emitters
+        (resident degradation, watchdog incidents)."""
+        set_global(self)
+        return self
+
+    def close(self) -> None:
+        self.bus.close()
+
+
+_GLOBAL: list = [None]
+
+
+def set_global(telemetry: Telemetry | None) -> None:
+    _GLOBAL[0] = telemetry
+
+
+def get_global() -> Telemetry | None:
+    return _GLOBAL[0]
+
+
+def emit_global(type_: str, **fields) -> dict | None:
+    """Emit onto the global bus if one is installed; no-op otherwise.
+    The call sites (degradation paths, watchdog incidents) must never
+    fail because telemetry is absent or broken."""
+    t = _GLOBAL[0]
+    if t is None:
+        return None
+    try:
+        return t.bus.emit(type_, **fields)
+    except Exception:
+        return None

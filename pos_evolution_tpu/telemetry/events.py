@@ -1,0 +1,126 @@
+"""Structured event bus: schema-versioned JSONL with span lineage.
+
+Every event is one JSON object per line, self-describing enough that
+``scripts/run_report.py`` can reconstruct a run — finality timeline,
+per-handler percentiles, fault attribution — **without access to the live
+``Simulation``** (the acceptance contract of ISSUE 3).
+
+Envelope (schema v1):
+
+    {"v": 1, "seq": <int>, "type": "<event type>", ...payload...}
+
+- ``seq`` is a per-bus monotonic ordinal: JSONL has no transactional
+  ordering guarantee across writers, so consumers sort by ``seq``;
+- span events additionally carry ``span`` (this event's id) and
+  ``parent`` (the id of the causally preceding span, or null at the
+  root). Span ids are **deterministic message identities**
+  (``blk-<slot>-<proposer>``, ``att-<slot>-g<group>-c<committee>``, and
+  per-edge ``…/g<dst>`` suffixes), not random uuids — the same run
+  always produces the same lineage, which is what lets tests pin
+  parent/child integrity across checkpoint/resume;
+- ``t`` is SIMULATION time where the emitter has one (delivery events).
+  The bus itself never stamps absolute wall-clock onto the envelope;
+  emitters may still include measured fields (``duration_ms`` on
+  deliveries, ``unix``/``elapsed_s`` on watchdog incidents), so golden
+  JSONL fixtures are hand-authored, not regenerated from live runs.
+
+The bus is deliberately not simulation state: ``Simulation.checkpoint``
+excludes it (like wall-clock handler timings), and a resumed run records
+only post-resume events.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+
+SCHEMA_VERSION = 1
+
+
+class EventBus:
+    """Append-only event sink: in-memory list + optional JSONL file.
+
+    ``path=None`` keeps events in memory only (tests, ad-hoc runs); with a
+    path every ``emit`` writes one line immediately (line-buffered), so a
+    crashed run still leaves a parseable prefix — the commit-on-arrival
+    posture of ``utils/watchdog.py`` applied to events.
+    """
+
+    def __init__(self, path: str | os.PathLike | None = None,
+                 keep_in_memory: bool = True):
+        self.path = os.fspath(path) if path is not None else None
+        self.keep_in_memory = keep_in_memory
+        self.events: list[dict] = []
+        self._seq = 0
+        self._fh: io.TextIOBase | None = None
+        if self.path is not None:
+            self._fh = open(self.path, "w", buffering=1)
+
+    # -- emission --------------------------------------------------------------
+
+    def emit(self, type_: str, *, span: str | None = None,
+             parent: str | None = None, **fields) -> dict:
+        """Record one event; returns the envelope (callers chain span ids
+        off it). Payload values must be JSON-serializable."""
+        ev = {"v": SCHEMA_VERSION, "seq": self._seq, "type": type_}
+        self._seq += 1
+        if span is not None:
+            ev["span"] = span
+        if parent is not None:
+            ev["parent"] = parent
+        ev.update(fields)
+        if self.keep_in_memory:
+            self.events.append(ev)
+        if self._fh is not None:
+            self._fh.write(json.dumps(ev, sort_keys=True) + "\n")
+        return ev
+
+    # -- queries (test/report convenience on the in-memory view) ---------------
+
+    def of_type(self, type_: str) -> list[dict]:
+        return [e for e in self.events if e["type"] == type_]
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "EventBus":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_jsonl(path: str | os.PathLike) -> list[dict]:
+    """Load a JSONL event log back into memory, sorted by ``seq``.
+
+    Tolerates a torn FINAL line (a run killed mid-write) — everything
+    before it is still usable, which is the point of line-at-a-time
+    commit. A decode error anywhere EARLIER is corruption, not a torn
+    tail, and raises with the line number: silently dropping the suffix
+    would present a truncated run as a complete one. Also raises on an
+    unknown schema version: consumers must not misread future formats.
+    """
+    with open(path) as fh:
+        lines = [(i + 1, line.strip()) for i, line in enumerate(fh)]
+    lines = [(ln, text) for ln, text in lines if text]
+    events = []
+    for pos, (ln, text) in enumerate(lines):
+        try:
+            ev = json.loads(text)
+        except json.JSONDecodeError:
+            if pos == len(lines) - 1:
+                break  # torn tail from a killed writer
+            raise ValueError(
+                f"{os.fspath(path)}:{ln}: corrupt event line mid-log "
+                f"(only the final line may be torn)")
+        v = ev.get("v")
+        if v != SCHEMA_VERSION:
+            raise ValueError(
+                f"unknown telemetry schema version {v!r} "
+                f"(this reader understands v{SCHEMA_VERSION})")
+        events.append(ev)
+    events.sort(key=lambda e: e.get("seq", 0))
+    return events

@@ -1,0 +1,103 @@
+"""JAX runtime telemetry folded into a ``MetricsRegistry``.
+
+Three sources, one namespace:
+
+- **compiler events** via ``jax.monitoring`` listeners:
+  ``jax_backend_compiles_total`` (one per XLA backend compile — the
+  "recompile count" the perf gate pins, since an unexpected recompile is
+  the classic silent TPU perf regression), ``jax_traces_total`` /
+  ``jax_lowerings_total`` (jaxpr trace / MLIR lowering passes), the
+  generic ``jax_events_total{event=...}``, and a
+  ``jax_compile_seconds`` histogram;
+- **dispatches**: ``record_dispatch()`` called from the call sites this
+  repo controls — ``utils/benchtime.fused_measure`` timed calls and the
+  ``ops/resident.py`` device paths (flush scatter batches, bucket head
+  queries). JAX exposes no public dispatch-count hook, so we count where
+  we dispatch rather than guessing at internals;
+- **host↔device transfers**: ``record_transfer(nbytes, direction)`` from
+  the same sites (the fused-measure checksum read-back, the resident
+  head index read-back).
+
+``jax.monitoring`` listener registration is process-global and
+irrevocable (``clear_event_listeners`` nukes everyone's), so ``install``
+registers ONE forwarding pair on first use and points it at the active
+registry; ``install(None)`` detaches without touching other listeners.
+Everything degrades to a no-op when jax or the monitoring module is
+absent — telemetry must never be the reason a NumPy-only run dies.
+"""
+
+from __future__ import annotations
+
+_STATE: dict = {"registry": None, "listeners_registered": False}
+
+# monitoring key -> counter name for the compile-pipeline stages the perf
+# gate cares about (everything else lands in jax_events_total{event=...})
+_DURATION_COUNTERS = {
+    "/jax/core/compile/backend_compile_duration": "jax_backend_compiles_total",
+    "/jax/core/compile/jaxpr_trace_duration": "jax_traces_total",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "jax_lowerings_total",
+}
+
+
+def current():
+    """The registry runtime events currently feed (None = detached)."""
+    return _STATE["registry"]
+
+
+def _on_event(event: str, **kw) -> None:
+    reg = _STATE["registry"]
+    if reg is not None:
+        reg.counter("jax_events_total",
+                    "jax.monitoring events by key").inc(event=event)
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    reg = _STATE["registry"]
+    if reg is None:
+        return
+    name = _DURATION_COUNTERS.get(event)
+    if name is not None:
+        reg.counter(name, f"count of {event}").inc()
+        reg.histogram("jax_compile_seconds",
+                      "compile-pipeline stage durations").observe(
+            duration, stage=event.rsplit("/", 1)[-1])
+    else:
+        reg.counter("jax_events_total",
+                    "jax.monitoring events by key").inc(event=event)
+
+
+def install(registry) -> bool:
+    """Point JAX runtime telemetry at ``registry`` (None detaches).
+    Returns True when the monitoring listeners are live."""
+    _STATE["registry"] = registry
+    if registry is None or _STATE["listeners_registered"]:
+        return _STATE["listeners_registered"]
+    try:
+        import jax.monitoring as monitoring
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _STATE["listeners_registered"] = True
+    except Exception:
+        # no jax / exotic build: counters still work via the explicit
+        # record_* helpers, compiler events just stay at zero
+        pass
+    return _STATE["listeners_registered"]
+
+
+# -- explicit hooks for the call sites this repo controls ----------------------
+
+def record_dispatch(n: int = 1, *, site: str = "unknown") -> None:
+    reg = _STATE["registry"]
+    if reg is not None:
+        reg.counter("jax_dispatches_total",
+                    "device computations dispatched from "
+                    "instrumented call sites").inc(n, site=site)
+
+
+def record_transfer(nbytes: int, *, direction: str = "d2h",
+                    site: str = "unknown") -> None:
+    reg = _STATE["registry"]
+    if reg is not None:
+        reg.counter("jax_transfer_bytes_total",
+                    "host<->device bytes moved by instrumented call "
+                    "sites").inc(int(nbytes), direction=direction, site=site)

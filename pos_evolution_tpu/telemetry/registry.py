@@ -1,0 +1,202 @@
+"""``MetricsRegistry``: named counters / gauges / histograms with label
+support and Prometheus-text + JSON export (SURVEY.md §5 "structured
+metrics"; the prose reference has none).
+
+Design constraints, in order:
+
+- **host-side and allocation-light** — metrics are updated from the sim
+  driver's per-message hot loop and from ``ops/resident.py`` device-call
+  sites, so one update must be a dict lookup + integer add, never I/O
+  (export is pull-based: ``to_prometheus()`` / ``to_json()`` walk the
+  registry when asked);
+- **labels as sorted key-tuples** — the Prometheus data model
+  (``name{k="v"}``) without a client-library dependency (nothing may be
+  pip-installed in this image);
+- **counts are the contract** — ``scripts/perf_gate.py`` gates on count
+  metrics (recompiles, handler calls, dispatches) because counts are
+  deterministic on CPU CI where timings are not. ``counts()`` flattens
+  every counter into one {name[;labels]: int} dict for exactly that
+  consumer.
+"""
+
+from __future__ import annotations
+
+import json
+from bisect import bisect_left
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _label_text(key: tuple) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self.series: dict[tuple, object] = {}
+
+    def _prom_header(self) -> list[str]:
+        out = []
+        if self.help:
+            out.append(f"# HELP {self.name} {self.help}")
+        out.append(f"# TYPE {self.name} {self.kind}")
+        return out
+
+    def to_prometheus(self) -> list[str]:
+        """One scalar sample per labelled series (Histogram overrides)."""
+        out = self._prom_header()
+        for key in sorted(self.series):
+            out.append(f"{self.name}{_label_text(key)} {self.series[key]}")
+        return out
+
+
+class Counter(_Metric):
+    """Monotonically increasing count, optionally labelled."""
+
+    kind = "counter"
+
+    def inc(self, amount: int | float = 1, **labels) -> None:
+        assert amount >= 0, "counters only go up"
+        key = _label_key(labels)
+        self.series[key] = self.series.get(key, 0) + amount
+
+    def value(self, **labels):
+        return self.series.get(_label_key(labels), 0)
+
+
+class Gauge(_Metric):
+    """Last-write-wins scalar (queue depths, capacities, lag)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        self.series[_label_key(labels)] = value
+
+    def inc(self, amount: float = 1, **labels) -> None:
+        key = _label_key(labels)
+        self.series[key] = self.series.get(key, 0) + amount
+
+    def value(self, **labels):
+        return self.series.get(_label_key(labels), 0)
+
+
+# Default bounds sized for handler latencies in seconds: 0.1 ms .. ~13 s.
+_DEFAULT_BUCKETS = tuple(0.0001 * 2 ** i for i in range(18))
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations <= its upper bound; +Inf is implicit)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str = "",
+                 buckets: tuple = _DEFAULT_BUCKETS):
+        super().__init__(name, help_)
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        row = self.series.get(key)
+        if row is None:
+            row = {"bucket_counts": [0] * len(self.buckets),
+                   "sum": 0.0, "count": 0}
+            self.series[key] = row
+        i = bisect_left(self.buckets, value)
+        if i < len(self.buckets):
+            row["bucket_counts"][i] += 1
+        row["sum"] += value
+        row["count"] += 1
+
+    def value(self, **labels) -> dict | None:
+        return self.series.get(_label_key(labels))
+
+    def to_prometheus(self) -> list[str]:
+        out = self._prom_header()
+        for key in sorted(self.series):
+            row = self.series[key]
+            cum = 0
+            for le, c in zip(self.buckets, row["bucket_counts"]):
+                cum += c
+                bkey = key + (("le", repr(float(le))),)
+                out.append(f"{self.name}_bucket{_label_text(bkey)} {cum}")
+            bkey = key + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_label_text(bkey)} {row['count']}")
+            out.append(f"{self.name}_sum{_label_text(key)} {row['sum']}")
+            out.append(f"{self.name}_count{_label_text(key)} {row['count']}")
+        return out
+
+
+class MetricsRegistry:
+    """One namespace of metrics; get-or-create accessors so call sites
+    never need registration order."""
+
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get(self, cls, name: str, help_: str, **kw):
+        m = self._metrics.get(name)
+        if m is None:
+            m = cls(name, help_, **kw)
+            self._metrics[name] = m
+        assert isinstance(m, cls), \
+            f"metric {name!r} already registered as {m.kind}"
+        return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(Gauge, name, help_)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help_, buckets=buckets)
+
+    # -- export ----------------------------------------------------------------
+
+    def to_prometheus(self) -> str:
+        lines = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].to_prometheus())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_json(self) -> dict:
+        out = {}
+        for name, m in sorted(self._metrics.items()):
+            series = []
+            for key, val in sorted(m.series.items()):
+                entry = {"labels": dict(key)}
+                if m.kind == "histogram":
+                    entry.update(val)
+                    entry["buckets"] = list(m.buckets)
+                else:
+                    entry["value"] = val
+                series.append(entry)
+            out[name] = {"kind": m.kind, "help": m.help, "series": series}
+        return out
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_json(), indent=1, sort_keys=True)
+
+    def counts(self) -> dict[str, int | float]:
+        """Flatten all counters (and histogram counts) into one
+        {name[;k=v;...]: value} dict — the count-based emission
+        ``scripts/perf_gate.py`` gates on."""
+        out: dict[str, int | float] = {}
+        for name, m in sorted(self._metrics.items()):
+            for key, val in sorted(m.series.items()):
+                suffix = "".join(f";{k}={v}" for k, v in key)
+                if m.kind == "counter":
+                    out[name + suffix] = val
+                elif m.kind == "histogram":
+                    out[name + suffix + ";stat=count"] = val["count"]
+        return out

@@ -31,6 +31,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pos_evolution_tpu.telemetry import jaxrt
+
 
 def checksum_tree(out) -> jax.Array:
     """i32 checksum covering EVERY element of every leaf (wraparound sums:
@@ -61,8 +63,14 @@ def fused_measure(body, *, k_hi: int = 4, entropy: int | None = None,
 
     def t_of(k: int, salt0: int) -> float:
         t0 = time.perf_counter()
-        np.asarray(run(jnp.int32(k), jnp.int32(salt0)))  # transfer = sync
-        return time.perf_counter() - t0
+        out = np.asarray(run(jnp.int32(k), jnp.int32(salt0)))  # transfer = sync
+        elapsed = time.perf_counter() - t0
+        # runtime telemetry (no-ops unless a registry is installed): one
+        # dispatch + one d2h checksum transfer per timed call
+        jaxrt.record_dispatch(site="fused_measure")
+        jaxrt.record_transfer(out.nbytes, direction="d2h",
+                              site="fused_measure")
+        return elapsed
 
     t_of(1, ent)                                         # compile + warm
     t1 = min(t_of(1, ent + 11 + r) for r in range(reps))

@@ -46,22 +46,36 @@ class HandlerTimer:
         xs = self.samples.get(name, [])
         return float(np.percentile(xs, q)) if xs else float("nan")
 
+    def reset(self) -> None:
+        """Drop all samples — benches call this after warm-up/compile
+        iterations so measured percentiles cover only the steady state."""
+        self.samples.clear()
+
     def summary(self) -> dict:
+        # an empty sample list (a handler registered but never hit, or a
+        # summary taken right after reset()) must not crash np.percentile
         return {
             name: {
                 "count": len(xs),
-                "p50_ms": round(float(np.percentile(xs, 50)) * 1e3, 4),
-                "p95_ms": round(float(np.percentile(xs, 95)) * 1e3, 4),
-                "total_s": round(float(np.sum(xs)), 4),
+                "p50_ms": round(float(np.percentile(xs, 50)) * 1e3, 4)
+                if xs else float("nan"),
+                "p95_ms": round(float(np.percentile(xs, 95)) * 1e3, 4)
+                if xs else float("nan"),
+                "total_s": round(float(np.sum(xs)), 4) if xs else 0.0,
             }
             for name, xs in self.samples.items()
         }
 
 
-def slot_record(store, slot: int) -> dict:
-    """Structured per-slot log entry (SURVEY.md §5 metrics)."""
-    from pos_evolution_tpu.specs.forkchoice import get_head
-    head = get_head(store)
+def slot_record(store, slot: int, head: bytes | None = None) -> dict:
+    """Structured per-slot log entry (SURVEY.md §5 metrics).
+
+    ``head`` lets a caller that already ran the head query (the sim
+    driver, whose accelerated path answers from the device-resident
+    store) pass it in instead of paying a second spec walk."""
+    if head is None:
+        from pos_evolution_tpu.specs.forkchoice import get_head
+        head = get_head(store)
     head_state = store.block_states[head]
     n = len(head_state.validators)
     participation = (

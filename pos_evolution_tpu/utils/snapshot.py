@@ -212,17 +212,23 @@ def save_simulation(sim) -> bytes:
     return out.getvalue()
 
 
-def load_simulation(data: bytes, schedule=None):
+def load_simulation(data: bytes, schedule=None, telemetry=None):
     """Rebuild a ``save_simulation`` checkpoint into a live Simulation.
     ``schedule`` must be the run's original Schedule (with its FaultPlan)
-    for faithful replay; crash flags re-derive from the plan + slot."""
+    for faithful replay; crash flags re-derive from the plan + slot.
+    ``telemetry`` re-attaches an event bus (not sim state; queue span ids
+    are not serialized, so pre-checkpoint deliveries re-emitted after a
+    resume carry no parent lineage)."""
     from pos_evolution_tpu.sim.driver import Simulation, _QueuedMessage
     buf = io.BytesIO(data)
     meta = json.loads(_unframe(buf).decode())
     assert meta["version"] == 1, f"unknown snapshot version {meta['version']}"
     # build the skeleton WITHOUT residents: __init__ would densify every
     # genesis store only for the mirrors to be rebuilt from the restored
-    # stores below — at registry scale that doubles resume latency
+    # stores below — at registry scale that doubles resume latency.
+    # Telemetry attaches AFTER the restore (below), not here: __init__
+    # would emit a run_start describing the skeleton (accelerated=False,
+    # slot 0) instead of the checkpointed run.
     sim = Simulation(meta["n_validators"], schedule=schedule,
                      genesis_time=meta["genesis_time"],
                      accelerated_forkchoice=False)
@@ -265,6 +271,26 @@ def load_simulation(data: bytes, schedule=None):
             g.resident.incidents = (list(rm.get("incidents", []))
                                     + g.resident.incidents)
             g.resident._head_queries = rm.get("head_queries", 0)
+    if telemetry is not None:
+        # attach to the fully restored run: groups get the bus, the debug
+        # checker anchors on the RESTORED stores, the fault sink is
+        # claimed for this run, and run_start describes the checkpointed
+        # state (not the skeleton)
+        sim.telemetry = telemetry
+        for g in sim.groups:
+            g.telemetry = telemetry
+            if telemetry.debug:
+                from pos_evolution_tpu.utils.metrics import (
+                    StoreInvariantChecker,
+                )
+                g.invariants = StoreInvariantChecker(g.store)
+        if sim.schedule.faults is not None:
+            sim.schedule.faults.sink = telemetry.bus
+        telemetry.bus.emit(
+            "run_start", n_validators=sim.n_validators,
+            n_groups=sim.schedule.n_groups, genesis_time=sim.genesis_time,
+            accelerated_forkchoice=sim.accelerated_forkchoice,
+            debug=telemetry.debug, resumed_at_slot=sim.slot)
     return sim
 
 

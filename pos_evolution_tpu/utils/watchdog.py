@@ -129,14 +129,18 @@ class Watchdog:
                 if isinstance(e, WatchdogTimeout) and not armed:
                     raise   # an ENCLOSING supervisor's alarm, not ours —
                             # let it unwind to the step that owns it
-                self.incidents.append({
+                incident = {
                     "step": name,
                     "attempt": attempt,
                     "error": f"{type(e).__name__}: {e}"[:400],
                     "elapsed_s": round(time.time() - t0, 3),
                     "unix": round(time.time(), 3),
-                })
+                }
+                self.incidents.append(incident)
                 self.commit()
+                from pos_evolution_tpu.telemetry import emit_global
+                emit_global("watchdog_incident", tag=self.tag,
+                            retries_left=attempts - attempt - 1, **incident)
                 if attempt + 1 < attempts:
                     time.sleep(self.backoff_s * 2 ** attempt)
                 continue

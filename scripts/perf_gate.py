@@ -1,0 +1,217 @@
+"""Perf-regression gate: compare a fresh bench/report emission against a
+baseline on COUNT-based metrics; timing metrics are report-only by
+default.
+
+Why counts: on CPU CI, wall-clock is noise, but the counters the
+telemetry registry tracks — XLA recompiles, handler calls, device
+dispatches — are deterministic properties of the code path taken. A PR
+that doubles ``jax_backend_compiles_total`` or starts rejecting half the
+on_block calls regressed the hot path even if this box can't time it;
+that is exactly the class of silent TPU regression this gate exists to
+catch before a device run does.
+
+Accepted emissions (count sources, in order of preference):
+
+- a bench emission (``bench.py`` / ``bench_all.py`` JSON) with a
+  ``telemetry.counts`` mapping (flattened ``MetricsRegistry.counts()``);
+- a ``scripts/run_report.py`` ``--json`` report (handler call counts);
+- any JSON whose top level has a ``counts`` mapping.
+
+Gate rule, per count key present in BOTH emissions:
+
+    candidate <= baseline * rel_tol + abs_slack        (default 1.25 / 4)
+
+Count keys present on only one side are listed and skipped (a new
+counter is not a regression; a vanished one is suspicious but may be a
+renamed metric — the listing makes it visible either way). If NO count
+key is comparable: when the baseline carries no counts at all
+(pre-telemetry emission) the gate passes vacuously, loudly; when BOTH
+sides carry counts in disjoint namespaces (e.g. a bench emission vs a
+run report) the shapes are incomparable and the gate refuses with
+exit 2 rather than manufacture a vacuous pass.
+
+Timing keys (``value`` seconds, ``*_ms`` leaves) are compared as ratios
+and printed; they fail the gate only under ``--strict-timing`` (meant
+for same-hardware A/B runs, never CPU CI).
+
+Usage:
+    python scripts/perf_gate.py --candidate fresh.json
+        [--baseline BENCH_r05.json] [--rel-tol 1.25] [--abs-slack 4]
+        [--count-only] [--strict-timing]
+
+``--baseline`` defaults to the newest ``BENCH_r*.json`` /
+``BENCH_ALL_r*.json`` in the repo root, falling back to
+``BASELINE.json``. Exit 0 = pass, 1 = regression, 2 = usage error or
+incomparable emission shapes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def extract_counts(obj: dict) -> dict[str, float]:
+    """Flat {metric-key: numeric} count emission from any accepted shape."""
+    out: dict[str, float] = {}
+    tel = obj.get("telemetry")
+    if isinstance(tel, dict):
+        counts = tel.get("counts", tel)
+        for k, v in counts.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[k] = v
+    if isinstance(obj.get("counts"), dict):
+        for k, v in obj["counts"].items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[k] = v
+    # registry counts() keys carry the status label
+    # (handler_calls_total;handler=X;status=Y); fold in the per-handler
+    # aggregate so they intersect the report-derived keys below
+    agg: dict[str, float] = {}
+    for k, v in out.items():
+        if k.startswith("handler_calls_total;handler=") and ";status=" in k:
+            base = k.split(";status=", 1)[0]
+            agg[base] = agg.get(base, 0) + v
+    out.update(agg)
+    handlers = obj.get("handlers")
+    if isinstance(handlers, dict):  # run_report.py --json shape
+        for name, row in handlers.items():
+            if isinstance(row, dict) and isinstance(row.get("count"), int):
+                out[f"handler_calls_total;handler={name}"] = row["count"]
+    return out
+
+
+def extract_timings(obj: dict, prefix: str = "") -> dict[str, float]:
+    """Numeric timing leaves: the bench headline ``value`` (seconds) and
+    any ``*_ms`` / ``*_s`` key, recursively."""
+    out: dict[str, float] = {}
+    for k, v in obj.items():
+        path = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(extract_timings(v, path))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            if k == "value" or re.search(r"(^|_)ms(_|$)|_s$|_seconds$", k):
+                out[path] = float(v)
+    return out
+
+
+def default_baseline() -> str | None:
+    def round_of(path: str) -> int:
+        m = re.search(r"_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
+    cands = sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json"))
+                   + glob.glob(os.path.join(_REPO, "BENCH_ALL_r*.json")),
+                   key=round_of)
+    if cands:
+        return cands[-1]
+    base = os.path.join(_REPO, "BASELINE.json")
+    return base if os.path.exists(base) else None
+
+
+def gate(baseline: dict, candidate: dict, rel_tol: float, abs_slack: float,
+         count_only: bool = True, strict_timing: bool = False,
+         out=sys.stdout) -> int:
+    """Compare two emissions; returns the process exit code."""
+    b_counts, c_counts = extract_counts(baseline), extract_counts(candidate)
+    shared = sorted(set(b_counts) & set(c_counts))
+    failures = []
+    print(f"count metrics: {len(shared)} comparable "
+          f"({len(c_counts) - len(shared)} candidate-only, "
+          f"{len(b_counts) - len(shared)} baseline-only)", file=out)
+    for key in shared:
+        b, c = b_counts[key], c_counts[key]
+        limit = b * rel_tol + abs_slack
+        verdict = "FAIL" if c > limit else "ok"
+        if c > limit:
+            failures.append(key)
+        print(f"  [{verdict}] {key}: baseline={b} candidate={c} "
+              f"limit={limit:.1f}", file=out)
+    for key in sorted(set(c_counts) - set(b_counts)):
+        print(f"  [skip] {key}: no baseline (candidate={c_counts[key]})",
+              file=out)
+    for key in sorted(set(b_counts) - set(c_counts)):
+        print(f"  [skip] {key}: vanished from candidate "
+              f"(baseline={b_counts[key]})", file=out)
+    if not shared:
+        if b_counts and c_counts:
+            # both emissions carry counts but in disjoint namespaces —
+            # comparing a bench emission against a run report, or two
+            # incompatible formats. Passing here would let a real
+            # regression ship behind a "vacuous pass".
+            print("  both emissions have counts but share NO keys — "
+                  "incomparable emission shapes; refusing to gate",
+                  file=out)
+            return 2
+        print("  no comparable count metrics — gate passes VACUOUSLY "
+              "(baseline predates telemetry counts?)", file=out)
+
+    if not count_only:
+        b_times, c_times = (extract_timings(baseline),
+                            extract_timings(candidate))
+        t_shared = sorted(set(b_times) & set(c_times))
+        print(f"timing metrics ({'GATED' if strict_timing else 'report-only'}"
+              f"): {len(t_shared)} comparable", file=out)
+        for key in t_shared:
+            b, c = b_times[key], c_times[key]
+            ratio = c / b if b else float("inf")
+            flag = strict_timing and ratio > rel_tol
+            if flag:
+                failures.append(f"timing:{key}")
+            print(f"  [{'FAIL' if flag else '--'}] {key}: "
+                  f"baseline={b:.6g} candidate={c:.6g} ratio={ratio:.3f}",
+                  file=out)
+
+    if failures:
+        print(f"PERF GATE: FAIL ({len(failures)} regression"
+              f"{'s' if len(failures) != 1 else ''}): "
+              + ", ".join(failures), file=out)
+        return 1
+    print("PERF GATE: pass", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--candidate", required=True,
+                    help="fresh bench/report JSON emission")
+    ap.add_argument("--baseline",
+                    help="baseline emission (default: newest BENCH_*.json, "
+                         "else BASELINE.json)")
+    ap.add_argument("--rel-tol", type=float, default=1.25)
+    ap.add_argument("--abs-slack", type=float, default=4.0)
+    ap.add_argument("--count-only", action="store_true",
+                    help="skip the timing report entirely (CPU CI mode)")
+    ap.add_argument("--strict-timing", action="store_true",
+                    help="timing regressions also fail the gate "
+                         "(same-hardware A/B only)")
+    args = ap.parse_args(argv)
+
+    baseline_path = args.baseline or default_baseline()
+    if baseline_path is None or not os.path.exists(baseline_path):
+        print(f"perf_gate: no baseline found ({baseline_path!r})",
+              file=sys.stderr)
+        return 2
+    try:
+        with open(baseline_path) as fh:
+            baseline = json.load(fh)
+        with open(args.candidate) as fh:
+            candidate = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"perf_gate: {e}", file=sys.stderr)
+        return 2
+    print(f"baseline:  {baseline_path}")
+    print(f"candidate: {args.candidate}")
+    return gate(baseline, candidate, args.rel_tol, args.abs_slack,
+                count_only=args.count_only,
+                strict_timing=args.strict_timing)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

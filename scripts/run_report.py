@@ -1,0 +1,274 @@
+"""Run report: telemetry JSONL -> markdown / JSON, offline.
+
+Reconstructs, from the event log alone (no live ``Simulation``):
+
+- the **finality timeline** — per-slot justified/finalized epochs from
+  ``slot`` events, plus the slots where finality actually advanced;
+- **fault attribution vs. effects** — per-(action, kind) counts from the
+  ``fault`` events ``sim/faults.py`` emits (these match the FaultPlan's
+  seeded decisions exactly: same code path records both), next to the
+  observable effects (childless gossip edges ≈ drops, handler rejects,
+  invariant violations, crash/rejoin, degradations, watchdog incidents);
+- **handler percentiles** — p50/p95/count over every event carrying
+  ``handler`` + ``duration_ms`` (deliveries and ``get_head`` queries);
+- **light-client lag** — worst/final head- and finality-lag per node;
+- **top device ops** — folded in from a ``bench_trace/top_ops.json``
+  passed via ``--top-ops`` (the xplane summary of
+  ``scripts/trace_summary.py``), when one exists.
+
+Usage:
+    python scripts/run_report.py events.jsonl [--json out.json]
+                                 [--markdown out.md] [--top-ops top_ops.json]
+
+Markdown goes to stdout unless ``--markdown`` is given.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pos_evolution_tpu.telemetry import read_jsonl  # noqa: E402
+
+
+def _percentile(xs: list[float], q: float) -> float:
+    """Linear-interpolation percentile (numpy 'linear' method) — kept
+    dependency-free so the report runs anywhere python does."""
+    if not xs:
+        return float("nan")
+    xs = sorted(xs)
+    if len(xs) == 1:
+        return xs[0]
+    pos = (len(xs) - 1) * q / 100.0
+    lo = int(pos)
+    frac = pos - lo
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] * (1 - frac) + xs[hi] * frac
+
+
+def build_report(events: list[dict], top_ops: dict | None = None) -> dict:
+    """Pure JSONL -> report-dict transform (the testable core)."""
+    by_type: dict[str, list[dict]] = {}
+    for ev in events:
+        by_type.setdefault(ev["type"], []).append(ev)
+
+    run_start = (by_type.get("run_start") or [{}])[0]
+
+    # -- finality timeline ----------------------------------------------------
+    slots = by_type.get("slot", [])
+    timeline = [{"slot": e["slot"], "head_slot": e.get("head_slot"),
+                 "justified_epoch": e.get("justified_epoch"),
+                 "finalized_epoch": e.get("finalized_epoch"),
+                 "participation": e.get("participation")}
+                for e in slots]
+    advances = []
+    prev_fin = None
+    for row in timeline:
+        fin = row["finalized_epoch"]
+        if prev_fin is not None and fin is not None and fin > prev_fin:
+            advances.append({"slot": row["slot"], "finalized_epoch": fin})
+        if fin is not None:
+            prev_fin = fin
+
+    # -- fault attribution vs. effects ----------------------------------------
+    fault_counts: dict[str, dict[str, int]] = {}
+    for e in by_type.get("fault", []):
+        row = fault_counts.setdefault(e["action"], {})
+        row[e["kind"]] = row.get(e["kind"], 0) + 1
+    gossip_spans = {e["span"] for e in by_type.get("gossip", [])
+                    if e.get("span")}
+    delivered_parents = {e.get("parent") for e in by_type.get("deliver", [])}
+    rejects: dict[str, int] = {}
+    for e in by_type.get("deliver", []):
+        if e.get("status") == "reject":
+            rejects[e["handler"]] = rejects.get(e["handler"], 0) + 1
+    effects = {
+        "gossip_edges": len(gossip_spans),
+        "undelivered_gossip_edges": len(gossip_spans - delivered_parents),
+        "handler_rejects": rejects,
+        "invariant_violations": len(by_type.get("invariant_violation", [])),
+        "crashes": [{"group": e["group"], "slot": e["slot"],
+                     "lost_in_flight": e.get("lost_in_flight")}
+                    for e in by_type.get("crash", [])],
+        "rejoins": [{"group": e["group"], "slot": e["slot"],
+                     "sync_checkpoint_epoch": e.get("sync_checkpoint_epoch")}
+                    for e in by_type.get("rejoin", [])],
+        "degradations": [{"component": e.get("component"),
+                          "reason": e.get("reason")}
+                         for e in by_type.get("degradation", [])],
+        "watchdog_incidents": [{"tag": e.get("tag"), "step": e.get("step"),
+                                "error": e.get("error")}
+                               for e in by_type.get("watchdog_incident", [])],
+    }
+
+    # -- handler percentiles --------------------------------------------------
+    durations: dict[str, list[float]] = {}
+    for ev in events:
+        h = ev.get("handler")
+        d = ev.get("duration_ms")
+        if h is not None and d is not None:
+            durations.setdefault(h, []).append(float(d))
+    handlers = {
+        name: {"count": len(xs),
+               "p50_ms": round(_percentile(xs, 50), 4),
+               "p95_ms": round(_percentile(xs, 95), 4),
+               "total_ms": round(sum(xs), 3)}
+        for name, xs in sorted(durations.items())
+    }
+
+    # -- light clients --------------------------------------------------------
+    lc: dict[int, dict] = {}
+    for e in by_type.get("light_client_lag", []):
+        row = lc.setdefault(e.get("node", 0), {
+            "records": 0, "max_head_lag": 0, "max_finality_lag": 0,
+            "final_head_lag": None, "final_finality_lag": None})
+        row["records"] += 1
+        row["max_head_lag"] = max(row["max_head_lag"], e.get("head_lag", 0))
+        row["max_finality_lag"] = max(row["max_finality_lag"],
+                                      e.get("finality_lag", 0))
+        row["final_head_lag"] = e.get("head_lag")
+        row["final_finality_lag"] = e.get("finality_lag")
+
+    report = {
+        "schema_version": events[0]["v"] if events else None,
+        "n_events": len(events),
+        "run": {k: run_start.get(k) for k in
+                ("n_validators", "n_groups", "accelerated_forkchoice",
+                 "debug") if k in run_start},
+        "finality": {
+            "timeline": timeline,
+            "advances": advances,
+            "final_justified_epoch":
+                timeline[-1]["justified_epoch"] if timeline else None,
+            "final_finalized_epoch":
+                timeline[-1]["finalized_epoch"] if timeline else None,
+        },
+        "faults": {"counts": fault_counts, "effects": effects},
+        "handlers": handlers,
+        "light_clients": {str(k): v for k, v in sorted(lc.items())},
+    }
+    if top_ops:
+        report["top_device_ops"] = top_ops
+    return report
+
+
+# -- markdown rendering --------------------------------------------------------
+
+def _md_table(headers: list[str], rows: list[list]) -> list[str]:
+    out = ["| " + " | ".join(headers) + " |",
+           "|" + "|".join("---" for _ in headers) + "|"]
+    for r in rows:
+        out.append("| " + " | ".join(str(x) for x in r) + " |")
+    return out
+
+
+def to_markdown(report: dict) -> str:
+    md = ["# Run report", ""]
+    run = report.get("run", {})
+    md.append(f"- events: **{report['n_events']}** "
+              f"(schema v{report['schema_version']})")
+    if run:
+        md.append("- run: " + ", ".join(f"{k}={v}" for k, v in run.items()))
+    fin = report["finality"]
+    md += ["", "## Finality timeline", ""]
+    if fin["timeline"]:
+        md.append(f"- final justified epoch: "
+                  f"**{fin['final_justified_epoch']}**, "
+                  f"final finalized epoch: "
+                  f"**{fin['final_finalized_epoch']}**")
+        if fin["advances"]:
+            md += ["", *_md_table(
+                ["slot", "finalized epoch"],
+                [[a["slot"], a["finalized_epoch"]] for a in fin["advances"]])]
+        else:
+            md.append("- finality never advanced")
+    else:
+        md.append("- no slot events in the log")
+
+    faults = report["faults"]
+    md += ["", "## Faults: attribution vs. effects", ""]
+    if faults["counts"]:
+        rows = [[action, kind, n]
+                for action, kinds in sorted(faults["counts"].items())
+                for kind, n in sorted(kinds.items())]
+        md += _md_table(["action", "kind", "count"], rows)
+    else:
+        md.append("- no fault events (clean network or no FaultPlan sink)")
+    eff = faults["effects"]
+    md += ["",
+           f"- gossip edges: {eff['gossip_edges']} "
+           f"(undelivered: {eff['undelivered_gossip_edges']})",
+           f"- handler rejects: {eff['handler_rejects'] or 'none'}",
+           f"- invariant violations: {eff['invariant_violations']}",
+           f"- crashes: {eff['crashes'] or 'none'}",
+           f"- rejoins: {eff['rejoins'] or 'none'}"]
+    if eff["degradations"]:
+        md.append(f"- degradations: {eff['degradations']}")
+    if eff["watchdog_incidents"]:
+        md.append(f"- watchdog incidents: {eff['watchdog_incidents']}")
+
+    md += ["", "## Handler percentiles", ""]
+    if report["handlers"]:
+        md += _md_table(
+            ["handler", "count", "p50 ms", "p95 ms", "total ms"],
+            [[h, v["count"], v["p50_ms"], v["p95_ms"], v["total_ms"]]
+             for h, v in report["handlers"].items()])
+    else:
+        md.append("- no handler timings in the log")
+
+    if report.get("light_clients"):
+        md += ["", "## Light clients", ""]
+        md += _md_table(
+            ["node", "records", "max head lag", "max finality lag",
+             "final head lag", "final finality lag"],
+            [[k, v["records"], v["max_head_lag"], v["max_finality_lag"],
+              v["final_head_lag"], v["final_finality_lag"]]
+             for k, v in report["light_clients"].items()])
+
+    if report.get("top_device_ops"):
+        md += ["", "## Top device ops", ""]
+        for plane, rows in report["top_device_ops"].items():
+            md.append(f"### {plane}")
+            md += _md_table(["op", "total ms", "count"],
+                            [[r["op"], r["total_ms"], r["count"]]
+                             for r in rows])
+            md.append("")
+    return "\n".join(md) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("events", help="telemetry JSONL file")
+    ap.add_argument("--json", help="write the report dict to this path")
+    ap.add_argument("--markdown",
+                    help="write markdown here instead of stdout")
+    ap.add_argument("--top-ops",
+                    help="bench_trace/top_ops.json to fold into the report")
+    args = ap.parse_args(argv)
+
+    events = read_jsonl(args.events)
+    top_ops = None
+    if args.top_ops and os.path.exists(args.top_ops):
+        with open(args.top_ops) as fh:
+            blob = json.load(fh)
+        top_ops = blob.get("planes", blob)
+    report = build_report(events, top_ops=top_ops)
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    md = to_markdown(report)
+    if args.markdown:
+        with open(args.markdown, "w") as fh:
+            fh.write(md)
+    else:
+        sys.stdout.write(md)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,563 @@
+"""Telemetry subsystem tests (ISSUE 3): MetricsRegistry semantics and
+export formats, EventBus span lineage across a faulted multi-group run,
+fault-attribution events agreeing with the FaultPlan's seeded decisions,
+the debug-gated StoreInvariantChecker wiring, run_report on a golden
+JSONL fixture, and the perf-regression gate's exit behavior."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from pos_evolution_tpu.config import minimal_config  # noqa: E402
+from pos_evolution_tpu.telemetry import (  # noqa: E402
+    SCHEMA_VERSION,
+    EventBus,
+    MetricsRegistry,
+    Telemetry,
+    emit_global,
+    read_jsonl,
+    set_global,
+)
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "golden_telemetry.jsonl")
+
+
+# -- registry ------------------------------------------------------------------
+
+class TestMetricsRegistry:
+    def test_counter_labels_and_values(self):
+        reg = MetricsRegistry()
+        c = reg.counter("requests_total", "help text")
+        c.inc()
+        c.inc(2, method="get")
+        c.inc(method="get")
+        assert c.value() == 1
+        assert c.value(method="get") == 3
+        assert reg.counter("requests_total") is c  # get-or-create
+
+    def test_counter_rejects_negative(self):
+        with pytest.raises(AssertionError):
+            MetricsRegistry().counter("c").inc(-1)
+
+    def test_kind_conflict_is_loud(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(AssertionError):
+            reg.gauge("x")
+
+    def test_gauge_set_and_inc(self):
+        g = MetricsRegistry().gauge("depth")
+        g.set(5, queue="a")
+        g.inc(2, queue="a")
+        g.set(-3)
+        assert g.value(queue="a") == 7
+        assert g.value() == -3
+
+    def test_histogram_buckets_sum_count(self):
+        h = MetricsRegistry().histogram("lat", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        row = h.value()
+        assert row["count"] == 5
+        assert row["sum"] == pytest.approx(56.05)
+        assert row["bucket_counts"] == [1, 2, 1]  # 50.0 -> +Inf only
+
+    def test_prometheus_text_format(self):
+        reg = MetricsRegistry()
+        reg.counter("hits_total", "hits").inc(3, route="/x")
+        reg.gauge("depth").set(2)
+        reg.histogram("lat", buckets=(1.0,)).observe(0.5)
+        text = reg.to_prometheus()
+        assert "# TYPE hits_total counter" in text
+        assert 'hits_total{route="/x"} 3' in text
+        assert "# HELP hits_total hits" in text
+        assert "# TYPE depth gauge" in text
+        assert "depth 2" in text
+        assert 'lat_bucket{le="1.0"} 1' in text
+        assert 'lat_bucket{le="+Inf"} 1' in text
+        assert "lat_count 1" in text
+
+    def test_json_export_and_counts(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total").inc(2, k="v")
+        reg.histogram("h", buckets=(1.0,)).observe(0.5)
+        reg.gauge("g").set(9)
+        blob = reg.to_json()
+        assert blob["a_total"]["kind"] == "counter"
+        assert blob["a_total"]["series"][0] == {"labels": {"k": "v"},
+                                                "value": 2}
+        counts = reg.counts()
+        assert counts == {"a_total;k=v": 2, "h;stat=count": 1}
+        json.dumps(blob)  # must be serializable as-is
+
+
+# -- event bus -----------------------------------------------------------------
+
+class TestEventBus:
+    def test_envelope_and_seq(self):
+        bus = EventBus()
+        e0 = bus.emit("a", x=1)
+        e1 = bus.emit("b", span="s1", parent="s0")
+        assert e0 == {"v": SCHEMA_VERSION, "seq": 0, "type": "a", "x": 1}
+        assert e1["seq"] == 1 and e1["span"] == "s1" and e1["parent"] == "s0"
+        assert bus.of_type("a") == [e0]
+
+    def test_jsonl_roundtrip_and_torn_tail(self, tmp_path):
+        path = tmp_path / "ev.jsonl"
+        with EventBus(path) as bus:
+            bus.emit("a", x=1)
+            bus.emit("b", y=2)
+        with open(path, "a") as fh:
+            fh.write('{"v": 1, "seq": 99, "type": "torn"')  # killed mid-write
+        events = read_jsonl(path)
+        assert [e["type"] for e in events] == ["a", "b"]
+
+    def test_midfile_corruption_raises_with_line_number(self, tmp_path):
+        """Only the FINAL line may be torn; corruption mid-log must be
+        loud — silently dropping the suffix would present a truncated
+        run as a complete one."""
+        path = tmp_path / "ev.jsonl"
+        path.write_text('{"v": 1, "seq": 0, "type": "a"}\n'
+                        '{"v": 1, "seq": 1, "ty\n'
+                        '{"v": 1, "seq": 2, "type": "c"}\n')
+        with pytest.raises(ValueError, match=":2: corrupt"):
+            read_jsonl(path)
+
+    def test_unknown_schema_version_raises(self, tmp_path):
+        path = tmp_path / "ev.jsonl"
+        path.write_text('{"v": 999, "seq": 0, "type": "future"}\n')
+        with pytest.raises(ValueError, match="schema version"):
+            read_jsonl(path)
+
+
+# -- driver integration: spans, faults, invariants -----------------------------
+
+def _faulted_sim(telemetry=None, n_groups=2, epochs=4, record_log=True):
+    from pos_evolution_tpu.sim import (
+        CrashWindow,
+        FaultPlan,
+        Simulation,
+        faulty_schedule,
+    )
+    c = minimal_config()
+    spe = c.slots_per_epoch
+    plan = FaultPlan(
+        seed=7, drop_p=0.15, duplicate_p=0.05, reorder_p=0.1,
+        gst=3 * spe * c.seconds_per_slot, record_log=record_log,
+        crashes=(CrashWindow(group=1, crash_slot=spe, rejoin_slot=2 * spe),))
+    sim = Simulation(32, schedule=faulty_schedule(32, plan, n_groups=n_groups),
+                     telemetry=telemetry)
+    sim.run_epochs(epochs)
+    return sim, plan
+
+
+class TestDriverTelemetry:
+    def test_span_parent_child_integrity(self):
+        """Every parent referenced by any event of a faulted multi-group
+        run must exist as an emitted span: propose/attest roots, gossip
+        edges, per-group deliveries."""
+        tel = Telemetry()
+        sim, plan = _faulted_sim(tel)
+        events = tel.bus.events
+        spans = {e["span"] for e in events if e.get("span")}
+        parents = {e["parent"] for e in events if e.get("parent")}
+        assert parents, "expected span lineage in a telemetry run"
+        assert parents <= spans, f"orphan parents: {parents - spans}"
+        for e in events:
+            if e["type"] == "deliver" and e.get("span"):
+                assert e["parent"] in spans
+                assert e["parent"].rsplit("/", 1)[0] in spans  # root span
+
+    def test_fault_events_match_plan_decisions_exactly(self):
+        tel = Telemetry()
+        sim, plan = _faulted_sim(tel)
+        from collections import Counter
+        by_event = Counter((e["action"], e["kind"])
+                           for e in tel.bus.of_type("fault"))
+        by_plan = Counter((e["action"], e["kind"]) for e in plan.log)
+        assert by_event == by_plan and by_plan, \
+            "fault attribution must mirror the plan's seeded decisions"
+
+    def test_fault_event_carries_replayable_hash_inputs(self):
+        """The (seed, tag, slot, src, msg_id, dst, u, threshold) payload
+        must let a consumer REPLAY the decision: drawing the recorded
+        identity through FaultPlan._unit reproduces u below threshold."""
+        tel = Telemetry()
+        sim, plan = _faulted_sim(tel)
+        idx_of = {"drop": 0, "reorder": 1, "duplicate": 3}
+        checked = 0
+        for e in tel.bus.of_type("fault"):
+            key = (e["tag"], e["slot"], e["src"], e["msg_id"], e["dst"])
+            u = plan._unit(idx_of[e["action"]], *key)
+            assert u == e["u"] and u < e["threshold"]
+            checked += 1
+        assert checked > 0
+
+    def test_telemetry_does_not_perturb_the_run(self):
+        """Attaching a bus/registry must not change a single per-slot
+        metric — observability is read-only by construction."""
+        ref, _ = _faulted_sim(None)
+        tel = Telemetry(debug=True)
+        sim, _ = _faulted_sim(tel)
+        assert sim.metrics == ref.metrics
+
+    def test_metrics_entries_superset_of_legacy_keys(self):
+        sim, _ = _faulted_sim(None, epochs=1)
+        legacy = {"slot", "head", "head_slot", "justified_epoch",
+                  "finalized_epoch", "n_blocks", "equivocators"}
+        rich = {"participation", "justification_bits", "n_latest_messages",
+                "head_root"}
+        for rec in sim.metrics:
+            assert legacy | rich <= set(rec)
+            assert rec["head"] == rec["head_root"][:8]
+
+    def test_checkpoint_resume_with_telemetry_stays_bit_identical(self):
+        from pos_evolution_tpu.sim import Simulation
+        ref, _ = _faulted_sim(None, epochs=4)
+        sim, plan = _faulted_sim(None, epochs=2)
+        data = sim.checkpoint()
+        from pos_evolution_tpu.sim import FaultPlan, CrashWindow, faulty_schedule
+        c = minimal_config()
+        spe = c.slots_per_epoch
+        plan2 = FaultPlan(
+            seed=7, drop_p=0.15, duplicate_p=0.05, reorder_p=0.1,
+            gst=3 * spe * c.seconds_per_slot,
+            crashes=(CrashWindow(group=1, crash_slot=spe,
+                                 rejoin_slot=2 * spe),))
+        tel = Telemetry()
+        back = Simulation.resume(
+            data, schedule=faulty_schedule(32, plan2, n_groups=2),
+            telemetry=tel)
+        back.run_epochs(4)
+        assert back.metrics == ref.metrics
+        assert tel.bus.of_type("slot"), "resumed run must keep recording"
+
+    def test_resume_with_reused_schedule_reclaims_fault_sink(self):
+        """Resuming with the ORIGINAL schedule object (the documented
+        contract — schedules hold callables) must re-point the plan's
+        fault sink at the NEW bus, not leak events onto the dead run's,
+        and the resumed run_start must describe the checkpointed state."""
+        from pos_evolution_tpu.sim import (
+            FaultPlan,
+            Simulation,
+            faulty_schedule,
+        )
+        c = minimal_config()
+        plan = FaultPlan(seed=3, drop_p=0.2,
+                         gst=3 * c.slots_per_epoch * c.seconds_per_slot)
+        sched = faulty_schedule(32, plan, n_groups=2)
+        tel_a = Telemetry()
+        sim = Simulation(32, schedule=sched, telemetry=tel_a)
+        sim.run_epochs(2)
+        assert plan.sink is tel_a.bus
+        data = sim.checkpoint()
+        n_a = len(tel_a.bus.events)
+        tel_b = Telemetry()
+        back = Simulation.resume(data, schedule=sched, telemetry=tel_b)
+        assert plan.sink is tel_b.bus
+        back.run_epochs(4)
+        assert tel_b.bus.of_type("fault"), \
+            "post-resume fault events must land on the new bus"
+        assert len(tel_a.bus.events) == n_a, \
+            "the dead run's bus must not keep growing"
+        (start,) = tel_b.bus.of_type("run_start")
+        assert start["resumed_at_slot"] == sim.slot
+        # and resuming with NO telemetry must CLEAR the stale sink, not
+        # keep appending to the (possibly closed) previous bus
+        n_b = len(tel_b.bus.events)
+        back2 = Simulation.resume(data, schedule=sched)
+        assert plan.sink is None
+        back2.run_epochs(3)
+        assert len(tel_b.bus.events) == n_b
+
+    def test_mutating_failed_handler_is_caught_debug_gated(self):
+        """A deliberately store-mutating FAILING handler must surface as
+        an invariant_violation event when telemetry.debug is on — the
+        pos-evolution.md:1041 contract, enforced at the driver's own
+        call sites."""
+        import pos_evolution_tpu.sim.driver as drv
+        orig = drv.fc.on_attestation
+
+        def dirty_on_attestation(store, att, is_from_block=False):
+            store.time += 1  # mutate BEFORE failing: the forbidden move
+            raise AssertionError("dirty handler")
+
+        tel = Telemetry(debug=True)
+        try:
+            drv.fc.on_attestation = dirty_on_attestation
+            sim, _ = _faulted_sim(tel, epochs=1)
+        finally:
+            drv.fc.on_attestation = orig
+        violations = tel.bus.of_type("invariant_violation")
+        assert violations, "mutating failed handler must be flagged"
+        assert violations[0]["handler"] == "dirty_on_attestation"
+        assert any(g.invariants.violations for g in sim.groups)
+
+    def test_debug_off_skips_invariant_checker(self):
+        tel = Telemetry(debug=False)
+        sim, _ = _faulted_sim(tel, epochs=1)
+        assert all(g.invariants is None for g in sim.groups)
+
+
+# -- global sink (resident degradation, watchdog incidents) --------------------
+
+class TestGlobalSink:
+    def test_emit_global_noop_without_install(self):
+        set_global(None)
+        assert emit_global("degradation", reason="x") is None
+
+    def test_watchdog_incident_event(self):
+        from pos_evolution_tpu.utils.watchdog import Watchdog
+        tel = Telemetry().install_global()
+        try:
+            wd = Watchdog(path=None, tag="t")
+            assert wd.step("boom", lambda: 1 / 0, default="d") == "d"
+        finally:
+            set_global(None)
+        (ev,) = tel.bus.of_type("watchdog_incident")
+        assert ev["step"] == "boom" and ev["tag"] == "t"
+        assert "ZeroDivisionError" in ev["error"]
+
+    def test_resident_degradation_event(self):
+        pytest.importorskip("jax")
+        from pos_evolution_tpu.sim import Simulation
+        tel = Telemetry().install_global()
+        try:
+            sim = Simulation(32, accelerated_forkchoice=True)
+            sim.run_until_slot(2)
+            sim.groups[0].resident._degrade("test-injected")
+        finally:
+            set_global(None)
+        (ev,) = tel.bus.of_type("degradation")
+        assert ev["component"] == "resident_forkchoice"
+        assert ev["reason"] == "test-injected"
+        assert ev["fallback"] == "host_spec_walk"
+
+
+# -- jax runtime telemetry -----------------------------------------------------
+
+class TestJaxRuntime:
+    def test_compile_events_and_explicit_hooks(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+
+        from pos_evolution_tpu.telemetry import jaxrt
+        reg = MetricsRegistry()
+        jaxrt.install(reg)
+        try:
+            @jax.jit
+            def f(x):
+                return x * 2 + 1
+
+            np.asarray(f(jnp.arange(7)))  # unique shape -> fresh compile
+            jaxrt.record_dispatch(site="test")
+            jaxrt.record_transfer(128, direction="d2h", site="test")
+        finally:
+            jaxrt.install(None)
+        counts = reg.counts()
+        assert counts.get("jax_backend_compiles_total", 0) >= 1
+        assert counts["jax_dispatches_total;site=test"] == 1
+        assert counts["jax_transfer_bytes_total;direction=d2h;site=test"] == 128
+        # detached: further events must not land anywhere
+        n = dict(counts)
+        jaxrt.record_dispatch(site="test")
+        assert reg.counts() == n
+
+
+# -- HandlerTimer satellites ---------------------------------------------------
+
+class TestHandlerTimerHardening:
+    def test_summary_tolerates_empty_samples(self):
+        from pos_evolution_tpu.utils.metrics import HandlerTimer
+        t = HandlerTimer()
+        t.samples["never_hit"]  # defaultdict: registered, no samples
+        s = t.summary()
+        assert s["never_hit"]["count"] == 0
+        assert np.isnan(s["never_hit"]["p50_ms"])
+        assert np.isnan(s["never_hit"]["p95_ms"])
+        assert s["never_hit"]["total_s"] == 0.0
+
+    def test_reset_drops_warmup_samples(self):
+        from pos_evolution_tpu.utils.metrics import HandlerTimer
+        t = HandlerTimer()
+        with t.track("h"):
+            pass
+        t.reset()
+        assert t.summary() == {}
+        with t.track("h"):
+            pass
+        assert t.summary()["h"]["count"] == 1
+
+
+# -- run_report on the golden fixture ------------------------------------------
+
+class TestRunReport:
+    def test_golden_fixture_report(self):
+        from run_report import build_report, to_markdown
+        events = read_jsonl(GOLDEN)
+        report = build_report(events)
+        fin = report["finality"]
+        assert fin["final_justified_epoch"] == 3
+        assert fin["final_finalized_epoch"] == 2
+        assert fin["advances"] == [
+            {"slot": 24, "finalized_epoch": 1},
+            {"slot": 32, "finalized_epoch": 2}]
+        assert report["faults"]["counts"] == {
+            "drop": {"block": 1}, "reorder": {"attestation": 1}}
+        eff = report["faults"]["effects"]
+        assert eff["gossip_edges"] == 4
+        assert eff["undelivered_gossip_edges"] == 1  # the dropped block
+        assert eff["handler_rejects"] == {"on_attestation": 1}
+        assert eff["invariant_violations"] == 1
+        assert eff["crashes"] == [
+            {"group": 1, "slot": 8, "lost_in_flight": 3}]
+        assert eff["rejoins"] == [
+            {"group": 1, "slot": 16, "sync_checkpoint_epoch": 1}]
+        assert eff["degradations"] == [
+            {"component": "resident_forkchoice",
+             "reason": "divergence self-check at query 128"}]
+        assert report["handlers"]["get_head"] == {
+            "count": 2, "p50_ms": 2.0, "p95_ms": 2.675, "total_ms": 4.0}
+        assert report["handlers"]["on_block"]["count"] == 1
+        assert report["light_clients"]["0"]["final_head_lag"] == 1
+        md = to_markdown(report)
+        assert "## Finality timeline" in md and "| get_head | 2 |" in md
+
+    def test_handler_percentiles_match_numpy(self):
+        """The dependency-free percentile must agree with np.percentile
+        (linear interpolation) on the fixture's durations."""
+        from run_report import _percentile
+        xs = [0.4, 0.8, 1.25, 2.75, 18.5]
+        for q in (50, 95):
+            assert _percentile(xs, q) == pytest.approx(
+                float(np.percentile(xs, q)))
+
+    def test_cli_writes_json_and_markdown(self, tmp_path):
+        from run_report import main
+        out_json = tmp_path / "r.json"
+        out_md = tmp_path / "r.md"
+        assert main([GOLDEN, "--json", str(out_json),
+                     "--markdown", str(out_md)]) == 0
+        report = json.loads(out_json.read_text())
+        assert report["n_events"] == 25
+        assert out_md.read_text().startswith("# Run report")
+
+    def test_report_reconstructs_live_run_without_simulation(self, tmp_path):
+        """Acceptance: a faulted multi-group run's JSONL alone yields the
+        finality timeline, handler percentiles, and per-fault-type counts
+        matching the plan's actual decisions exactly."""
+        from collections import Counter
+
+        from run_report import build_report
+        path = tmp_path / "events.jsonl"
+        tel = Telemetry.to_file(path)
+        sim, plan = _faulted_sim(tel, epochs=4)
+        tel.close()
+        report = build_report(read_jsonl(path))
+        assert report["finality"]["final_finalized_epoch"] == \
+            sim.finalized_epoch()
+        assert [r["finalized_epoch"] for r in report["finality"]["timeline"]] \
+            == [m["finalized_epoch"] for m in sim.metrics]
+        by_plan: dict = {}
+        for e in plan.log:
+            by_plan.setdefault(e["action"], Counter())[e["kind"]] += 1
+        got = {a: Counter(k) for a, k in report["faults"]["counts"].items()}
+        for action, kinds in by_plan.items():
+            assert got.get(action, Counter()) == kinds, action
+        deliver_counts = Counter(
+            e["handler"] for e in read_jsonl(path) if e["type"] == "deliver")
+        for handler, n in deliver_counts.items():
+            assert report["handlers"][handler]["count"] == n
+
+
+# -- perf gate -----------------------------------------------------------------
+
+class TestPerfGate:
+    def _bench_emission(self, recompiles):
+        return {"metric": "m", "value": 1.0, "unit": "s",
+                "telemetry": {"counts": {
+                    "jax_backend_compiles_total": recompiles,
+                    "jax_dispatches_total;site=fused_measure": 12}}}
+
+    def test_real_emission_passes(self, tmp_path):
+        from perf_gate import main
+        base = tmp_path / "base.json"
+        cand = tmp_path / "cand.json"
+        base.write_text(json.dumps(self._bench_emission(8)))
+        cand.write_text(json.dumps(self._bench_emission(8)))
+        assert main(["--candidate", str(cand), "--baseline", str(base),
+                     "--count-only"]) == 0
+
+    def test_doctored_inflated_recompiles_fail(self, tmp_path):
+        from perf_gate import main
+        base = tmp_path / "base.json"
+        cand = tmp_path / "cand.json"
+        base.write_text(json.dumps(self._bench_emission(8)))
+        cand.write_text(json.dumps(self._bench_emission(64)))
+        assert main(["--candidate", str(cand), "--baseline", str(base),
+                     "--count-only"]) == 1
+
+    def test_vacuous_pass_when_baseline_has_no_counts(self, tmp_path):
+        from perf_gate import main
+        base = tmp_path / "base.json"
+        cand = tmp_path / "cand.json"
+        base.write_text(json.dumps({"metric": "m", "value": 1.0}))
+        cand.write_text(json.dumps(self._bench_emission(8)))
+        assert main(["--candidate", str(cand), "--baseline", str(base),
+                     "--count-only"]) == 0
+
+    def test_run_report_handler_counts_are_gateable(self, tmp_path):
+        from perf_gate import extract_counts, gate
+        report = {"handlers": {"on_block": {"count": 68, "p50_ms": 17.9}}}
+        assert extract_counts(report) == {
+            "handler_calls_total;handler=on_block": 68}
+        doctored = {"handlers": {"on_block": {"count": 204}}}
+        assert gate(report, doctored, 1.25, 4.0) == 1
+        assert gate(report, report, 1.25, 4.0) == 0
+
+    def test_registry_counts_aggregate_over_status_label(self):
+        """A registry counts() emission (status-labelled) must intersect
+        a run-report emission on the per-handler aggregate."""
+        from perf_gate import extract_counts, gate
+        registry_shaped = {"counts": {
+            "handler_calls_total;handler=on_block;status=accept": 60,
+            "handler_calls_total;handler=on_block;status=reject": 8}}
+        assert extract_counts(registry_shaped)[
+            "handler_calls_total;handler=on_block"] == 68
+        report = {"handlers": {"on_block": {"count": 68}}}
+        assert gate(registry_shaped, report, 1.25, 4.0) == 0
+        inflated = {"handlers": {"on_block": {"count": 204}}}
+        assert gate(registry_shaped, inflated, 1.25, 4.0) == 1
+
+    def test_disjoint_count_namespaces_refuse_to_gate(self):
+        """A bench emission vs a run report share no count keys: that is
+        an incomparable pair (exit 2), NOT a vacuous pass — a real
+        regression must not ship behind a namespace mismatch."""
+        from perf_gate import gate
+        bench = self._bench_emission(8)
+        report = {"handlers": {"on_block": {"count": 68}}}
+        assert gate(bench, report, 1.25, 4.0) == 2
+
+    def test_timing_report_only_unless_strict(self, tmp_path):
+        from perf_gate import gate
+        base = {"config2": {"ms": 10.0}, "telemetry": {"counts": {"c": 1}}}
+        cand = {"config2": {"ms": 100.0}, "telemetry": {"counts": {"c": 1}}}
+        assert gate(base, cand, 1.25, 4.0, count_only=False) == 0
+        assert gate(base, cand, 1.25, 4.0, count_only=False,
+                    strict_timing=True) == 1
+
+    def test_missing_baseline_is_usage_error(self, tmp_path):
+        from perf_gate import main
+        cand = tmp_path / "cand.json"
+        cand.write_text("{}")
+        assert main(["--candidate", str(cand),
+                     "--baseline", str(tmp_path / "nope.json")]) == 2
