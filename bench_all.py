@@ -25,7 +25,6 @@ Usage: python bench_all.py [--record N]
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -57,13 +56,16 @@ def config1_forkchoice_python():
         for v in range(1024):
             store.latest_messages[v] = LatestMessage(
                 epoch=0, root=roots[rng.integers(0, len(roots))])
-        times = []
+        # HandlerTimer owns the percentile math (utils/metrics): one
+        # accessor for benches, the sim driver and the profiling
+        # exporters, instead of per-caller np.percentile re-derivations
+        from pos_evolution_tpu.utils.metrics import HandlerTimer
+        timer = HandlerTimer()
         for _ in range(20):
-            t0 = time.perf_counter()
-            head = fc.get_head(store)
-            times.append(time.perf_counter() - t0)
-        out = {"p50_ms": round(float(np.percentile(times, 50)) * 1e3, 3),
-               "p95_ms": round(float(np.percentile(times, 95)) * 1e3, 3)}
+            with timer.track("get_head"):
+                head = fc.get_head(store)
+        out = {"p50_ms": round(timer.percentile("get_head", 50) * 1e3, 3),
+               "p95_ms": round(timer.percentile("get_head", 95) * 1e3, 3)}
         try:
             from pos_evolution_tpu.ops.forkchoice import get_head_dense
             out["dense_matches"] = bool(get_head_dense(store) == head)
@@ -107,7 +109,11 @@ def config1_forkchoice_device(n_msgs, entropy, fused_measure, checksum_tree):
         boost_amount=jnp.int64(32 * gwei * (n_msgs // 32) // 4),
     )
 
-    def rescan_body(salt, acc):
+    # the store rides through fused_measure as a TRACED capture — closed
+    # over, its message table is an HLO constant and XLA constant-folds
+    # the vote-bucket scatter at compile time (the >1 s stalls in the
+    # BENCH_r05 tail; see benchtime.fused_measure's captures contract)
+    def rescan_body(salt, acc, store):
         st = store._replace(
             msg_epoch=store.msg_epoch.at[0].set(salt.astype(jnp.int64)),
             boost_idx=(salt % capacity).astype(jnp.int32))
@@ -115,13 +121,14 @@ def config1_forkchoice_device(n_msgs, entropy, fused_measure, checksum_tree):
         return acc + h.astype(jnp.int32) + checksum_tree(w)
 
     t_rescan = fused_measure(rescan_body, entropy=entropy,
-                             tag="fc rescan cap1024")
+                             tag="fc rescan cap1024", captures=store)
 
     buckets = rebuild_buckets(store.msg_block, store.weight, capacity)
     delta = 64
     vi = jnp.asarray(rng.integers(0, n_msgs, delta).astype(np.int32))
 
-    def incr_body(salt, acc):
+    def incr_body(salt, acc, cap):
+        store, buckets = cap
         blocks = (salt + jnp.arange(delta, dtype=jnp.int32)) % capacity
         mb, me, bk = apply_latest_messages(
             store.msg_block, store.msg_epoch, buckets, vi, blocks,
@@ -134,7 +141,8 @@ def config1_forkchoice_device(n_msgs, entropy, fused_measure, checksum_tree):
         return acc + h.astype(jnp.int32) + checksum_tree((mb, me, w))
 
     t_incr = fused_measure(incr_body, entropy=entropy + 7,
-                           tag="fc incremental cap1024")
+                           tag="fc incremental cap1024",
+                           captures=(store, buckets))
     return {"capacity": 1024, "latest_messages": n_msgs,
             "rescan_head_ms": round(t_rescan * 1e3, 3),
             "incremental_head_ms": round(t_incr * 1e3, 3),
@@ -231,14 +239,16 @@ def main():
         sigs = jnp.asarray(rng.integers(0, 2**32, (A, 24), dtype=np.uint64)
                            .astype(np.uint32))
 
-        def agg_body(salt, acc):
+        def agg_body(salt, acc, cap):
+            pk_states, committees, bits, msgs, sigs = cap
             ok = aggregate_verify_batch(
                 pk_states, committees, bits,
                 msgs.at[0, 0].set(salt.astype(jnp.uint32)), sigs)
             return acc + ok.sum(dtype=jnp.int32)
 
         t = fused_measure(agg_body, entropy=entropy,
-                          tag="aggregation fake-bls")
+                          tag="aggregation fake-bls",
+                          captures=(pk_states, committees, bits, msgs, sigs))
         return {
             "fake_crypto": True,
             "note": "SHA/XOR FakeBLS pipeline shape, NOT real pairings — "
@@ -315,15 +325,19 @@ def main():
         bits4 = jnp.zeros(4, bool)
 
         def _config4():
-            def epoch_body(salt, acc):
-                out = step(sharded._replace(
-                    balance=sharded.balance.at[0].set(
+            # the registry rides as a traced capture (not a closure): a
+            # closed-over column is an HLO constant and XLA can fold the
+            # sweeps over it at compile time — the BENCH_r05 hazard
+            def epoch_body(salt, acc, reg):
+                out = step(reg._replace(
+                    balance=reg.balance.at[0].set(
                         31 * gwei + salt.astype(jnp.int64))),
                     jnp.int64(10), jnp.int64(8), bits4, jnp.int64(8),
                     jnp.int64(9), jnp.int64(0))
                 return acc + checksum_tree(out)
 
-            t = fused_measure(epoch_body, entropy=entropy, tag="epoch sharded")
+            t = fused_measure(epoch_body, entropy=entropy,
+                              tag="epoch sharded", captures=sharded)
             return {"n_validators": n,
                     "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
                     "ms_scaled_to_1m": round(t * 1e3 * scale, 3)}
@@ -340,11 +354,13 @@ def main():
             eff = reg.effective_balance
             total = jnp.int64(n * 32 * gwei)
 
-            def ssf_body(salt, acc):
+            def ssf_body(salt, acc, cap):
+                votes, eff = cap
                 out = tally(votes.at[salt % n].set(salt % 2 == 0), eff, total)
                 return acc + checksum_tree(out)
 
-            t = fused_measure(ssf_body, entropy=entropy, tag="ssf tally")
+            t = fused_measure(ssf_body, entropy=entropy, tag="ssf tally",
+                              captures=(votes, eff))
             return {"ms_scaled_to_1m": round(t * 1e3 * scale, 4)}
 
         results["config5_ssf_tally_1m"] = wd.step(
@@ -356,11 +372,22 @@ def main():
 
     out = json.dumps(results, indent=1)
     print(out)
+    here = os.path.dirname(os.path.abspath(__file__))
     if record is not None:
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            f"BENCH_ALL_r{record:02d}.json")
+        path = os.path.join(here, f"BENCH_ALL_r{record:02d}.json")
         with open(path, "w") as f:
             f.write(out + "\n")
+
+    # Bench history (profiling/history.py): the whole matrix lands as one
+    # schema-versioned entry for scripts/perf_gate.py --history.
+    if "--no-history" not in sys.argv:
+        try:
+            from pos_evolution_tpu.profiling import history as _history
+            _history.append_entry(os.path.join(here, "bench_history.jsonl"),
+                                  results, kind="bench_all")
+        except Exception as e:
+            print(f"# bench history append failed: {e!r:.120}",
+                  file=sys.stderr)
 
 
 def _config3b_real_bls(entropy, fused_measure):
@@ -392,14 +419,17 @@ def _config3b_real_bls(entropy, fused_measure):
         [pairing.g2_affine_encode(oracle.ec_mul(p, 3)) for p in g2s]))
     sig_inf = jnp.zeros(batch, bool)
 
-    def body(salt, acc):
+    def body(salt, acc, cap):
+        pk_table, committees, bits, msg_g2, sig_g2, sig_inf = cap
         comm = (committees + salt) % n_keys
         ok = pairing.fast_aggregate_verify_batch(
             pk_table, comm, bits, msg_g2, sig_g2, sig_inf)
         return acc + ok.sum(dtype=jnp.int32)
 
     t = fused_measure(body, k_hi=3, entropy=entropy,
-                      tag=f"real-bls verify batch={batch}")
+                      tag=f"real-bls verify batch={batch}",
+                      captures=(pk_table, committees, bits, msg_g2, sig_g2,
+                                sig_inf))
     return {"fake_crypto": False, "batch": batch, "lanes_per_aggregate": lanes,
             "ms_per_batch": round(t * 1e3, 1),
             "aggregate_verifies_per_s": round(batch / t, 2)}

@@ -1,0 +1,56 @@
+"""Profiling subsystem (ISSUE 4): where did the device time, FLOPs and
+HBM go — and is it drifting?
+
+Four legs, one pipeline (capture → attribute → export → gate):
+
+- **device-time attribution** (``xplane.py`` + ``attribution.py``): an
+  importable xplane protobuf wire-format parser (what
+  ``scripts/trace_summary.py`` used to be) plus ``ProfiledRegion``, a
+  context manager that wraps any sim/bench section in a ``jax.profiler``
+  trace and attributes device op time back to the telemetry spans /
+  jitted kernels that dispatched it;
+- **static cost & memory analysis** (``cost.py``): per-kernel
+  FLOPs / bytes-accessed / peak-memory tables for the hot paths via
+  ``lower().compile().cost_analysis()`` + ``memory_analysis()``;
+- **trace export** (``export.py``): telemetry JSONL span trees and
+  attributed device ops rendered as Chrome ``trace_event`` JSON
+  (Perfetto-loadable) and collapsed-stack flamegraphs;
+- **bench history** (``history.py``): every bench emission appended to a
+  schema-versioned ``bench_history.jsonl``; ``scripts/perf_gate.py
+  --history`` flags a metric only when it falls outside a robust
+  median ± k·MAD band of the recent entries.
+
+Timing caveats are inherited from ``utils/benchtime.py``: on async
+relays wall-clock around a dispatch measures enqueue latency, so device
+*timelines* (this package) complement — never replace — the fused-loop
+work-difference *numbers* (benchtime).
+"""
+
+from pos_evolution_tpu.profiling.attribution import (
+    ProfiledRegion,
+    attribute_to_spans,
+    group_by_jit,
+    innermost_jit,
+)
+from pos_evolution_tpu.profiling.history import (
+    HISTORY_SCHEMA_VERSION,
+    append_entry,
+    band_verdicts,
+    read_history,
+    robust_band,
+)
+from pos_evolution_tpu.profiling.xplane import (
+    encode_xspace,
+    parse_xspace,
+    summarize_path,
+    summarize_xplane,
+    top_table,
+)
+
+__all__ = [
+    "ProfiledRegion", "attribute_to_spans", "group_by_jit", "innermost_jit",
+    "HISTORY_SCHEMA_VERSION", "append_entry", "band_verdicts",
+    "read_history", "robust_band",
+    "encode_xspace", "parse_xspace", "summarize_path", "summarize_xplane",
+    "top_table",
+]

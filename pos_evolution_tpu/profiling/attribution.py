@@ -1,0 +1,268 @@
+"""Device-time attribution: from xplane op streams back to the code that
+dispatched them.
+
+XLA stamps every HLO op with an ``op_name`` metadata path like
+
+    jit(run)/while/body/closed_call/jit(head_and_weights)/scatter-add
+
+The *innermost* ``jit(...)`` frame names the Python function whose trace
+emitted the op — that is the natural attribution key for this codebase,
+where every hot path is a named jitted kernel (``head_and_weights``,
+``aggregate_verify_batch``, ``process_epoch_dense``, ...). Spans on the
+telemetry bus (``blk-3-5``, handler names like ``on_block``/``get_head``,
+``TraceAnnotation`` region names) are then matched against those frames
+and against raw path segments, folding device milliseconds onto the span
+that dispatched them; everything unmatched lands in ``unattributed`` so
+the table always sums to the trace total (no silently vanishing time).
+
+``ProfiledRegion`` is the capture harness: a context manager that wraps
+any sim/bench section in a ``jax.profiler`` trace, parses the resulting
+xplane protobufs with ``profiling/xplane.py``, attributes device ops to
+the telemetry spans emitted *during the region*, and (when a telemetry
+bundle is attached) emits one ``profile`` event carrying the summary —
+so run reports can show "where the device time went" next to "what
+happened".
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import tempfile
+
+from pos_evolution_tpu.profiling import xplane
+
+_JIT_RE = re.compile(r"jit\(([^()]*)\)")
+
+
+def is_python_frame(op_name: str) -> bool:
+    """Host python-tracer frames (``$file.py:123 fn``) — timeline context,
+    not executed device work; the aggregate views skip them (they nest
+    around everything and would double-count the real ops under them)."""
+    return op_name.startswith("$")
+
+
+def op_frames(op_name: str) -> list[str]:
+    """The scope path of one op: ``jit(f)/while/body/jit(g)/add`` ->
+    ``['jit(f)', 'while', 'body', 'jit(g)', 'add']``."""
+    return [seg for seg in op_name.split("/") if seg]
+
+
+def innermost_jit(op_name: str) -> str | None:
+    """The function name of the deepest ``jit(...)`` frame, or None."""
+    names = _JIT_RE.findall(op_name)
+    return names[-1] if names else None
+
+
+def group_by_jit(planes, device_only: bool = True,
+                 exclude_ops=frozenset()) -> dict[str, dict]:
+    """Aggregate a ``parse_xspace`` result by innermost jit frame:
+    ``{fn_name: {"total_ms", "count", "ops": {op: [ms, count]}}}``.
+
+    ``exclude_ops``: op names dropped entirely — ``ProfiledRegion``
+    passes its own annotation name here, because on the CPU-plane
+    fallback the region's ``TraceAnnotation`` slice *envelops* every op
+    it dispatched and would double-count the whole region as work.
+    Ops with no jit frame key under ``"unjitted"``. ``device_only``
+    keeps planes whose name smells like a device (``xplane.
+    is_device_plane``); on a CPU-only run nothing matches, so it falls
+    back to every plane — the CPU thunk executor timeline is the device
+    timeline there."""
+    chosen = xplane.select_planes(planes, device_only)
+    out: dict[str, dict] = {}
+    key_of: dict[str, str] = {}  # op_name -> key: a trace has ~10^5 events
+    # but only ~10^2 distinct op names (metadata-interned); resolve once
+    for _, _, op, _, dur in xplane.iter_ops(chosen):
+        key = key_of.get(op)
+        if key is None:
+            if is_python_frame(op) or op in exclude_ops:
+                key_of[op] = ""
+                continue
+            key = key_of[op] = innermost_jit(op) or "unjitted"
+        elif not key:
+            continue
+        row = out.setdefault(key, {"total_ms": 0.0, "count": 0, "ops": {}})
+        ms = dur / 1e9
+        row["total_ms"] += ms
+        row["count"] += 1
+        cell = row["ops"].setdefault(op, [0.0, 0])
+        cell[0] += ms
+        cell[1] += 1
+    for row in out.values():
+        row["total_ms"] = round(row["total_ms"], 4)
+        row["ops"] = {k: [round(v[0], 4), v[1]]
+                      for k, v in sorted(row["ops"].items(),
+                                         key=lambda kv: -kv[1][0])}
+    return out
+
+
+def attribute_to_spans(planes, span_names, device_only: bool = True,
+                       exclude_ops=frozenset()) -> dict:
+    """Fold device op time onto telemetry span / region names.
+    ``exclude_ops`` as in ``group_by_jit`` (enveloping annotation slices
+    must not be counted as the work they contain).
+
+    An op attributes to the first span name (iteration order of
+    ``span_names``) that appears in the op's scope path — as a
+    ``jit(<name>)`` frame, a literal path segment (TraceAnnotation
+    regions show up as segments), or a substring of a frame (so the span
+    ``get_head`` catches ``jit(head_from_buckets)`` only if callers map
+    it; exact/segment matches are tried first, substring last). Ops no
+    span claims land in ``"unattributed"`` — the table is a partition of
+    the trace, totals preserved."""
+    names = list(dict.fromkeys(span_names))  # de-dup, keep order
+    out: dict[str, dict] = {}
+
+    def bucket(key):
+        return out.setdefault(key, {"total_ms": 0.0, "count": 0})
+
+    def resolve(op: str) -> str | None:
+        if is_python_frame(op) or op in exclude_ops:
+            return None
+        frames = op_frames(op)
+        jits = _JIT_RE.findall(op)
+        for name in names:
+            if name in jits or name in frames:
+                return name
+        for name in names:
+            if any(name in f for f in frames):
+                return name
+        return "unattributed"
+
+    # memoize per distinct op name: a big trace has ~10^5 events over
+    # ~10^2 metadata-interned names, and resolve() scans span_names —
+    # without the cache __exit__ goes quadratic on profiled sims
+    target_of: dict[str, str | None] = {}
+    for _, _, op, _, dur in xplane.iter_ops(
+            xplane.select_planes(planes, device_only)):
+        if op in target_of:
+            target = target_of[op]
+        else:
+            target = target_of[op] = resolve(op)
+        if target is None:
+            continue
+        row = bucket(target)
+        row["total_ms"] += dur / 1e9
+        row["count"] += 1
+    for row in out.values():
+        row["total_ms"] = round(row["total_ms"], 4)
+    return out
+
+
+class ProfiledRegion:
+    """Capture a device trace around a code region and attribute it.
+
+    >>> with ProfiledRegion("epoch", telemetry=tel) as prof:
+    ...     run_the_workload()
+    >>> prof.top_ops          # plane -> top-N [{op, total_ms, count}]
+    >>> prof.by_jit           # innermost-jit attribution table
+    >>> prof.attribution      # span-name attribution (needs telemetry)
+
+    The trace lands in ``trace_dir`` (a temp dir deleted on exit unless
+    ``keep_trace``/an explicit dir is given). With a telemetry bundle
+    attached, span/handler names emitted on the bus *during* the region
+    become attribution targets and one ``profile`` event with the summary
+    is emitted on exit. Profiling failures (no jax, a second concurrent
+    ``jax.profiler`` session, an empty trace) degrade to empty tables
+    with ``self.error`` set — profiling must never kill the run it
+    observes."""
+
+    def __init__(self, name: str = "profiled", telemetry=None,
+                 trace_dir=None, top_n: int = 10, keep_trace: bool = False,
+                 extra_span_names=()):
+        self.name = name
+        self.telemetry = telemetry
+        self.trace_dir = os.fspath(trace_dir) if trace_dir is not None \
+            else None
+        self.top_n = top_n
+        self.keep_trace = keep_trace or trace_dir is not None
+        self.extra_span_names = list(extra_span_names)
+        self.planes: list[dict] = []
+        self.top_ops: dict = {}
+        self.by_jit: dict = {}
+        self.attribution: dict = {}
+        self.error: str | None = None
+        self._bus_mark = 0
+        self._annotation = None
+        self._tracing = False
+
+    def __enter__(self) -> "ProfiledRegion":
+        if self.trace_dir is None:
+            self.trace_dir = tempfile.mkdtemp(prefix=".profiled_region_")
+        os.makedirs(self.trace_dir, exist_ok=True)
+        if self.telemetry is not None:
+            self._bus_mark = len(self.telemetry.bus.events)
+        try:
+            import jax
+            jax.profiler.start_trace(self.trace_dir)
+            self._tracing = True
+            self._annotation = jax.profiler.TraceAnnotation(self.name)
+            self._annotation.__enter__()
+        except Exception as e:  # no jax / profiler already active
+            self.error = f"trace start failed: {e!r:.200}"
+        return self
+
+    def _region_span_names(self) -> list[str]:
+        names = list(self.extra_span_names)
+        names.append(self.name)
+        if self.telemetry is not None:
+            for ev in self.telemetry.bus.events[self._bus_mark:]:
+                h = ev.get("handler")
+                if h:
+                    names.append(h)
+                s = ev.get("span")
+                if s:
+                    names.append(s)
+        return names
+
+    def __exit__(self, *exc) -> None:
+        if self._annotation is not None:
+            try:
+                self._annotation.__exit__(*exc)
+            except Exception:
+                pass
+        if self._tracing:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as e:
+                self.error = self.error or f"trace stop failed: {e!r:.200}"
+            else:
+                try:
+                    self.planes = xplane.parse_path(self.trace_dir)
+                    self.top_ops = xplane.top_table(
+                        xplane.summarize_planes(self.planes), self.top_n)
+                    # the region's own annotation slice envelops every op
+                    # it dispatched — exclude it or CPU-fallback tables
+                    # double-count the whole region (the legacy top_ops
+                    # view keeps it: there it reads as a total, not work)
+                    self.by_jit = group_by_jit(self.planes,
+                                               exclude_ops={self.name})
+                    self.attribution = attribute_to_spans(
+                        self.planes, self._region_span_names(),
+                        exclude_ops={self.name})
+                except Exception as e:
+                    # truncated protobufs (killed writer, full disk),
+                    # missing files, anything: profiling must never kill
+                    # the run it observes
+                    self.planes = []
+                    self.error = f"trace parse failed: {e!r:.200}"
+        if self.telemetry is not None:
+            payload = {
+                "name": self.name,
+                "by_jit": {k: {"total_ms": v["total_ms"],
+                               "count": v["count"]}
+                           for k, v in self.by_jit.items()},
+                "attribution": self.attribution,
+            }
+            if self.error is not None:
+                payload["error"] = self.error
+            if self.keep_trace:
+                payload["trace_dir"] = self.trace_dir
+            try:
+                self.telemetry.bus.emit("profile", **payload)
+            except Exception:
+                pass  # a closed bus must not raise out of the region
+        if not self.keep_trace and self.trace_dir is not None:
+            shutil.rmtree(self.trace_dir, ignore_errors=True)

@@ -1,0 +1,247 @@
+"""Static cost & memory analysis of the jitted hot paths.
+
+XLA knows, at compile time, how many FLOPs and HBM bytes every kernel
+will touch — ``lowered.compile().cost_analysis()`` and
+``memory_analysis()`` expose it. This module walks the repo's hot-path
+kernels (attestation aggregation, fork-choice rescan + incremental head,
+dense epoch sweep, sync-committee merkle walk, swap-or-not shuffle) at a
+configurable validator count and emits one per-kernel table:
+
+    {"kernel": {"flops", "bytes_accessed", "transcendentals",
+                "argument_bytes", "output_bytes", "temp_bytes",
+                "generated_code_bytes", "peak_bytes"}}
+
+``peak_bytes`` approximates peak device memory as arguments + outputs +
+temps (XLA's own accounting; aliasing is subtracted when reported). The
+table is the static complement to the xplane timeline: the timeline says
+where time *went*, this says where the FLOPs/bytes *must* go — the
+per-kernel breakdown hardware papers justify designs with, produced on
+CPU or TPU backends alike (the analysis runs wherever the kernel
+compiles; per-backend numbers differ and the emission records which).
+
+A kernel that fails to build/compile records ``{"error": ...}`` instead
+of killing the sweep — a cost table with one hole beats no table.
+
+CLI: ``python -m pos_evolution_tpu.profiling.cost [--json out.json]
+[--n 4096] [--capacity 64]``; ``scripts/run_report.py --cost out.json``
+folds the emission into a run report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cost_dict(compiled) -> dict:
+    """Normalize ``cost_analysis()`` across jax versions (list-of-dict
+    vs dict) into plain floats we care about."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    out = {}
+    for key, name in (("flops", "flops"),
+                      ("bytes accessed", "bytes_accessed"),
+                      ("transcendentals", "transcendentals"),
+                      ("optimal_seconds", "optimal_seconds")):
+        v = ca.get(key)
+        if isinstance(v, (int, float)) and v == v:  # drop NaN
+            out[name] = float(v)
+    return out
+
+
+def _memory_dict(compiled) -> dict:
+    """Normalize ``memory_analysis()`` (absent on some backends)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for attr, name in (("argument_size_in_bytes", "argument_bytes"),
+                       ("output_size_in_bytes", "output_bytes"),
+                       ("temp_size_in_bytes", "temp_bytes"),
+                       ("alias_size_in_bytes", "alias_bytes"),
+                       ("generated_code_size_in_bytes",
+                        "generated_code_bytes")):
+        v = getattr(ma, attr, None)
+        if isinstance(v, (int, float)):
+            out[name] = int(v)
+    if {"argument_bytes", "output_bytes", "temp_bytes"} <= out.keys():
+        out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                             + out["temp_bytes"] - out.get("alias_bytes", 0))
+    return out
+
+
+def analyze_fn(fn, *args, **kwargs) -> dict:
+    """Lower + compile one jitted callable and return its cost/memory
+    row. ``fn`` may already be jitted (``.lower`` is used as-is) or a
+    plain callable (wrapped in ``jax.jit`` first)."""
+    import jax
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    compiled = fn.lower(*args, **kwargs).compile()
+    row = _cost_dict(compiled)
+    row.update(_memory_dict(compiled))
+    return row
+
+
+def hot_path_specs(n: int = 4096, capacity: int = 64) -> dict:
+    """name -> zero-arg builder returning ``(fn, args, kwargs)`` for each
+    hot-path kernel at validator count ``n``, fork-choice capacity
+    ``capacity``. Builders are lazy so one import failure doesn't sink
+    the others."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    gwei = 10**9
+
+    def _aggregation():
+        from pos_evolution_tpu.ops.aggregation import aggregate_verify_batch
+        a_total = max(n // 512, 4)
+        lanes = max(n // a_total, 1)
+        pk_states = jnp.asarray(
+            rng.integers(0, 2**32, (n, 8), dtype=np.uint64).astype(np.uint32))
+        committees = jnp.asarray(
+            rng.permutation(n)[:a_total * lanes]
+            .reshape(a_total, lanes).astype(np.int32))
+        bits = jnp.asarray(rng.random((a_total, lanes)) < 0.99)
+        msgs = jnp.asarray(
+            rng.integers(0, 2**32, (a_total, 8), dtype=np.uint64)
+            .astype(np.uint32))
+        sigs = jnp.asarray(
+            rng.integers(0, 2**32, (a_total, 24), dtype=np.uint64)
+            .astype(np.uint32))
+        return aggregate_verify_batch, (pk_states, committees, bits, msgs,
+                                        sigs), {}
+
+    def _dense_store():
+        from pos_evolution_tpu.ops.forkchoice import DenseStore
+        parent = np.arange(-1, capacity - 1, dtype=np.int32)
+        return DenseStore(
+            parent=jnp.asarray(parent),
+            slot=jnp.arange(capacity, dtype=jnp.int32),
+            rank=jnp.asarray(rng.permutation(capacity).astype(np.int32)),
+            real=jnp.ones(capacity, bool),
+            leaf_viable=jnp.ones(capacity, bool),
+            justified_idx=jnp.int32(0),
+            msg_block=jnp.asarray(
+                rng.integers(0, capacity, n).astype(np.int32)),
+            msg_epoch=jnp.zeros(n, jnp.int64),
+            weight=jnp.asarray(np.full(n, 32 * gwei, np.int64)),
+            boost_idx=jnp.int32(capacity - 1),
+            boost_amount=jnp.int64(32 * gwei),
+        )
+
+    def _forkchoice_rescan():
+        from pos_evolution_tpu.ops.forkchoice import head_and_weights
+        return head_and_weights, (_dense_store(),), {"capacity": capacity}
+
+    def _forkchoice_incremental():
+        from pos_evolution_tpu.ops.forkchoice import (
+            head_from_buckets, rebuild_buckets,
+        )
+        st = _dense_store()
+        buckets = rebuild_buckets(st.msg_block, st.weight, capacity)
+        return head_from_buckets, (st.parent, st.real, st.rank,
+                                   st.leaf_viable, st.justified_idx, buckets,
+                                   st.boost_idx, st.boost_amount), \
+            {"capacity": capacity}
+
+    def _epoch():
+        from pos_evolution_tpu.config import mainnet_config
+        from pos_evolution_tpu.ops.epoch import (
+            DenseRegistry, process_epoch_dense,
+        )
+        reg = DenseRegistry(
+            effective_balance=jnp.asarray(np.full(n, 32 * gwei, np.int64)),
+            balance=jnp.asarray(
+                rng.integers(31 * gwei, 33 * gwei, n).astype(np.int64)),
+            activation_epoch=jnp.zeros(n, jnp.int64),
+            exit_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
+            withdrawable_epoch=jnp.asarray(np.full(n, 2**62, np.int64)),
+            slashed=jnp.zeros(n, bool),
+            prev_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+            cur_flags=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+            inactivity_scores=jnp.zeros(n, jnp.int64),
+        )
+        bits = jnp.zeros(4, bool)
+        return process_epoch_dense, (reg, 10, 8, bits, 8, 9, 0,
+                                     mainnet_config()), {}
+
+    def _sync_verify():
+        from pos_evolution_tpu.ops.sync_verify import _merkle_walk_device
+        batch, depth = 8, 6
+        leaf = jnp.asarray(
+            rng.integers(0, 2**32, (batch, 8), dtype=np.uint64)
+            .astype(np.uint32))
+        branch = jnp.asarray(
+            rng.integers(0, 2**32, (batch, depth, 8), dtype=np.uint64)
+            .astype(np.uint32))
+        idx_bits = jnp.asarray(
+            rng.integers(0, 2, (batch, depth)).astype(bool))
+        return _merkle_walk_device, (leaf, branch, idx_bits), {}
+
+    def _shuffle():
+        from pos_evolution_tpu.ops.shuffle import (
+            _seed_words, _shuffle_device, host_pivots,
+        )
+        seed = bytes(range(32))
+        rounds = 10
+        return _shuffle_device, (jnp.asarray(_seed_words(seed)),
+                                 jnp.asarray(host_pivots(seed, n, rounds))), \
+            {"n": n, "rounds": rounds}
+
+    return {
+        "aggregation.aggregate_verify_batch": _aggregation,
+        "forkchoice.head_and_weights": _forkchoice_rescan,
+        "forkchoice.head_from_buckets": _forkchoice_incremental,
+        "epoch.process_epoch_dense": _epoch,
+        "sync_verify.merkle_walk": _sync_verify,
+        "shuffle.swap_or_not": _shuffle,
+    }
+
+
+def analyze_hot_paths(n: int = 4096, capacity: int = 64) -> dict:
+    """The full emission: per-kernel cost/memory rows plus the backend
+    they were compiled for."""
+    import jax
+    kernels = {}
+    for name, build in hot_path_specs(n=n, capacity=capacity).items():
+        try:
+            fn, args, kwargs = build()
+            kernels[name] = analyze_fn(fn, *args, **kwargs)
+        except Exception as e:
+            kernels[name] = {"error": f"{e!r:.200}"}
+    return {"backend": jax.default_backend(), "n_validators": n,
+            "forkchoice_capacity": capacity, "kernels": kernels}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", help="write the cost table to this path")
+    ap.add_argument("--n", type=int, default=4096,
+                    help="validator count for the analyzed shapes")
+    ap.add_argument("--capacity", type=int, default=64,
+                    help="fork-choice tree capacity")
+    args = ap.parse_args(argv)
+    table = analyze_hot_paths(n=args.n, capacity=args.capacity)
+    blob = json.dumps(table, indent=1, sort_keys=True)
+    if args.json:
+        with open(args.json, "w") as fh:
+            fh.write(blob + "\n")
+    print(blob)
+    errors = [k for k, v in table["kernels"].items() if "error" in v]
+    if errors:
+        print(f"# cost: {len(errors)} kernel(s) failed to analyze: "
+              f"{', '.join(errors)}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -224,7 +224,7 @@ class Simulation:
 
     def __init__(self, n_validators: int, schedule: Schedule | None = None,
                  genesis_time: int = 0, accelerated_forkchoice: bool = False,
-                 telemetry=None):
+                 telemetry=None, profile=None):
         self.cfg = cfg()
         self.schedule = schedule or honest_schedule(n_validators)
         self.n_validators = n_validators
@@ -241,6 +241,19 @@ class Simulation:
         # across CONCURRENT sims is not supported (its log would
         # interleave anyway).
         self.telemetry = telemetry
+        # Opt-in profiling (pos_evolution_tpu/profiling, ISSUE 4): a
+        # directory path. The FIRST top-level run (run_until_slot /
+        # run_epochs) is captured under a jax.profiler trace; on completion
+        # the directory receives chrome_trace.json (sim spans + device ops,
+        # Perfetto-loadable), flame.txt / flame_device.txt (collapsed
+        # stacks), and top_ops.json (xplane summary — run_report.py
+        # auto-discovers it next to an event log). One capture only:
+        # jax.profiler supports a single session, and the first run segment
+        # is the one that includes compiles — the honest-timing caveats of
+        # utils/benchtime.py apply to any wall-clock read off the trace.
+        import os as _os
+        self.profile = _os.fspath(profile) if profile is not None else None
+        self._profiled = False
         if self.schedule.faults is not None:
             self.schedule.faults.sink = (telemetry.bus
                                          if telemetry is not None else None)
@@ -648,8 +661,59 @@ class Simulation:
         self.slot += 1
 
     def run_until_slot(self, slot: int) -> None:
+        if self.profile is not None and not self._profiled:
+            self._profiled = True
+            self._run_profiled(slot)
+            return
         while self.slot <= slot:
             self.run_slot()
+
+    def _run_profiled(self, slot: int) -> None:
+        """One profiled run segment: capture a device trace around the
+        slot loop, attribute device ops to the telemetry spans emitted
+        during it, and write the exporter artifacts into ``self.profile``
+        (see ``__init__``). Profiling failures degrade to a plain run —
+        the artifacts are best-effort, the simulation is not."""
+        from pos_evolution_tpu.profiling import ProfiledRegion
+        from pos_evolution_tpu.profiling.export import write_artifacts
+        if self.telemetry is not None and not self.telemetry.bus.keep_in_memory:
+            # the sim lane and span attribution are built from the
+            # in-memory event view; say so rather than silently emit an
+            # empty lane + all-unattributed tables
+            self.telemetry.bus.emit(
+                "profile_export_note",
+                warning="bus keep_in_memory=False: profile artifacts will "
+                        "carry no sim-time lane or span attribution")
+        mark = (len(self.telemetry.bus.events)
+                if self.telemetry is not None else 0)
+        with ProfiledRegion("sim_run", telemetry=self.telemetry) as prof:
+            while self.slot <= slot:
+                self.run_slot()
+        events = (self.telemetry.bus.events[mark:]
+                  if self.telemetry is not None else [])
+        try:
+            # device slices capped to the longest 50K (a CPU run records
+            # one event per thunk execution — tens of MB untruncated; the
+            # cap lands in a "truncated" metadata event)
+            written = write_artifacts(self.profile, events=events,
+                                      planes=prof.planes,
+                                      top_ops=prof.top_ops,
+                                      max_device_events=50_000,
+                                      exclude_ops={"sim_run"})
+            if self.telemetry is not None:
+                # record where the artifacts landed so offline consumers
+                # (run_report top-ops auto-discovery) can find them from
+                # the event log alone
+                self.telemetry.bus.emit("profile_artifacts",
+                                        dir=self.profile,
+                                        files=sorted(written))
+        except Exception as e:
+            # not just OSError: a non-JSON-serializable payload some
+            # emitter slipped onto an in-memory bus surfaces here as
+            # TypeError — the completed run must survive it regardless
+            if self.telemetry is not None:
+                self.telemetry.bus.emit("profile_export_failed",
+                                        error=f"{e!r:.200}")
 
     def run_epochs(self, n_epochs: int) -> None:
         self.run_until_slot(n_epochs * self.cfg.slots_per_epoch)

@@ -93,34 +93,46 @@ class EventBus:
         self.close()
 
 
-def read_jsonl(path: str | os.PathLike) -> list[dict]:
-    """Load a JSONL event log back into memory, sorted by ``seq``.
+def read_versioned_jsonl(path: str | os.PathLike, expected_version: int,
+                         label: str = "event") -> list[dict]:
+    """The one torn-tail-tolerant, schema-versioned JSONL reader — shared
+    by the telemetry event log and the bench history
+    (``profiling/history.py``), so the subtle semantics cannot drift
+    between them.
 
     Tolerates a torn FINAL line (a run killed mid-write) — everything
     before it is still usable, which is the point of line-at-a-time
     commit. A decode error anywhere EARLIER is corruption, not a torn
     tail, and raises with the line number: silently dropping the suffix
-    would present a truncated run as a complete one. Also raises on an
-    unknown schema version: consumers must not misread future formats.
+    would present a truncated log as a complete one. Also raises on an
+    unknown ``"v"``: consumers must not misread future formats.
     """
     with open(path) as fh:
         lines = [(i + 1, line.strip()) for i, line in enumerate(fh)]
     lines = [(ln, text) for ln, text in lines if text]
-    events = []
+    out = []
     for pos, (ln, text) in enumerate(lines):
         try:
-            ev = json.loads(text)
+            obj = json.loads(text)
         except json.JSONDecodeError:
             if pos == len(lines) - 1:
                 break  # torn tail from a killed writer
             raise ValueError(
-                f"{os.fspath(path)}:{ln}: corrupt event line mid-log "
+                f"{os.fspath(path)}:{ln}: corrupt {label} line mid-log "
                 f"(only the final line may be torn)")
-        v = ev.get("v")
-        if v != SCHEMA_VERSION:
+        v = obj.get("v")
+        if v != expected_version:
             raise ValueError(
-                f"unknown telemetry schema version {v!r} "
-                f"(this reader understands v{SCHEMA_VERSION})")
-        events.append(ev)
+                f"unknown {label} schema version {v!r} "
+                f"(this reader understands v{expected_version})")
+        out.append(obj)
+    return out
+
+
+def read_jsonl(path: str | os.PathLike) -> list[dict]:
+    """Load a JSONL event log back into memory, sorted by ``seq``
+    (see ``read_versioned_jsonl`` for the torn-tail/corruption/schema
+    contract)."""
+    events = read_versioned_jsonl(path, SCHEMA_VERSION, label="event")
     events.sort(key=lambda e: e.get("seq", 0))
     return events

@@ -46,24 +46,38 @@ def checksum_tree(out) -> jax.Array:
 
 
 def fused_measure(body, *, k_hi: int = 4, entropy: int | None = None,
-                  tag: str = "", reps: int = 2) -> float:
+                  tag: str = "", reps: int = 2, captures=None) -> float:
     """Per-iteration seconds for ``body(salt_i32, acc_i32) -> acc_i32``.
 
     ``body`` must fold ``salt`` into its inputs and fold all its outputs
     into the returned accumulator (use ``checksum_tree``).
+
+    ``captures``: an optional pytree of arrays passed to ``body`` as a
+    third argument, **traced** through the jitted loop. Pass the big
+    lookup tables here instead of closing over them: a closed-over array
+    becomes an HLO *constant*, and XLA's constant-folding pass will
+    happily evaluate a whole scatter/reduce chain over it at compile
+    time — the ``s64[65]`` scatter-add in ``head_and_weights`` cost >1 s
+    per compile in BENCH_r05 exactly this way (the message table and
+    weights were closures, so the per-block vote reduction was a
+    compile-time constant). Traced captures keep compilation
+    O(program), and the workload they feed is measured, not folded.
     """
     ent = entropy if entropy is not None else \
         int.from_bytes(os.urandom(3), "little")
 
     @jax.jit
-    def run(k, salt0):
+    def run(k, salt0, cap):
         def step(i, acc):
-            return body(salt0 + i, acc)
+            if captures is None:
+                return body(salt0 + i, acc)
+            return body(salt0 + i, acc, cap)
         return jax.lax.fori_loop(0, k, step, jnp.int32(0))
 
     def t_of(k: int, salt0: int) -> float:
         t0 = time.perf_counter()
-        out = np.asarray(run(jnp.int32(k), jnp.int32(salt0)))  # transfer = sync
+        out = np.asarray(run(jnp.int32(k), jnp.int32(salt0),
+                             captures))  # transfer = sync
         elapsed = time.perf_counter() - t0
         # runtime telemetry (no-ops unless a registry is installed): one
         # dispatch + one d2h checksum transfer per timed call

@@ -43,6 +43,10 @@ class HandlerTimer:
         return wrapped
 
     def percentile(self, name: str, q: float) -> float:
+        """The q-th percentile of ``name``'s samples in SECONDS (NaN when
+        empty) — the one accessor every consumer (benches, the profiling
+        exporters, ``summary()`` itself) derives p50/p95 from, instead of
+        re-implementing percentile math over raw sample lists."""
         xs = self.samples.get(name, [])
         return float(np.percentile(xs, q)) if xs else float("nan")
 
@@ -53,14 +57,12 @@ class HandlerTimer:
 
     def summary(self) -> dict:
         # an empty sample list (a handler registered but never hit, or a
-        # summary taken right after reset()) must not crash np.percentile
+        # summary taken right after reset()) is NaN/0, never a crash
         return {
             name: {
                 "count": len(xs),
-                "p50_ms": round(float(np.percentile(xs, 50)) * 1e3, 4)
-                if xs else float("nan"),
-                "p95_ms": round(float(np.percentile(xs, 95)) * 1e3, 4)
-                if xs else float("nan"),
+                "p50_ms": round(self.percentile(name, 50) * 1e3, 4),
+                "p95_ms": round(self.percentile(name, 95) * 1e3, 4),
                 "total_s": round(float(np.sum(xs)), 4) if xs else 0.0,
             }
             for name, xs in self.samples.items()

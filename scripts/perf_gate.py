@@ -34,10 +34,21 @@ Timing keys (``value`` seconds, ``*_ms`` leaves) are compared as ratios
 and printed; they fail the gate only under ``--strict-timing`` (meant
 for same-hardware A/B runs, never CPU CI).
 
+**History mode** (``--history bench_history.jsonl``): instead of one
+baseline file, gate against the robust band of the last ``--window``
+entries of a ``profiling/history.py`` time-series — a count metric fails
+only when it exceeds ``median + max(mad_k · 1.4826 · MAD, abs_slack)``
+of its own recent history, so one noisy run neither poisons the band
+nor slips a slow drift through. Empty history passes vacuously
+(loudly); a history whose counts share no keys with the candidate is
+incomparable and refuses with exit 2, same as baseline mode.
+
 Usage:
     python scripts/perf_gate.py --candidate fresh.json
         [--baseline BENCH_r05.json] [--rel-tol 1.25] [--abs-slack 4]
         [--count-only] [--strict-timing]
+        [--history bench_history.jsonl] [--window 20] [--mad-k 4.0]
+        [--kind bench]
 
 ``--baseline`` defaults to the newest ``BENCH_r*.json`` /
 ``BENCH_ALL_r*.json`` in the repo root, falling back to
@@ -55,6 +66,7 @@ import re
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
 
 def extract_counts(obj: dict) -> dict[str, float]:
@@ -117,8 +129,9 @@ def default_baseline() -> str | None:
 
 def gate(baseline: dict, candidate: dict, rel_tol: float, abs_slack: float,
          count_only: bool = True, strict_timing: bool = False,
-         out=sys.stdout) -> int:
+         out=None) -> int:
     """Compare two emissions; returns the process exit code."""
+    out = out if out is not None else sys.stdout  # late-bound: capsys swaps
     b_counts, c_counts = extract_counts(baseline), extract_counts(candidate)
     shared = sorted(set(b_counts) & set(c_counts))
     failures = []
@@ -177,6 +190,126 @@ def gate(baseline: dict, candidate: dict, rel_tol: float, abs_slack: float,
     return 0
 
 
+def gate_history(history_path: str, candidate: dict, window: int,
+                 mad_k: float, abs_slack: float, rel_tol: float = 1.25,
+                 kind: str | None = None, count_only: bool = True,
+                 strict_timing: bool = False, out=None) -> int:
+    """Gate one emission against the robust band of its own history
+    (``profiling/history.py``); returns the process exit code.
+
+    Timing metrics get a RELATIVE slack floor (``rel_tol`` - 1, matching
+    baseline mode's ratio semantics) instead of the count-calibrated
+    ``abs_slack`` — 4 absolute units would swallow any regression of a
+    sub-4ms metric.
+
+    ``kind`` selects which emission family the band is computed over.
+    ``bench.py`` and ``bench_all.py`` share one history file and share
+    count KEYS at very different magnitudes; a band over the mixture is
+    bimodal garbage, so a mixed-kind history without an explicit
+    ``--kind`` refuses with exit 2 rather than gate against it."""
+    from pos_evolution_tpu.profiling import history as hist
+
+    out = out if out is not None else sys.stdout  # late-bound: capsys swaps
+    try:
+        # window applies AFTER the kind filter: the band must cover the
+        # last N entries of the candidate's own family
+        entries = hist.read_history(history_path)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: history unreadable: {e}", file=out)
+        return 2
+    if kind is not None:
+        entries = [e for e in entries if e.get("kind") == kind]
+    else:
+        # an entry with no "kind" sorts as None — key it explicitly or
+        # sorted() raises TypeError instead of the deliberate exit 2
+        kinds = sorted({e.get("kind") for e in entries},
+                       key=lambda k: (k is None, k or ""))
+        if len(kinds) > 1:
+            print(f"history holds MIXED emission kinds {kinds} sharing "
+                  f"count keys at different magnitudes — a band over the "
+                  f"mixture would gate nothing honestly. Pass --kind.",
+                  file=out)
+            return 2
+    entries = entries[-window:]
+    c_counts = extract_counts(candidate)
+    # benches append their emission BEFORE anyone gates it: when the
+    # newest entry IS the candidate (identical count emission), gating
+    # against it would let the candidate vouch for itself — and a
+    # regressed run re-gated N times would self-legitimize as its own
+    # entries fill the window. Exclude it from the band.
+    if entries and extract_counts(
+            entries[-1].get("emission") or {}) == c_counts:
+        entries = entries[:-1]
+        print("note: newest history entry matches the candidate emission "
+              "— excluded from the band (no self-gating)", file=out)
+    series = hist.series_from_history(entries, extract_counts)
+    if not entries:
+        print(f"history {history_path}: EMPTY — gate passes VACUOUSLY "
+              f"(first entry seeds the band)", file=out)
+        print("PERF GATE: pass", file=out)
+        return 0
+    print(f"history: {len(entries)} entr"
+          f"{'y' if len(entries) == 1 else 'ies'} (window {window}), "
+          f"band = median ± max({mad_k}·1.4826·MAD, {abs_slack})", file=out)
+
+    rows = hist.band_verdicts(c_counts, series, k=mad_k,
+                              abs_slack=abs_slack)
+    failures = []
+    compared = 0
+    for row in rows:
+        if row["verdict"] == "skip":
+            print(f"  [skip] {row['key']}: no history "
+                  f"(candidate={row['value']})", file=out)
+            continue
+        compared += 1
+        if row["verdict"] == "FAIL":
+            failures.append(row["key"])
+        print(f"  [{row['verdict']}] {row['key']}: "
+              f"candidate={row['value']} median={row['median']:.6g} "
+              f"mad={row['mad']:.6g} hi={row['hi']:.6g} (n={row['n']})",
+              file=out)
+    for key in sorted(set(series) - set(c_counts)):
+        # baseline mode reports vanished metrics; a renamed counter must
+        # stay visible here too, not silently fall out of the band
+        print(f"  [skip] {key}: vanished from candidate "
+              f"(history n={len(series[key])})", file=out)
+    if not compared:
+        if c_counts and series:
+            print("  candidate and history both carry counts but share NO "
+                  "keys — incomparable emission shapes; refusing to gate",
+                  file=out)
+            return 2
+        print("  no comparable count metrics — gate passes VACUOUSLY "
+              "(history predates telemetry counts?)", file=out)
+
+    if not count_only:
+        c_times = extract_timings(candidate)
+        t_series = hist.series_from_history(entries, extract_timings)
+        t_rows = hist.band_verdicts(c_times, t_series, k=mad_k,
+                                    abs_slack=0.0,
+                                    rel_slack=max(rel_tol - 1.0, 0.0))
+        print(f"timing metrics ({'GATED' if strict_timing else 'report-only'}"
+              f"): {sum(r['verdict'] != 'skip' for r in t_rows)} comparable",
+              file=out)
+        for row in t_rows:
+            if row["verdict"] == "skip":
+                continue
+            flag = strict_timing and row["verdict"] == "FAIL"
+            if flag:
+                failures.append(f"timing:{row['key']}")
+            print(f"  [{'FAIL' if flag else '--'}] {row['key']}: "
+                  f"candidate={row['value']:.6g} median={row['median']:.6g} "
+                  f"hi={row['hi']:.6g} (n={row['n']})", file=out)
+
+    if failures:
+        print(f"PERF GATE: FAIL ({len(failures)} regression"
+              f"{'s' if len(failures) != 1 else ''} vs history band): "
+              + ", ".join(failures), file=out)
+        return 1
+    print("PERF GATE: pass", file=out)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--candidate", required=True,
@@ -191,7 +324,33 @@ def main(argv=None) -> int:
     ap.add_argument("--strict-timing", action="store_true",
                     help="timing regressions also fail the gate "
                          "(same-hardware A/B only)")
+    ap.add_argument("--history",
+                    help="gate against a bench_history.jsonl robust band "
+                         "instead of a single baseline file")
+    ap.add_argument("--window", type=int, default=20,
+                    help="history entries the band is computed over")
+    ap.add_argument("--mad-k", type=float, default=4.0,
+                    help="band halfwidth in scaled-MAD units")
+    ap.add_argument("--kind",
+                    help="history emission kind to gate against (e.g. "
+                         "bench / bench_all); required when the history "
+                         "file holds mixed kinds")
     args = ap.parse_args(argv)
+
+    if args.history:
+        try:
+            with open(args.candidate) as fh:
+                candidate = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"perf_gate: {e}", file=sys.stderr)
+            return 2
+        print(f"history:   {args.history}")
+        print(f"candidate: {args.candidate}")
+        return gate_history(args.history, candidate, window=args.window,
+                            mad_k=args.mad_k, abs_slack=args.abs_slack,
+                            rel_tol=args.rel_tol, kind=args.kind,
+                            count_only=args.count_only,
+                            strict_timing=args.strict_timing)
 
     baseline_path = args.baseline or default_baseline()
     if baseline_path is None or not os.path.exists(baseline_path):

@@ -12,13 +12,20 @@ Reconstructs, from the event log alone (no live ``Simulation``):
 - **handler percentiles** — p50/p95/count over every event carrying
   ``handler`` + ``duration_ms`` (deliveries and ``get_head`` queries);
 - **light-client lag** — worst/final head- and finality-lag per node;
-- **top device ops** — folded in from a ``bench_trace/top_ops.json``
-  passed via ``--top-ops`` (the xplane summary of
-  ``scripts/trace_summary.py``), when one exists.
+- **top device ops** — folded in from a ``top_ops.json`` (the xplane
+  summary of ``pos_evolution_tpu/profiling/xplane.py``). When
+  ``--top-ops`` is not given, the report auto-discovers
+  ``top_ops.json`` / ``bench_trace/top_ops.json`` next to the event log
+  (reports used to silently omit device ops whenever the flag was
+  forgotten);
+- **static cost tables** — a ``profiling/cost.py`` emission passed via
+  ``--cost`` lands under ``cost_analysis`` (per-kernel FLOPs / bytes /
+  peak memory next to the observed timeline).
 
 Usage:
     python scripts/run_report.py events.jsonl [--json out.json]
                                  [--markdown out.md] [--top-ops top_ops.json]
+                                 [--cost cost.json]
 
 Markdown goes to stdout unless ``--markdown`` is given.
 """
@@ -50,7 +57,28 @@ def _percentile(xs: list[float], q: float) -> float:
     return xs[lo] * (1 - frac) + xs[hi] * frac
 
 
-def build_report(events: list[dict], top_ops: dict | None = None) -> dict:
+def discover_top_ops(events_path: str, events=()) -> str | None:
+    """``top_ops.json`` next to the event log, under a sibling
+    ``bench_trace/`` (the spots ``bench.py`` writes to), or wherever a
+    ``profile_artifacts`` event in the log itself says
+    ``Simulation(profile=<dir>)`` dropped its artifacts."""
+    here = os.path.dirname(os.path.abspath(events_path))
+    # the log's own recorded artifact dir is authoritative — proximity
+    # guesses come AFTER it, or a stale bench_trace/ next to the log
+    # would shadow this run's actual profile
+    cands = [os.path.join(ev["dir"], "top_ops.json")
+             for ev in events
+             if ev.get("type") == "profile_artifacts" and ev.get("dir")]
+    cands += [os.path.join(here, "top_ops.json"),
+              os.path.join(here, "bench_trace", "top_ops.json")]
+    for cand in cands:
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def build_report(events: list[dict], top_ops: dict | None = None,
+                 cost: dict | None = None) -> dict:
     """Pure JSONL -> report-dict transform (the testable core)."""
     by_type: dict[str, list[dict]] = {}
     for ev in events:
@@ -153,6 +181,15 @@ def build_report(events: list[dict], top_ops: dict | None = None) -> dict:
     }
     if top_ops:
         report["top_device_ops"] = top_ops
+    if cost:
+        report["cost_analysis"] = cost
+    # device-time attribution emitted by profiling.ProfiledRegion runs
+    profiles = by_type.get("profile", [])
+    if profiles:
+        report["profiles"] = [
+            {k: p.get(k) for k in ("name", "by_jit", "attribution",
+                                   "trace_dir", "error") if k in p}
+            for p in profiles]
     return report
 
 
@@ -237,6 +274,36 @@ def to_markdown(report: dict) -> str:
                             [[r["op"], r["total_ms"], r["count"]]
                              for r in rows])
             md.append("")
+
+    if report.get("profiles"):
+        md += ["", "## Device-time attribution", ""]
+        for p in report["profiles"]:
+            md.append(f"### region `{p.get('name', '?')}`")
+            if p.get("error"):
+                md.append(f"- profiling degraded: {p['error']}")
+            attr = p.get("attribution") or {}
+            if attr:
+                rows = sorted(attr.items(),
+                              key=lambda kv: -kv[1].get("total_ms", 0))
+                md += _md_table(["span / kernel", "total ms", "ops"],
+                                [[k, v.get("total_ms"), v.get("count")]
+                                 for k, v in rows])
+            md.append("")
+
+    if report.get("cost_analysis"):
+        cost = report["cost_analysis"]
+        md += ["", "## Static cost analysis",
+               f"(backend {cost.get('backend')}, "
+               f"n={cost.get('n_validators')})", ""]
+        rows = []
+        for k, v in sorted((cost.get("kernels") or {}).items()):
+            if "error" in v:
+                rows.append([k, "error", v["error"][:40], "", ""])
+            else:
+                rows.append([k, v.get("flops"), v.get("bytes_accessed"),
+                             v.get("temp_bytes"), v.get("peak_bytes")])
+        md += _md_table(
+            ["kernel", "flops", "bytes accessed", "temp B", "peak B"], rows)
     return "\n".join(md) + "\n"
 
 
@@ -247,16 +314,27 @@ def main(argv=None) -> int:
     ap.add_argument("--markdown",
                     help="write markdown here instead of stdout")
     ap.add_argument("--top-ops",
-                    help="bench_trace/top_ops.json to fold into the report")
+                    help="top_ops.json to fold into the report (default: "
+                         "auto-discovered next to the event log)")
+    ap.add_argument("--cost",
+                    help="profiling/cost.py JSON emission to fold in")
     args = ap.parse_args(argv)
 
     events = read_jsonl(args.events)
+    top_ops_path = args.top_ops or discover_top_ops(args.events, events)
+    if args.top_ops is None and top_ops_path is not None:
+        print(f"# auto-discovered top-ops table: {top_ops_path}",
+              file=sys.stderr)
     top_ops = None
-    if args.top_ops and os.path.exists(args.top_ops):
-        with open(args.top_ops) as fh:
+    if top_ops_path and os.path.exists(top_ops_path):
+        with open(top_ops_path) as fh:
             blob = json.load(fh)
         top_ops = blob.get("planes", blob)
-    report = build_report(events, top_ops=top_ops)
+    cost = None
+    if args.cost and os.path.exists(args.cost):
+        with open(args.cost) as fh:
+            cost = json.load(fh)
+    report = build_report(events, top_ops=top_ops, cost=cost)
     if args.json:
         with open(args.json, "w") as fh:
             json.dump(report, fh, indent=1, sort_keys=True)

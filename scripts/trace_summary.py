@@ -1,132 +1,25 @@
 """Summarize a jax.profiler xplane trace into a top-N op table.
 
-No xplane_pb2 bindings ship in this image, so this walks the protobuf
-wire format directly with the field numbers from
-tsl/profiler/protobuf/xplane.proto (stable public schema):
-
-    XSpace.planes = 1
-    XPlane.name = 2, XPlane.lines = 3, XPlane.event_metadata = 4 (map)
-    XLine.name = 2, XLine.events = 4
-    XEvent.metadata_id = 1, XEvent.duration_ps = 3
-    XEventMetadata.id = 1, XEventMetadata.name = 2
+Thin CLI shim: the wire-format parser lives in
+``pos_evolution_tpu/profiling/xplane.py`` (importable; also feeds the
+Chrome-trace exporter and the span-attribution pass). This entry point
+keeps the historic invocation working:
 
 Usage: python scripts/trace_summary.py <trace_dir_or_xplane.pb> [top_n]
-Prints one line per op: total_ms, count, op name — device planes first.
+Prints the top-N table as JSON — device planes first.
 """
 
-import glob
 import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def _varint(buf, i):
-    out = shift = 0
-    while True:
-        b = buf[i]
-        out |= (b & 0x7F) << shift
-        i += 1
-        if not b & 0x80:
-            return out, i
-        shift += 7
-
-
-def _fields(buf):
-    """Yield (field_number, wire_type, value) over a message buffer."""
-    i, n = 0, len(buf)
-    while i < n:
-        key, i = _varint(buf, i)
-        fnum, wtype = key >> 3, key & 7
-        if wtype == 0:
-            val, i = _varint(buf, i)
-        elif wtype == 1:
-            val, i = buf[i:i + 8], i + 8
-        elif wtype == 2:
-            ln, i = _varint(buf, i)
-            val, i = buf[i:i + ln], i + ln
-        elif wtype == 5:
-            val, i = buf[i:i + 4], i + 4
-        else:
-            raise ValueError(f"unsupported wire type {wtype}")
-        yield fnum, wtype, val
-
-
-def summarize_xplane(data: bytes):
-    """-> list of planes: {name, ops: {op_name: [total_ps, count]}}."""
-    planes = []
-    for fnum, _, plane_buf in _fields(data):
-        if fnum != 1:
-            continue
-        name, metadata, lines = "", {}, []
-        for pf, _, pv in _fields(plane_buf):
-            if pf == 2:
-                name = pv.decode("utf-8", "replace")
-            elif pf == 3:
-                lines.append(pv)
-            elif pf == 4:  # map<int64, XEventMetadata> entry
-                mid, mname = 0, ""
-                for mf, _, mv in _fields(pv):
-                    if mf == 1:
-                        mid = mv
-                    elif mf == 2:  # XEventMetadata
-                        for ef, _, ev in _fields(mv):
-                            if ef == 1:
-                                mid = ev
-                            elif ef == 2:
-                                mname = ev.decode("utf-8", "replace")
-                metadata[mid] = mname
-        ops = {}
-        for line_buf in lines:
-            for lf, _, lv in _fields(line_buf):
-                if lf != 4:
-                    continue
-                mid = dur = 0
-                for ef, _, ev in _fields(lv):
-                    if ef == 1:
-                        mid = ev
-                    elif ef == 3:
-                        dur = ev
-                key = metadata.get(mid, f"#{mid}")
-                tot = ops.get(key)
-                if tot is None:
-                    ops[key] = [dur, 1]
-                else:
-                    tot[0] += dur
-                    tot[1] += 1
-        if ops:
-            planes.append({"name": name, "ops": ops})
-    return planes
-
-
-def top_table(planes, top_n=10):
-    """-> dict plane name -> top-N [{op, total_ms, count}] (device-ish
-    planes sorted first)."""
-    def rank(p):
-        n = p["name"].lower()
-        return (0 if ("device" in n or "tpu" in n or "gpu" in n
-                      or "xla" in n) else 1, p["name"])
-
-    out = {}
-    for p in sorted(planes, key=rank):
-        rows = sorted(p["ops"].items(), key=lambda kv: -kv[1][0])[:top_n]
-        out[p["name"]] = [
-            {"op": k, "total_ms": round(v[0] / 1e9, 3), "count": v[1]}
-            for k, v in rows if v[0] > 0]
-    return {k: v for k, v in out.items() if v}
-
-
-def summarize_path(path, top_n=10):
-    files = ([path] if os.path.isfile(path) else
-             glob.glob(os.path.join(path, "**", "*.xplane.pb"),
-                       recursive=True))
-    if not files:
-        raise FileNotFoundError(f"no .xplane.pb under {path}")
-    planes = []
-    for f in files:
-        with open(f, "rb") as fh:
-            planes.extend(summarize_xplane(fh.read()))
-    return top_table(planes, top_n)
-
+from pos_evolution_tpu.profiling.xplane import (  # noqa: E402,F401
+    summarize_path,
+    summarize_xplane,   # re-exported for legacy importers
+    top_table,          # re-exported for legacy importers
+)
 
 if __name__ == "__main__":
     if len(sys.argv) < 2:

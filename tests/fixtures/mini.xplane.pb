@@ -1,0 +1,3 @@
+
+š/device:TPU:0 (fixture)DXLA OpsÀ„="€Ð¬ó"À–±€´ÄÃ!"€ÚÄ	€¨Ö¹"€‡§€Êµî	XLA Ops#1€‰z"€”ëÜ"=95jit(run)/while/body/jit(head_and_weights)/scatter-add"C?;jit(run)/while/body/jit(aggregate_verify_batch)/dot-general"jit(run)/transpose
+8	/host:CPUpython Â"€À²Í;"bench_epoch

@@ -1,0 +1,681 @@
+"""Profiling subsystem tests (ISSUE 4): the xplane wire-format parser
+against a small checked-in ``*.xplane.pb`` fixture, device-op
+attribution, Chrome-trace / collapsed-stack exporters (structural
+validity of what Perfetto loads), static cost analysis of the hot-path
+kernels, the bench-history robust gate's statistics (including empty /
+single-entry histories and a doctored regression), and the
+compile-count pin for the ``fused_measure`` traced-captures fix."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from pos_evolution_tpu.profiling import (  # noqa: E402
+    attribution,
+    export,
+    history,
+    xplane,
+)
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "mini.xplane.pb")
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "golden_telemetry.jsonl")
+
+
+def _fixture_planes():
+    with open(FIXTURE, "rb") as fh:
+        return xplane.parse_xspace(fh.read())
+
+
+# -- xplane parser -------------------------------------------------------------
+
+class TestXplaneParser:
+    def test_fixture_round_trip(self):
+        """parse -> encode -> parse is the identity on the checked-in
+        fixture (planes, lines, event-metadata all survive)."""
+        planes = _fixture_planes()
+        assert [p["name"] for p in planes] == ["/device:TPU:0 (fixture)",
+                                               "/host:CPU"]
+        assert xplane.parse_xspace(xplane.encode_xspace(planes)) == planes
+
+    def test_fixture_structure(self):
+        dev, host = _fixture_planes()
+        assert dev["event_metadata"][1].endswith("scatter-add")
+        assert [ln["name"] for ln in dev["lines"]] == ["XLA Ops", "XLA Ops#1"]
+        assert dev["lines"][0]["timestamp_ns"] == 1_000_000
+        assert dev["lines"][0]["events"][1] == {
+            "metadata_id": 2, "offset_ps": 5_000_000,
+            "duration_ps": 9_000_000_000}
+        assert host["lines"][0]["events"][0]["duration_ps"] \
+            == 16_000_000_000
+
+    def test_top_table_stability(self):
+        """The top-N table is deterministic: device plane first, rows by
+        descending total, exact totals."""
+        top = xplane.summarize_path(FIXTURE, 2)
+        assert list(top) == ["/device:TPU:0 (fixture)", "/host:CPU"]
+        assert top["/device:TPU:0 (fixture)"] == [
+            {"op": "jit(run)/while/body/jit(aggregate_verify_batch)"
+                   "/dot-general", "total_ms": 10.0, "count": 2},
+            {"op": "jit(run)/while/body/jit(head_and_weights)/scatter-add",
+             "total_ms": 6.0, "count": 2},
+        ]
+        assert top["/host:CPU"] == [
+            {"op": "bench_epoch", "total_ms": 16.0, "count": 1}]
+
+    def test_legacy_aggregate_view(self):
+        with open(FIXTURE, "rb") as fh:
+            planes = xplane.summarize_xplane(fh.read())
+        dev = planes[0]["ops"]
+        assert dev["jit(run)/while/body/jit(head_and_weights)/scatter-add"] \
+            == [6_000_000_000, 2]
+
+    def test_trace_summary_shim_still_works(self):
+        """scripts/trace_summary.py stays a working CLI facade."""
+        import trace_summary
+        top = trace_summary.summarize_path(FIXTURE, 1)
+        assert top["/host:CPU"][0]["op"] == "bench_epoch"
+
+    def test_summarize_path_missing(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            xplane.summarize_path(tmp_path)
+
+    def test_truncated_bytes_raise_valueerror(self):
+        """A partially written protobuf (killed writer, full disk) must
+        be a loud ValueError — the one exception type ProfiledRegion's
+        degrade-don't-die contract is allowed to see — at EVERY
+        truncation point, never an IndexError."""
+        with open(FIXTURE, "rb") as fh:
+            data = fh.read()
+        for cut in range(1, len(data)):
+            try:
+                xplane.parse_xspace(data[:cut])
+            except ValueError:
+                pass  # loud and typed is the contract
+
+
+# -- attribution ---------------------------------------------------------------
+
+class TestAttribution:
+    def test_innermost_jit(self):
+        assert attribution.innermost_jit(
+            "jit(run)/while/jit(head_and_weights)/scatter-add") \
+            == "head_and_weights"
+        assert attribution.innermost_jit("copy-start") is None
+
+    def test_group_by_jit_device_plane_only(self):
+        groups = attribution.group_by_jit(_fixture_planes())
+        assert groups["head_and_weights"]["total_ms"] == pytest.approx(6.0)
+        assert groups["head_and_weights"]["count"] == 2
+        assert groups["aggregate_verify_batch"]["total_ms"] \
+            == pytest.approx(10.0)
+        # host plane excluded when a device plane exists
+        assert "unjitted" not in groups
+
+    def test_group_by_jit_host_fallback(self):
+        host_only = [p for p in _fixture_planes() if "host" in p["name"]]
+        groups = attribution.group_by_jit(host_only)
+        assert groups["unjitted"]["total_ms"] == pytest.approx(16.0)
+
+    def test_attribute_to_spans_partitions_totals(self):
+        attr = attribution.attribute_to_spans(
+            _fixture_planes(), ["aggregate_verify_batch", "nonexistent"])
+        assert attr["aggregate_verify_batch"]["total_ms"] \
+            == pytest.approx(10.0)
+        assert attr["unattributed"]["total_ms"] == pytest.approx(6.5)
+        total = sum(v["total_ms"] for v in attr.values())
+        assert total == pytest.approx(16.5)  # device plane total preserved
+
+
+# -- exporters -----------------------------------------------------------------
+
+def _valid_chrome(blob: dict) -> None:
+    """Structural trace_event validation: what Perfetto's legacy JSON
+    importer requires of the object form."""
+    assert isinstance(blob, dict)
+    evs = blob["traceEvents"]
+    assert isinstance(evs, list) and evs
+    json.dumps(blob)  # must be JSON-serializable end to end
+    for ev in evs:
+        assert isinstance(ev["ph"], str) and ev["ph"] in ("X", "M", "I", "i")
+        assert isinstance(ev["name"], str)
+        assert isinstance(ev["pid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float))
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+
+
+class TestChromeTrace:
+    def test_golden_events_export(self):
+        from pos_evolution_tpu.telemetry import read_jsonl
+        events = read_jsonl(GOLDEN)
+        blob = export.chrome_trace(events)
+        _valid_chrome(blob)
+        slices = [e for e in blob["traceEvents"] if e["ph"] == "X"]
+        # deliver events carry t + duration_ms -> exact slices
+        deliver = [e for e in slices if e["cat"] == "deliver"]
+        assert deliver and deliver[0]["ts"] == pytest.approx(12.0 * 1e6)
+        assert deliver[0]["dur"] == pytest.approx(18.5 * 1e3)
+        # propose has no t of its own: inherits the earliest child t
+        propose = [e for e in slices if e["cat"] == "propose"]
+        assert propose and propose[0]["ts"] == pytest.approx(12.0 * 1e6)
+
+    def test_device_planes_fold_in(self):
+        from pos_evolution_tpu.telemetry import read_jsonl
+        blob = export.chrome_trace(read_jsonl(GOLDEN),
+                                   device_planes=_fixture_planes())
+        _valid_chrome(blob)
+        dev = [e for e in blob["traceEvents"]
+               if e.get("pid") == export.DEVICE_PID and e["ph"] == "X"]
+        assert len(dev) == 6  # 5 device events + 1 host event
+        assert any(e["args"]["op_name"].endswith("scatter-add")
+                   for e in dev)
+
+    def test_device_event_cap_is_loud(self):
+        """max_device_events keeps the longest slices and records the
+        drop in a 'truncated' metadata event — never a silent cap."""
+        blob = export.chrome_trace([], device_planes=_fixture_planes(),
+                                   max_device_events=2)
+        _valid_chrome(blob)
+        slices = [e for e in blob["traceEvents"] if e["ph"] == "X"]
+        assert len(slices) == 2
+        # the two longest device events survive (16ms host, 9ms dot)
+        assert sorted(e["dur"] for e in slices) == [9000.0, 16000.0]
+        trunc = [e for e in blob["traceEvents"] if e["name"] == "truncated"]
+        assert trunc and trunc[0]["args"]["dropped_short_events"] == 4
+
+    def test_collapsed_stacks_format(self):
+        from pos_evolution_tpu.telemetry import read_jsonl
+        lines = export.collapsed_stacks(read_jsonl(GOLDEN))
+        assert lines
+        for line in lines:
+            stack, weight = line.rsplit(" ", 1)
+            assert int(weight) >= 1
+            assert stack  # frames joined by ';'
+        assert any(line.startswith("propose;gossip:block;deliver:on_block")
+                   for line in lines)
+
+    def test_device_collapsed_stacks(self):
+        lines = export.device_collapsed_stacks(_fixture_planes())
+        joined = "\n".join(lines)
+        assert "jit(run);while;body;jit(head_and_weights);scatter-add" \
+            in joined
+        # weights are integer microseconds of the summed durations
+        row = [ln for ln in lines if "scatter-add" in ln][0]
+        assert int(row.rsplit(" ", 1)[1]) == 6000
+
+    def test_export_cli(self, tmp_path):
+        import shutil
+        events = tmp_path / "events.jsonl"
+        shutil.copy(GOLDEN, events)
+        chrome = tmp_path / "trace.json"
+        flame = tmp_path / "flame.txt"
+        dflame = tmp_path / "flame_dev.txt"
+        rc = export.main([str(events), "--chrome", str(chrome),
+                          "--flame", str(flame), "--xplane", FIXTURE,
+                          "--device-flame", str(dflame)])
+        assert rc == 0
+        _valid_chrome(json.loads(chrome.read_text()))
+        assert flame.read_text().strip()
+        assert "scatter-add" in dflame.read_text()
+
+
+# -- run_report integration ----------------------------------------------------
+
+class TestRunReportProfileFolding:
+    def test_top_ops_auto_discovery(self, tmp_path, capsys):
+        """run_report picks up top_ops.json next to the event log when
+        --top-ops is not given (reports used to silently omit it)."""
+        import shutil
+
+        import run_report
+        events = tmp_path / "events.jsonl"
+        shutil.copy(GOLDEN, events)
+        top = {"backend": "cpu",
+               "planes": {"/host:CPU": [
+                   {"op": "bench_epoch", "total_ms": 16.0, "count": 1}]}}
+        (tmp_path / "top_ops.json").write_text(json.dumps(top))
+        out = tmp_path / "report.json"
+        assert run_report.main([str(events), "--json", str(out),
+                                "--markdown", str(tmp_path / "r.md")]) == 0
+        report = json.loads(out.read_text())
+        assert report["top_device_ops"]["/host:CPU"][0]["op"] \
+            == "bench_epoch"
+
+    def test_top_ops_discovered_via_profile_artifacts_event(self, tmp_path):
+        """Simulation(profile=<dir>) records where its artifacts landed;
+        run_report must find top_ops.json there from the log alone."""
+        import shutil
+
+        import run_report
+        prof_dir = tmp_path / "prof"
+        prof_dir.mkdir()
+        top = {"source": "profiled_region",
+               "planes": {"/host:CPU": [
+                   {"op": "sim_run", "total_ms": 1.0, "count": 1}]}}
+        (prof_dir / "top_ops.json").write_text(json.dumps(top))
+        events = tmp_path / "logs" / "events.jsonl"
+        events.parent.mkdir()
+        shutil.copy(GOLDEN, events)
+        with open(events, "a") as fh:
+            fh.write(json.dumps(
+                {"v": 1, "seq": 9999, "type": "profile_artifacts",
+                 "dir": str(prof_dir),
+                 "files": ["chrome_trace.json", "top_ops.json"]}) + "\n")
+        out = tmp_path / "report.json"
+        assert run_report.main([str(events), "--json", str(out),
+                                "--markdown", str(tmp_path / "r.md")]) == 0
+        report = json.loads(out.read_text())
+        assert report["top_device_ops"]["/host:CPU"][0]["op"] == "sim_run"
+
+    def test_cost_table_folds_in(self, tmp_path):
+        import shutil
+
+        import run_report
+        events = tmp_path / "ev.jsonl"
+        shutil.copy(GOLDEN, events)
+        cost = {"backend": "cpu", "n_validators": 128,
+                "kernels": {"epoch.process_epoch_dense":
+                            {"flops": 123.0, "bytes_accessed": 456.0}}}
+        cpath = tmp_path / "cost.json"
+        cpath.write_text(json.dumps(cost))
+        out = tmp_path / "report.json"
+        md = tmp_path / "r.md"
+        assert run_report.main([str(events), "--json", str(out),
+                                "--cost", str(cpath),
+                                "--markdown", str(md)]) == 0
+        report = json.loads(out.read_text())
+        assert report["cost_analysis"]["kernels"][
+            "epoch.process_epoch_dense"]["flops"] == 123.0
+        assert "Static cost analysis" in md.read_text()
+
+
+# -- bench history + robust gate ----------------------------------------------
+
+class TestHistoryStats:
+    def test_append_read_round_trip(self, tmp_path):
+        path = tmp_path / "hist.jsonl"
+        history.append_entry(path, {"value": 1.0}, kind="bench")
+        history.append_entry(path, {"value": 2.0}, kind="bench",
+                             top_ops={"p": []})
+        entries = history.read_history(path)
+        assert [e["emission"]["value"] for e in entries] == [1.0, 2.0]
+        assert entries[1]["top_ops"] == {"p": []}
+        assert all(e["v"] == history.HISTORY_SCHEMA_VERSION
+                   for e in entries)
+
+    def test_torn_tail_tolerated_mid_corruption_raises(self, tmp_path):
+        path = tmp_path / "hist.jsonl"
+        history.append_entry(path, {"value": 1.0}, kind="bench")
+        with open(path, "a") as fh:
+            fh.write('{"v": 1, "emission": {"val')  # torn final line
+        assert len(history.read_history(path)) == 1
+        with open(path, "w") as fh:
+            fh.write('not json\n')
+            fh.write(json.dumps({"v": 1, "emission": {}}) + "\n")
+        with pytest.raises(ValueError, match="corrupt bench-history line"):
+            history.read_history(path)
+
+    def test_unknown_schema_version_refused(self, tmp_path):
+        path = tmp_path / "hist.jsonl"
+        path.write_text('{"v": 99, "emission": {}}\n')
+        with pytest.raises(ValueError, match="schema version"):
+            history.read_history(path)
+
+    def test_window(self, tmp_path):
+        path = tmp_path / "hist.jsonl"
+        for i in range(10):
+            history.append_entry(path, {"value": i}, kind="bench")
+        assert [e["emission"]["value"]
+                for e in history.read_history(path, window=3)] == [7, 8, 9]
+
+    def test_robust_band_mad(self):
+        # median 10, MAD 1 -> sigma-ish halfwidth k*1.4826
+        band = history.robust_band([8, 9, 10, 11, 12], k=2.0, abs_slack=0.0)
+        assert band["median"] == 10
+        assert band["mad"] == 1
+        assert band["hi"] == pytest.approx(10 + 2 * 1.4826)
+
+    def test_robust_band_outlier_resistance(self):
+        """One wild outlier widens a stddev band but not the MAD band."""
+        band = history.robust_band([10, 10, 10, 10, 1000], k=4.0,
+                                   abs_slack=0.0)
+        assert band["median"] == 10
+        assert band["hi"] == 10  # MAD is still 0
+
+    def test_degenerate_band_gets_abs_slack_floor(self):
+        band = history.robust_band([5, 5, 5], k=4.0, abs_slack=4.0)
+        assert band["hi"] == 9 and band["lo"] == 1
+
+    def test_band_verdicts_regression_flagged(self):
+        series = {"calls_total": [100.0] * 8}
+        ok = history.band_verdicts({"calls_total": 103.0}, series,
+                                   k=4.0, abs_slack=4.0)
+        bad = history.band_verdicts({"calls_total": 160.0}, series,
+                                    k=4.0, abs_slack=4.0)
+        assert ok[0]["verdict"] == "ok"
+        assert bad[0]["verdict"] == "FAIL"
+
+    def test_band_verdicts_skip_without_history(self):
+        rows = history.band_verdicts({"new_counter": 5.0}, {}, k=4.0)
+        assert rows[0]["verdict"] == "skip"
+
+    def test_drop_does_not_fail_one_sided(self):
+        series = {"calls_total": [100.0] * 5}
+        rows = history.band_verdicts({"calls_total": 3.0}, series)
+        assert rows[0]["verdict"] == "ok"  # vanishing work never gated
+
+
+class TestHistoryGateCLI:
+    def _emission(self, n_calls):
+        return {"telemetry": {"counts": {"handler_calls_total": n_calls}},
+                "value": 1.25}
+
+    def _write(self, tmp_path, name, obj):
+        p = tmp_path / name
+        p.write_text(json.dumps(obj))
+        return str(p)
+
+    def test_fresh_history_passes_doctored_fails(self, tmp_path, capsys):
+        import perf_gate
+        hist = tmp_path / "hist.jsonl"
+        for _ in range(5):
+            history.append_entry(hist, self._emission(100), kind="bench")
+        cand = self._write(tmp_path, "cand.json", self._emission(101))
+        assert perf_gate.main(["--candidate", cand, "--history", str(hist),
+                               "--count-only"]) == 0
+        doctored = self._write(tmp_path, "bad.json", self._emission(400))
+        assert perf_gate.main(["--candidate", doctored, "--history",
+                               str(hist), "--count-only"]) == 1
+        assert "FAIL" in capsys.readouterr().out
+
+    def test_empty_history_vacuous_pass(self, tmp_path, capsys):
+        import perf_gate
+        cand = self._write(tmp_path, "cand.json", self._emission(100))
+        hist = tmp_path / "empty.jsonl"
+        hist.write_text("")
+        assert perf_gate.main(["--candidate", cand, "--history", str(hist),
+                               "--count-only"]) == 0
+        assert "VACUOUS" in capsys.readouterr().out
+
+    def test_single_entry_band(self, tmp_path):
+        """n=1 history: MAD degenerates to 0, the abs_slack floor keeps
+        a same-ish candidate passing and a doubled one failing."""
+        import perf_gate
+        hist = tmp_path / "hist.jsonl"
+        history.append_entry(hist, self._emission(100), kind="bench")
+        near = self._write(tmp_path, "near.json", self._emission(103))
+        far = self._write(tmp_path, "far.json", self._emission(200))
+        assert perf_gate.main(["--candidate", near, "--history", str(hist),
+                               "--count-only"]) == 0
+        assert perf_gate.main(["--candidate", far, "--history", str(hist),
+                               "--count-only"]) == 1
+
+    def test_disjoint_namespaces_refused(self, tmp_path, capsys):
+        import perf_gate
+        hist = tmp_path / "hist.jsonl"
+        history.append_entry(hist, self._emission(100), kind="bench")
+        cand = self._write(tmp_path, "cand.json",
+                           {"counts": {"other_metric": 1}})
+        assert perf_gate.main(["--candidate", cand, "--history", str(hist),
+                               "--count-only"]) == 2
+        assert "incomparable" in capsys.readouterr().out
+
+    def test_candidate_own_entry_excluded_from_band(self, tmp_path,
+                                                    capsys):
+        """bench.py appends before anyone gates: a regressed emission
+        already sitting as the newest history entry must not vouch for
+        itself (with [100, 400] in-band, median 250 + MAD slack would
+        pass a 400-count candidate)."""
+        import perf_gate
+        hist = tmp_path / "hist.jsonl"
+        history.append_entry(hist, self._emission(100), kind="bench")
+        history.append_entry(hist, self._emission(400), kind="bench")
+        bad = self._write(tmp_path, "bad.json", self._emission(400))
+        assert perf_gate.main(["--candidate", bad, "--history", str(hist),
+                               "--count-only"]) == 1
+        assert "no self-gating" in capsys.readouterr().out
+
+    def test_strict_timing_uses_relative_slack(self, tmp_path):
+        """History mode gates timings with a relative floor, not the
+        count-calibrated abs_slack: a 6x regression of a sub-4ms metric
+        must fail under --strict-timing."""
+        import perf_gate
+        hist = tmp_path / "hist.jsonl"
+        for _ in range(5):
+            history.append_entry(
+                hist, {"telemetry": {"counts": {"handler_calls_total": 10}},
+                       "head_p50_ms": 0.5}, kind="bench")
+        slow = self._write(
+            tmp_path, "slow.json",
+            {"telemetry": {"counts": {"handler_calls_total": 10}},
+             "head_p50_ms": 3.0})
+        assert perf_gate.main(["--candidate", slow, "--history", str(hist),
+                               "--strict-timing"]) == 1
+        same = self._write(
+            tmp_path, "same.json",
+            {"telemetry": {"counts": {"handler_calls_total": 10}},
+             "head_p50_ms": 0.55})
+        assert perf_gate.main(["--candidate", same, "--history", str(hist),
+                               "--strict-timing"]) == 0
+
+    def test_kindless_entry_refuses_not_crashes(self, tmp_path, capsys):
+        """A hand-seeded entry with no 'kind' field must hit the
+        deliberate mixed-kind exit 2, not a sorted() TypeError."""
+        import perf_gate
+        hist = tmp_path / "hist.jsonl"
+        history.append_entry(hist, self._emission(100), kind="bench")
+        with open(hist, "a") as fh:
+            fh.write(json.dumps({"v": 1, "emission": self._emission(90)})
+                     + "\n")
+        cand = self._write(tmp_path, "cand.json", self._emission(101))
+        assert perf_gate.main(["--candidate", cand, "--history", str(hist),
+                               "--count-only"]) == 2
+        assert "MIXED" in capsys.readouterr().out
+
+    def test_mixed_kinds_refused_without_kind_flag(self, tmp_path, capsys):
+        """bench and bench_all share the history file AND the count keys
+        at different magnitudes — a band over the mixture gates nothing
+        honestly, so mixed kinds require an explicit --kind."""
+        import perf_gate
+        hist = tmp_path / "hist.jsonl"
+        for _ in range(3):
+            history.append_entry(hist, self._emission(100), kind="bench")
+            history.append_entry(hist, self._emission(9000),
+                                 kind="bench_all")
+        cand = self._write(tmp_path, "cand.json", self._emission(101))
+        assert perf_gate.main(["--candidate", cand, "--history", str(hist),
+                               "--count-only"]) == 2
+        assert "MIXED" in capsys.readouterr().out
+        # --kind selects the candidate's own family: passes against the
+        # bench band, and the bench_all entries no longer widen it
+        assert perf_gate.main(["--candidate", cand, "--history", str(hist),
+                               "--kind", "bench", "--count-only"]) == 0
+        big = self._write(tmp_path, "big.json", self._emission(9000))
+        assert perf_gate.main(["--candidate", big, "--history", str(hist),
+                               "--kind", "bench", "--count-only"]) == 1
+
+    def test_window_limits_band(self, tmp_path):
+        """Only the trailing --window entries shape the band: after a
+        legitimate step-change, old history ages out."""
+        import perf_gate
+        hist = tmp_path / "hist.jsonl"
+        for _ in range(10):
+            history.append_entry(hist, self._emission(10), kind="bench")
+        for _ in range(5):
+            history.append_entry(hist, self._emission(100), kind="bench")
+        cand = self._write(tmp_path, "cand.json", self._emission(102))
+        assert perf_gate.main(["--candidate", cand, "--history", str(hist),
+                               "--window", "5", "--count-only"]) == 0
+
+
+# -- cost analysis + live capture (jax required) -------------------------------
+
+jax = pytest.importorskip("jax")
+
+
+class TestCostAnalysis:
+    def test_hot_path_table(self):
+        from pos_evolution_tpu.profiling import cost
+        table = cost.analyze_hot_paths(n=128, capacity=16)
+        assert table["backend"] == jax.default_backend()
+        kernels = table["kernels"]
+        for name in ("aggregation.aggregate_verify_batch",
+                     "forkchoice.head_and_weights",
+                     "forkchoice.head_from_buckets",
+                     "epoch.process_epoch_dense",
+                     "sync_verify.merkle_walk",
+                     "shuffle.swap_or_not"):
+            assert name in kernels
+            row = kernels[name]
+            assert "error" not in row, f"{name}: {row}"
+            assert row.get("flops", 0) > 0
+            assert row.get("bytes_accessed", 0) > 0
+        # memory_analysis leg (present on CPU/TPU backends here)
+        agg = kernels["aggregation.aggregate_verify_batch"]
+        assert agg.get("argument_bytes", 0) > 0
+        assert agg.get("peak_bytes", 0) >= agg.get("output_bytes", 0)
+
+
+class TestProfiledRegion:
+    def test_capture_attribute_emit(self, tmp_path):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from pos_evolution_tpu.telemetry import Telemetry
+        tel = Telemetry()
+
+        @jax.jit
+        def work(x):
+            return (x @ x).sum()
+
+        x = jnp.ones((256, 256))
+        np.asarray(work(x))  # compile outside the region
+        with attribution.ProfiledRegion(
+                "test_region", telemetry=tel,
+                trace_dir=tmp_path / "trace") as prof:
+            tel.bus.emit("handler", handler="work_handler", duration_ms=1.0)
+            np.asarray(work(x))
+        assert prof.error is None, prof.error
+        assert prof.planes, "trace produced no planes"
+        assert prof.top_ops
+        profile_events = tel.bus.of_type("profile")
+        assert len(profile_events) == 1
+        assert profile_events[0]["name"] == "test_region"
+        assert "attribution" in profile_events[0]
+        # the region's own TraceAnnotation slice envelops every op it
+        # dispatched: counting it would double the table on CPU planes
+        assert "test_region" not in prof.attribution
+        assert "test_region" not in prof.by_jit
+        # explicit trace_dir is kept on disk
+        assert (tmp_path / "trace").exists()
+
+    def test_degrades_without_killing_region(self):
+        """A profiling failure must not raise out of the region body."""
+        ran = []
+        import unittest.mock as mock
+        with mock.patch.object(jax.profiler, "start_trace",
+                               side_effect=RuntimeError("boom")):
+            with attribution.ProfiledRegion("broken") as prof:
+                ran.append(True)
+        assert ran and prof.error is not None
+        assert prof.top_ops == {}
+
+
+class TestFusedMeasureCaptures:
+    def test_compile_count_unchanged_by_captures(self):
+        """The constant-folding fix (pass the fork-choice tables as
+        traced captures instead of closures) must not change how many
+        XLA backend compiles a measurement costs — pinned via the
+        telemetry jaxrt recompile counter."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from pos_evolution_tpu.ops.forkchoice import (
+            DenseStore, head_and_weights,
+        )
+        from pos_evolution_tpu.telemetry import MetricsRegistry, jaxrt
+        from pos_evolution_tpu.utils.benchtime import (
+            checksum_tree, fused_measure,
+        )
+
+        capacity, n = 16, 64
+        rng = np.random.default_rng(0)
+        store = DenseStore(
+            parent=jnp.asarray(np.arange(-1, capacity - 1, dtype=np.int32)),
+            slot=jnp.arange(capacity, dtype=jnp.int32),
+            rank=jnp.asarray(rng.permutation(capacity).astype(np.int32)),
+            real=jnp.ones(capacity, bool),
+            leaf_viable=jnp.ones(capacity, bool),
+            justified_idx=jnp.int32(0),
+            msg_block=jnp.asarray(
+                rng.integers(0, capacity, n).astype(np.int32)),
+            msg_epoch=jnp.zeros(n, jnp.int64),
+            weight=jnp.asarray(np.full(n, 32, np.int64)),
+            boost_idx=jnp.int32(capacity - 1),
+            boost_amount=jnp.int64(7),
+        )
+
+        def closure_body(salt, acc):
+            st = store._replace(
+                msg_epoch=store.msg_epoch.at[0].set(salt.astype(jnp.int64)))
+            h, w = head_and_weights(st, capacity)
+            return acc + h.astype(jnp.int32) + checksum_tree(w)
+
+        def captured_body(salt, acc, st0):
+            st = st0._replace(
+                msg_epoch=st0.msg_epoch.at[0].set(salt.astype(jnp.int64)))
+            h, w = head_and_weights(st, capacity)
+            return acc + h.astype(jnp.int32) + checksum_tree(w)
+
+        reg = MetricsRegistry()
+        was = jaxrt.current()
+        jaxrt.install(reg)
+        try:
+            def compiles():
+                return reg.counter("jax_backend_compiles_total").value()
+
+            c0 = compiles()
+            t_closure = fused_measure(closure_body, entropy=5, reps=1)
+            c1 = compiles()
+            t_captured = fused_measure(captured_body, entropy=5, reps=1,
+                                       captures=store)
+            c2 = compiles()
+        finally:
+            jaxrt.install(was)
+        assert t_closure > 0 and t_captured > 0
+        closure_compiles = c1 - c0
+        captured_compiles = c2 - c1
+        assert closure_compiles >= 1
+        assert captured_compiles == closure_compiles, (
+            f"captures changed compile count: "
+            f"{closure_compiles} -> {captured_compiles}")
+
+
+class TestSimulationProfile:
+    @pytest.mark.usefixtures("minimal_cfg")
+    def test_profiled_sim_writes_artifacts(self, tmp_path):
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.telemetry import Telemetry
+
+        tel = Telemetry()
+        sim = Simulation(16, telemetry=tel, profile=tmp_path / "prof")
+        sim.run_until_slot(3)
+        assert sim.slot == 4  # the profiled segment ran the sim
+        chrome = tmp_path / "prof" / "chrome_trace.json"
+        assert chrome.exists()
+        _valid_chrome(json.loads(chrome.read_text()))
+        assert (tmp_path / "prof" / "flame.txt").read_text().strip()
+        profile_events = tel.bus.of_type("profile")
+        assert len(profile_events) == 1
+        # second run segment is NOT re-profiled (single capture contract)
+        sim.run_until_slot(4)
+        assert len(tel.bus.of_type("profile")) == 1
