@@ -25,7 +25,20 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 from jax.experimental import mesh_utils  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
-from jax import shard_map  # noqa: E402
+try:
+    from jax import shard_map  # noqa: E402
+except ImportError:
+    # pre-0.6 jax: only the experimental spelling exists, and the
+    # replication check is still called check_rep (renamed check_vma
+    # upstream); everything else about the call sites is identical
+    from jax.experimental.shard_map import (  # noqa: E402
+        shard_map as _shard_map_experimental,
+    )
+
+    def shard_map(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_experimental(f, **kwargs)
 
 from pos_evolution_tpu.config import Config  # noqa: E402
 from pos_evolution_tpu.ops.epoch import (  # noqa: E402

@@ -1,11 +1,28 @@
 """Network & adversary simulation layer (L6)."""
 
+from pos_evolution_tpu.sim.adversary import (
+    AdversaryContext,
+    AdversaryStrategy,
+    Balancer,
+    Equivocator,
+    RandomByzantine,
+    SplitVoter,
+    Withholder,
+)
 from pos_evolution_tpu.sim.driver import Simulation, ViewGroup
 from pos_evolution_tpu.sim.faults import (
     CrashWindow,
     FaultPlan,
     chaos_plan,
     lossy_plan,
+    stateless_unit,
+)
+from pos_evolution_tpu.sim.monitors import (
+    AccountableSafetyMonitor,
+    FinalityLivenessMonitor,
+    ForkChoiceParityMonitor,
+    Monitor,
+    default_monitors,
 )
 from pos_evolution_tpu.sim.schedule import (
     Schedule,

@@ -1,19 +1,33 @@
 """Attack scenario reproductions (SURVEY.md §2.10; pos-evolution.md:1319-1527).
 
-Each scenario scripts the adversary's exact strategy from the reference
-against real fork-choice stores and returns a result dict the regression
-tests assert on:
+Two tiers:
 
-- ``run_ex_ante_reorg``: the 1-block ex-ante reorg (pos-evolution.md:
-  1516-1522). Without proposer boost the hidden block + 1 private
-  attestation beats the next honest proposal; with the mainline W/4 boost
-  the same strategy fails — matching the reference's narrative (:1350).
-- ``run_ex_ante_reorg_with_boost``: the 7%-adversary / 0.8W-boost variant
-  that defeats boost (pos-evolution.md:1525-1526), with the reference's
-  exact numbers (W=100 per slot, 7 Byzantine per slot).
-- ``run_balancing_attack``: withheld "swayer" votes keep two chains tied so
-  neither reaches 2/3 and finality halts (pos-evolution.md:1321-1348).
-  Requires the pre-boost protocol (boost 0), as in the reference.
+- **Simulation-driven** (the public entry points): ``run_ex_ante_reorg``,
+  ``run_ex_ante_reorg_with_boost`` and ``run_lmd_balancing_attack`` run
+  the adversary *inside* ``Simulation`` as ``AdversaryStrategy``
+  instances (sim/adversary.py) — honest proposers/attesters follow the
+  ordinary duty loop, the adversary acts through the per-slot hooks, and
+  monitors/telemetry/faults can be layered on top. Their asserted
+  outcomes are pinned bit-identical to the scripted originals by
+  tests/test_attacks.py.
+- **Scripted oracles** (``scripted_run_*``): the original one-shot
+  reproductions against raw fork-choice stores, with the reference's
+  exact numbers. Kept as the ground truth the sim-driven versions are
+  compared against, and for the scenarios whose store-level mechanics
+  the driver deliberately does not model (``run_bouncing_attack_step``,
+  ``run_balancing_attack``).
+
+The scenarios (pos-evolution.md):
+
+- ex-ante reorg (:1516-1522): a hidden block + 1 private attestation
+  beats the next honest proposal pre-boost; the mainline W/4 boost kills
+  it (:1350); the 7%-adversary / 0.8W-boost variant (:1525-1526) defeats
+  even the boost (W=100 per slot, 7 Byzantine per slot).
+- LMD balancing despite boost (:1379-1403): equivocating release blocks
+  credit each view's LMD table 80:0 for its own chain; honest votes
+  split forever.
+- swayer balancing (:1321-1348): withheld votes keep two chains tied so
+  neither reaches 2/3 and finality halts (pre-boost protocol).
 
 The adversary capabilities used are exactly the reference's model: knowing
 honest decision times, targeted just-in-time delivery, and inability of
@@ -27,6 +41,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from pos_evolution_tpu.config import cfg
+from pos_evolution_tpu.sim.adversary import committee_attestations
 from pos_evolution_tpu.specs import forkchoice as fc
 from pos_evolution_tpu.specs.genesis import make_genesis
 from pos_evolution_tpu.specs.helpers import (
@@ -38,7 +53,6 @@ from pos_evolution_tpu.specs.transition import state_transition
 from pos_evolution_tpu.specs.validator import (
     advance_state_to_slot,
     build_block,
-    make_committee_attestation,
 )
 from pos_evolution_tpu.ssz import hash_tree_root
 
@@ -63,24 +77,15 @@ def _chain_contains(store: fc.Store, head: bytes, root: bytes) -> bool:
         cur = parent
 
 
-def _committee_attestations(state, slot: int, head_root: bytes,
-                            participants: np.ndarray) -> list:
-    """Aggregates restricted to ``participants`` across all committees."""
-    epoch = compute_epoch_at_slot(slot)
-    count = get_committee_count_per_slot(state, epoch)
-    out = []
-    for index in range(count):
-        try:
-            out.append(make_committee_attestation(state, slot, index, head_root,
-                                                  participants=participants))
-        except ValueError:
-            continue
-    return out
+# committee-restricted aggregates now live in sim/adversary.py (the same
+# routine the in-loop strategies use); keep the historical private name
+# for the scripted oracles' call sites
+_committee_attestations = committee_attestations
 
 
 # --- ex-ante reorg (pos-evolution.md:1503-1526) -------------------------------
 
-def run_ex_ante_reorg(n_validators: int = 64) -> dict:
+def scripted_run_ex_ante_reorg(n_validators: int = 64) -> dict:
     """Simple 1-block ex-ante reorg (pos-evolution.md:1516-1522).
 
     Slot layout (all within epoch 0):
@@ -157,7 +162,7 @@ def run_ex_ante_reorg(n_validators: int = 64) -> dict:
     }
 
 
-def run_ex_ante_reorg_with_boost(n_validators: int = 800) -> dict:
+def scripted_run_ex_ante_reorg_with_boost(n_validators: int = 800) -> dict:
     """Ex-ante reorg despite boost (pos-evolution.md:1525-1526).
 
     Reference numbers: W = 100 validators per slot, boost W_p = 0.8W,
@@ -328,7 +333,7 @@ def run_bouncing_attack_step(n_validators: int = 64) -> dict:
 
 # --- LMD balancing despite proposer boost (pos-evolution.md:1379-1403) --------
 
-def run_lmd_balancing_attack(n_validators: int = 800) -> dict:
+def scripted_run_lmd_balancing_attack(n_validators: int = 800) -> dict:
     """The balancing attack that survives proposer boost, using the LMD
     first-received rule (pos-evolution.md:1383: equal-epoch votes never
     replace the table entry).
@@ -662,3 +667,311 @@ def run_balancing_attack(n_validators: int = 64, n_epochs: int = 3,
         head_R=fc.get_head(store_R),
         tie_maintained=tie_maintained,
     )
+
+
+# --- Simulation-driven scenarios (sim/adversary.py strategies) ----------------
+#
+# The entry points below run the SAME attacks inside ``Simulation``: honest
+# proposers/attesters follow the ordinary duty loop, the adversary acts
+# through AdversaryStrategy hooks, and the asserted outcome fields are
+# pinned equal to the scripted oracles above by tests/test_attacks.py.
+
+
+def balanced_split_schedule(n_validators: int, corrupted: set,
+                            isolate: bool = False) -> "Schedule":
+    """Two view groups with the HONEST set split exactly in half by rank
+    (the reference's halves, pos-evolution.md:1330: the adversary assigns
+    each honest validator a sticky side). A plain ``partition_schedule``
+    splits by index parity, which leaves the halves unequal once the
+    corrupted set is removed — and an unequal split erodes the balancing
+    margin (own-side equivocating votes minus cross-side boost) until the
+    attack collapses for the wrong reason. ``isolate=True`` additionally
+    withholds ALL cross-group delivery (blocks and attestations), the
+    split-brain network of ``sim/adversary.SplitVoter``."""
+    from pos_evolution_tpu.sim.schedule import Schedule
+    group_of = np.zeros(n_validators, dtype=np.int64)
+    honest = [v for v in range(n_validators) if v not in corrupted]
+    for k, v in enumerate(honest):
+        group_of[v] = k % 2
+    for k, v in enumerate(sorted(corrupted)):
+        group_of[v] = k % 2
+    kwargs = {}
+    if isolate:
+        kwargs["block_delay"] = (
+            lambda proposer, slot, group:
+            0.0 if int(group_of[proposer]) == group else None)
+        kwargs["attestation_delay"] = (
+            lambda src_group, slot, group:
+            0.0 if src_group == group else None)
+    return Schedule(n_validators=n_validators, group_of=group_of,
+                    corrupted=set(corrupted), **kwargs)
+
+
+def split_brain_schedule(n_validators: int, corrupted: set) -> "Schedule":
+    """Total 2-way partition: no message ever crosses groups. The network
+    ``SplitVoter`` needs to force conflicting finality."""
+    return balanced_split_schedule(n_validators, corrupted, isolate=True)
+
+
+def committee_balanced_split_schedule(n_validators: int,
+                                      corrupted: set) -> "Schedule":
+    """Two view groups whose honest members split evenly within EVERY
+    epoch-0 slot committee — the reference's idealized balancing setup
+    (pos-evolution.md:1330 assumes per-slot symmetric halves). The
+    adversary knows the epoch's committees in advance and targets
+    delivery per validator, so this assignment is within its declared
+    powers; committees reshuffle at the epoch boundary, which is exactly
+    where the swayer banks start paying for the imbalance."""
+    from pos_evolution_tpu.sim.adversary import slot_committee
+    from pos_evolution_tpu.sim.schedule import Schedule
+    from pos_evolution_tpu.specs.genesis import make_genesis
+    state, _ = make_genesis(n_validators)
+    group_of = np.zeros(n_validators, dtype=np.int64)
+    for slot in range(cfg().slots_per_epoch):
+        committee = [int(v) for v in slot_committee(
+            advance_state_to_slot(state, max(slot, 1)), slot)]
+        honest = [v for v in committee if v not in corrupted]
+        for k, v in enumerate(honest):
+            group_of[v] = k % 2
+    for k, v in enumerate(sorted(corrupted)):
+        group_of[v] = k % 2
+    return Schedule(n_validators=n_validators, group_of=group_of,
+                    corrupted=set(corrupted))
+
+
+def run_ex_ante_reorg(n_validators: int = 64) -> dict:
+    """Sim-driven 1-block ex-ante reorg: the ``Withholder`` strategy hides
+    B2 + one private vote at slot 2 and releases just before the slot-3
+    attestation deadline (see ``scripted_run_ex_ante_reorg`` for the slot
+    layout). The slot-2 proposer is corrupted (the scripted scenario has
+    no honest slot-2 block), everything else is the honest duty loop."""
+    from pos_evolution_tpu.sim.adversary import Withholder, slot_committee
+    from pos_evolution_tpu.sim.driver import Simulation
+    from pos_evolution_tpu.specs.genesis import make_genesis
+    from pos_evolution_tpu.specs.helpers import get_beacon_proposer_index
+
+    state, _ = make_genesis(n_validators)
+    s2_view = advance_state_to_slot(state, 2)
+    adversary = int(slot_committee(s2_view, 2)[0])
+    proposer2 = int(get_beacon_proposer_index(s2_view))
+    controlled = {adversary, proposer2}
+    for s in (1, 3, 4):
+        p = int(get_beacon_proposer_index(advance_state_to_slot(state, s)))
+        assert p not in controlled, \
+            f"scenario needs an honest slot-{s} proposer"
+
+    strat = Withholder(controlled=controlled, fork_slot=2, release_slot=3,
+                       release_phase="before_attest", vote_slots=(2,),
+                       private_attesters={2: [adversary]})
+    sim = Simulation(n_validators, adversaries=[strat])
+    sim.run_until_slot(4)
+
+    store = sim.store(0)
+    head = fc.get_head(store)
+    r2 = strat.chain.tip
+    (r3,) = [r for r, b in store.blocks.items() if int(b.slot) == 3]
+    return {
+        "b2_root": r2,
+        "b3_root": r3,
+        "final_head": head,
+        "b3_reorged": not _chain_contains(store, head, r3),
+        "b2_canonical": _chain_contains(store, head, r2),
+    }
+
+
+def run_ex_ante_reorg_with_boost(n_validators: int = 800) -> dict:
+    """Sim-driven 7%-adversary / 0.8W-boost ex-ante reorg: ``Withholder``
+    banks 7 private votes in each of slots 2 and 3 and releases at slot 4
+    ``before_propose`` with a timely proposal on the private tip — the
+    boost-stealing step (see ``scripted_run_ex_ante_reorg_with_boost``
+    for the arithmetic). Slot-2 and slot-4 proposers are corrupted (the
+    scripted scenario has no honest block in either slot)."""
+    from pos_evolution_tpu.sim.adversary import Withholder, slot_committee
+    from pos_evolution_tpu.sim.driver import Simulation
+    from pos_evolution_tpu.specs.genesis import make_genesis
+    from pos_evolution_tpu.specs.helpers import get_beacon_proposer_index
+
+    c = cfg()
+    assert c.proposer_score_boost_percent == 80, "scenario expects 0.8W boost"
+    state, _ = make_genesis(n_validators)
+    adv2 = [int(v) for v in
+            slot_committee(advance_state_to_slot(state, 2), 2)[:7]]
+    adv3 = [int(v) for v in
+            slot_committee(advance_state_to_slot(state, 3), 3)[:7]]
+    proposer2 = int(get_beacon_proposer_index(advance_state_to_slot(state, 2)))
+    proposer4 = int(get_beacon_proposer_index(advance_state_to_slot(state, 4)))
+    controlled = set(adv2) | set(adv3) | {proposer2, proposer4}
+    for s in (1, 3):
+        p = int(get_beacon_proposer_index(advance_state_to_slot(state, s)))
+        assert p not in controlled, \
+            f"scenario needs an honest slot-{s} proposer"
+
+    strat = Withholder(controlled=controlled, fork_slot=2, release_slot=4,
+                       release_phase="before_propose", vote_slots=(2, 3),
+                       private_attesters={2: adv2, 3: adv3},
+                       propose_on_release=True)
+    sim = Simulation(n_validators, adversaries=[strat])
+    sim.run_until_slot(4)
+
+    store = sim.store(0)
+    head = fc.get_head(store)
+    r2 = strat.chain.tip
+    (r3,) = [r for r, b in store.blocks.items() if int(b.slot) == 3]
+    (r4,) = [r for r, b in store.blocks.items() if int(b.slot) == 4]
+    return {
+        "per_slot_committee": n_validators // c.slots_per_epoch,
+        "head": head,
+        "b3_reorged": not _chain_contains(store, head, r3),
+        "b4_canonical": _chain_contains(store, head, r4),
+        "b2_canonical": _chain_contains(store, head, r2),
+    }
+
+
+class LMDBalancer:
+    """Strategy form of the LMD balancing attack (pos-evolution.md:
+    1379-1403): slots 1-4 build two private chains with 20 equivocating
+    votes per chain per slot; slot 5 releases two equivocating blocks
+    carrying each chain's 80 votes, each view receiving "its" chain
+    timely (boost) and the other past the attesting interval — the LMD
+    first-received rule then credits each view's table 80:0 for its own
+    chain, permanently. Implements the ``AdversaryStrategy`` protocol
+    structurally (duck-typed, the protocol's point) rather than by
+    inheritance."""
+
+    name = "lmd_balancer"
+
+    def __init__(self, controlled, per_slot_byz: dict[int, list[int]],
+                 build_slots=(1, 2, 3, 4), release_slot: int = 5):
+        self.controlled = tuple(sorted(int(v) for v in controlled))
+        self.per_slot_byz = {int(k): list(v) for k, v in per_slot_byz.items()}
+        self.build_slots = tuple(build_slots)
+        self.release_slot = int(release_slot)
+        self.chain_states = None
+        self.chain_blocks = {"L": [], "R": []}
+        self.chain_votes = {"L": [], "R": []}
+        self.first_roots: tuple | None = None
+        self.release_tips: dict | None = None
+        self.measured: dict | None = None
+        self.tie_log: list[tuple[int, bool]] = []
+
+    def bind(self, sim) -> None:
+        self.sim = sim
+        assert len(sim.groups) == 2, "LMDBalancer needs exactly two views"
+
+    def describe(self) -> dict:
+        return {"kind": type(self).__name__,
+                "controlled": list(self.controlled),
+                "build_slots": list(self.build_slots),
+                "release_slot": self.release_slot}
+
+    def _extend_both(self, ctx) -> None:
+        for side, graffiti in (("L", b"\x1f" * 32), ("R", b"\xf1" * 32)):
+            sb = build_block(self.chain_states[side], ctx.slot,
+                             graffiti=graffiti)
+            self.chain_blocks[side].append(sb)
+            post = self.chain_states[side].copy()
+            state_transition(post, sb, True)
+            self.chain_states[side] = post
+            head_root = hash_tree_root(sb.message)
+            head_state = advance_state_to_slot(post, ctx.slot)
+            # the slot's 20 Byzantine attesters vote this chain's head too
+            # (equivocation across chains)
+            self.chain_votes[side].extend(committee_attestations(
+                head_state, ctx.slot, head_root,
+                np.array(self.per_slot_byz[ctx.slot], dtype=np.int64)))
+        if self.first_roots is None:
+            self.first_roots = (
+                hash_tree_root(self.chain_blocks["L"][0].message),
+                hash_tree_root(self.chain_blocks["R"][0].message))
+
+    def _release(self, ctx) -> None:
+        c = cfg()
+        # own side timely (boost applies), cross side one tick past the
+        # attesting interval (no boost; equal-epoch LMD entries keep the
+        # first-received chain, pos-evolution.md:1383, :1394)
+        offset = float(_attest_interval(c) + 1)
+        tips = {}
+        for side, own in (("L", 0), ("R", 1)):
+            assert len(self.chain_votes[side]) <= c.max_attestations, \
+                "equivocating votes exceed the block's attestation capacity"
+            sb5 = build_block(self.chain_states[side], ctx.slot,
+                              attestations=self.chain_votes[side],
+                              graffiti=(b"\x55" if side == "L" else b"\xaa") * 32)
+            tips[side] = hash_tree_root(sb5.message)
+            delay = {own: 0.0, 1 - own: offset}
+            for sb in self.chain_blocks[side] + [sb5]:
+                ctx.broadcast("block", sb,
+                              src=int(sb.message.proposer_index), delay=delay)
+        self.release_tips = tips
+        ctx.deliver()
+
+    def before_propose(self, ctx) -> None:
+        if self.chain_states is None:
+            base = ctx.store(0).block_states[ctx.head(0)]
+            self.chain_states = {"L": base, "R": base}
+        if self.first_roots is not None and ctx.slot > self.release_slot + 1:
+            # head-tie audit for the PREVIOUS slot, read after the slot
+            # boundary tick cleared its proposer boost (the scripted
+            # oracle has no boost live at its per-slot head checks)
+            self.tie_log.append((ctx.slot - 1, ctx.head(0) != ctx.head(1)))
+        if ctx.slot in self.build_slots:
+            self._extend_both(ctx)
+        elif ctx.slot == self.release_slot:
+            self._release(ctx)
+
+    def before_attest(self, ctx) -> None:
+        pass
+
+    def after_attest(self, ctx) -> None:
+        if ctx.slot == self.release_slot and self.measured is None:
+            firstL, firstR = self.first_roots
+            gwei32 = 32 * 10**9
+            self.measured = {
+                "viewA_L_votes": int(fc.get_latest_attesting_balance(
+                    ctx.store(0), firstL)) // gwei32,
+                "viewA_R_votes": int(fc.get_latest_attesting_balance(
+                    ctx.store(0), firstR)) // gwei32,
+                "viewB_L_votes": int(fc.get_latest_attesting_balance(
+                    ctx.store(1), firstL)) // gwei32,
+                "viewB_R_votes": int(fc.get_latest_attesting_balance(
+                    ctx.store(1), firstR)) // gwei32,
+            }
+
+
+def run_lmd_balancing_attack(n_validators: int = 800,
+                             end_slot: int = 10) -> dict:
+    """Sim-driven LMD balancing despite boost, reference numbers (W=100
+    per slot, 20 Byzantine per slot, five corrupted proposers). The
+    adversary additionally censors the proposers of the post-release
+    window (adaptive corruption, pos-evolution.md:183-185): the scripted
+    oracle models no blocks after the release, and an honest proposal's
+    boost would otherwise perturb the vote ledger the oracle pins."""
+    from pos_evolution_tpu.sim.adversary import slot_committee
+    from pos_evolution_tpu.sim.driver import Simulation
+    from pos_evolution_tpu.specs.genesis import make_genesis
+    from pos_evolution_tpu.specs.helpers import get_beacon_proposer_index
+
+    state, _ = make_genesis(n_validators)
+    per_slot_byz: dict[int, list[int]] = {}
+    corrupted: set[int] = set()
+    for slot in range(1, 6):
+        flat = [int(v) for v in
+                slot_committee(advance_state_to_slot(state, slot), slot)]
+        per_slot_byz[slot] = flat[:20]
+        corrupted.update(per_slot_byz[slot])
+    for slot in range(1, end_slot + 1):
+        corrupted.add(int(get_beacon_proposer_index(
+            advance_state_to_slot(state, slot))))
+
+    sched = balanced_split_schedule(n_validators, corrupted)
+    strat = LMDBalancer(corrupted, per_slot_byz)
+    sim = Simulation(n_validators, schedule=sched, adversaries=[strat])
+    sim.run_until_slot(end_slot + 1)
+
+    ties = dict(strat.tie_log)
+    return {
+        **strat.measured,
+        "heads_disagree": [ties[s] for s in range(6, end_slot + 1)],
+        "justified_A": sim.justified_epoch(0),
+        "justified_B": sim.justified_epoch(1),
+    }

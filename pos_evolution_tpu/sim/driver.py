@@ -53,9 +53,13 @@ class _QueuedMessage:
 
 def _span_id(kind: str, slot: int, src: int, msg_id: int) -> str:
     """Deterministic message-span identity: the same run always names the
-    same spans (no uuids), so lineage is replayable and test-pinnable."""
+    same spans (no uuids), so lineage is replayable and test-pinnable.
+    Honest proposals use msg_id 0 and keep their historical span names;
+    an adversarial double proposal (sim/adversary.Equivocator) needs the
+    msg_id suffix to keep the two conflicting blocks' spans distinct."""
     if kind == "block":
-        return f"blk-{slot}-{src}"
+        return f"blk-{slot}-{src}" if msg_id == 0 \
+            else f"blk-{slot}-{src}-{msg_id}"
     if kind == "attestation":
         return f"att-{slot}-g{src}-c{msg_id}"
     return f"{kind}-{slot}-{src}-{msg_id}"
@@ -224,7 +228,7 @@ class Simulation:
 
     def __init__(self, n_validators: int, schedule: Schedule | None = None,
                  genesis_time: int = 0, accelerated_forkchoice: bool = False,
-                 telemetry=None, profile=None):
+                 telemetry=None, profile=None, adversaries=(), monitors=()):
         self.cfg = cfg()
         self.schedule = schedule or honest_schedule(n_validators)
         self.n_validators = n_validators
@@ -300,12 +304,22 @@ class Simulation:
         # run's FaultPlan. Not simulation state: a resumed run re-attaches.
         self.light_clients: list = []
         self._lc_group = 0
+        # In-loop adversary engine + online property monitors
+        # (sim/adversary.py, sim/monitors.py). Neither is simulation
+        # state: like the schedule and telemetry, pass them again to
+        # ``resume`` (RandomByzantine's stateless seeded decisions replay
+        # identically; stateful strategies replay from an episode-start
+        # checkpoint — the chaos-fuzz repro-bundle contract).
+        self.adversaries = list(adversaries)
+        self.monitors = list(monitors)
+        self.monitor_violations: list[dict] = []
         if telemetry is not None:
             telemetry.bus.emit(
                 "run_start", n_validators=n_validators,
                 n_groups=self.schedule.n_groups, genesis_time=genesis_time,
                 accelerated_forkchoice=accelerated_forkchoice,
                 debug=telemetry.debug)
+        self._bind_adversaries_and_monitors()
 
     def _get_head(self, group: ViewGroup) -> bytes:
         t0 = _time.perf_counter()
@@ -327,6 +341,50 @@ class Simulation:
     def trace_summary(self) -> dict:
         """Per-handler timing percentiles for this run."""
         return self.timer.summary()
+
+    # -- adversary engine + monitors (sim/adversary.py, sim/monitors.py) -------
+
+    def _bind_adversaries_and_monitors(self) -> None:
+        """Fold controlled validators into the schedule's corrupted set
+        (the honest duty loop must never act for them) and hand each
+        strategy/monitor its simulation handle."""
+        for strat in self.adversaries:
+            self.schedule.corrupted.update(strat.controlled)
+            strat.bind(self)
+        for mon in self.monitors:
+            mon.bind(self)
+        if self.monitors and self.telemetry is not None:
+            self.telemetry.bus.emit(
+                "monitor_attach",
+                monitors=[m.describe() for m in self.monitors],
+                adversaries=[s.describe() for s in self.adversaries])
+
+    def _adversary_phase(self, phase: str, slot: int, now: float) -> None:
+        """Run one hook round: every strategy acts, then anything it
+        injected for immediate delivery is flushed — so honest duties
+        that follow see the adversarial messages, which is the whole
+        point of in-loop attacks."""
+        if not self.adversaries:
+            return
+        from pos_evolution_tpu.sim.adversary import AdversaryContext
+        ctx = AdversaryContext(self, slot, phase, now)
+        for strat in self.adversaries:
+            getattr(strat, phase)(ctx)
+        self._tick_all(now)
+
+    def _observe(self, kind: str, payload) -> None:
+        """Show one ORIGINATED message (honest or adversarial, before any
+        fault decision) to every monitor — the watchtower's wire tap."""
+        for mon in self.monitors:
+            mon.observe(kind, payload)
+
+    def _run_monitors(self, slot: int) -> None:
+        for mon in self.monitors:
+            for violation in mon.on_slot_end(self, slot):
+                record = {"slot": slot, **violation}
+                self.monitor_violations.append(record)
+                if self.telemetry is not None:
+                    self.telemetry.bus.emit("monitor", **record)
 
     # -- time helpers --
     def slot_start(self, slot: int) -> int:
@@ -517,6 +575,7 @@ class Simulation:
                                  attestations=[], sync_aggregate=sync_agg)
             block_root = hash_tree_root(sb.message)
             self.block_archive[block_root] = sb
+            self._observe("block", sb)
             if self.telemetry is not None:
                 # lifecycle root span: propose -> per-group gossip edges
                 # -> per-group deliveries hang off this id
@@ -633,6 +692,7 @@ class Simulation:
                         participants=np.array(sorted(awake), dtype=np.int64))
                 except ValueError:
                     continue  # no awake member in this committee
+                self._observe("attestation", att)
                 if self.telemetry is not None:
                     self.telemetry.bus.emit(
                         "attest",
@@ -651,12 +711,16 @@ class Simulation:
         self._apply_fault_transitions(slot)
         self._tick_all(t0)
         if slot > 0:
+            self._adversary_phase("before_propose", slot, t0)
             self._propose(slot)
             self._tick_all(t0 + 1)  # timely blocks land within the boost window
             self._tick_all(t0 + self.delta)
+            self._adversary_phase("before_attest", slot, t0 + self.delta)
             self._attest(slot)
             self._tick_all(t0 + 2 * self.delta)
+            self._adversary_phase("after_attest", slot, t0 + 2 * self.delta)
         self._record_metrics(slot)
+        self._run_monitors(slot)
         self._serve_light_clients(slot)
         self.slot += 1
 
@@ -850,16 +914,23 @@ class Simulation:
 
     @classmethod
     def resume(cls, data: bytes, schedule: Schedule | None = None,
-               telemetry=None) -> "Simulation":
+               telemetry=None, adversaries=(), monitors=()) -> "Simulation":
         """Rebuild a checkpointed simulation mid-run. ``schedule`` must be
         the same delivery/fault policy the original run used (schedules
         hold callables, which do not serialize); None resumes an honest
         synchronous run. Crash state re-derives from the FaultPlan, so a
         checkpoint taken during an outage resumes into the outage.
         ``telemetry`` re-attaches an event bus/registry (telemetry is not
-        sim state; the resumed run records only post-resume events)."""
+        sim state; the resumed run records only post-resume events).
+        ``adversaries``/``monitors`` re-attach strategy and monitor
+        instances (also not sim state): a stateless strategy
+        (``RandomByzantine``) replays exactly from any checkpoint slot;
+        stateful strategies and monitors replay exactly from an
+        episode-START checkpoint — the repro-bundle contract of
+        ``scripts/chaos_fuzz.py``."""
         from pos_evolution_tpu.utils.snapshot import load_simulation
-        return load_simulation(data, schedule=schedule, telemetry=telemetry)
+        return load_simulation(data, schedule=schedule, telemetry=telemetry,
+                               adversaries=adversaries, monitors=monitors)
 
     # -- accessors --
     def store(self, group: int = 0) -> fc.Store:

@@ -35,6 +35,20 @@ from dataclasses import dataclass, field
 _KIND_TAG = {"block": 0, "attestation": 1, "slashing": 2}
 
 
+def stateless_unit(seed: int, *key: int) -> float:
+    """Uniform [0, 1) from a hash of (seed, key): no RNG stream, no
+    call-order dependence — the same identity always draws the same
+    number, before or after a checkpoint/resume, and independent of any
+    array backend (pure ``hashlib``, never NumPy/JAX). Shared by
+    ``FaultPlan`` and ``sim/adversary.RandomByzantine`` so the two
+    adversaries cannot drift apart in determinism discipline
+    (byte-stability is pinned by tests/test_adversary.py)."""
+    h = hashlib.blake2b(
+        struct.pack(f"<{len(key) + 1}q", seed, *key),
+        digest_size=8).digest()
+    return int.from_bytes(h, "little") / 2.0**64
+
+
 @dataclass(frozen=True)
 class CrashWindow:
     """View group ``group`` is down for slots [crash_slot, rejoin_slot):
@@ -88,10 +102,7 @@ class FaultPlan:
         """Uniform [0, 1) from a hash of (seed, key): no RNG stream, no
         call-order dependence — the same message identity always draws the
         same number, before or after a checkpoint/resume."""
-        h = hashlib.blake2b(
-            struct.pack(f"<{len(key) + 1}q", self.seed, *key),
-            digest_size=8).digest()
-        return int.from_bytes(h, "little") / 2.0**64
+        return stateless_unit(self.seed, *key)
 
     # -- message faults --------------------------------------------------------
 

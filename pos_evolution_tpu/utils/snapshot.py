@@ -212,13 +212,17 @@ def save_simulation(sim) -> bytes:
     return out.getvalue()
 
 
-def load_simulation(data: bytes, schedule=None, telemetry=None):
+def load_simulation(data: bytes, schedule=None, telemetry=None,
+                    adversaries=(), monitors=()):
     """Rebuild a ``save_simulation`` checkpoint into a live Simulation.
     ``schedule`` must be the run's original Schedule (with its FaultPlan)
     for faithful replay; crash flags re-derive from the plan + slot.
     ``telemetry`` re-attaches an event bus (not sim state; queue span ids
     are not serialized, so pre-checkpoint deliveries re-emitted after a
-    resume carry no parent lineage)."""
+    resume carry no parent lineage). ``adversaries``/``monitors``
+    re-attach in-loop strategies and property monitors; they bind AFTER
+    the restore so their handles see the checkpointed stores, not the
+    skeleton's."""
     from pos_evolution_tpu.sim.driver import Simulation, _QueuedMessage
     buf = io.BytesIO(data)
     meta = json.loads(_unframe(buf).decode())
@@ -291,6 +295,10 @@ def load_simulation(data: bytes, schedule=None, telemetry=None):
             n_groups=sim.schedule.n_groups, genesis_time=sim.genesis_time,
             accelerated_forkchoice=sim.accelerated_forkchoice,
             debug=telemetry.debug, resumed_at_slot=sim.slot)
+    if adversaries or monitors:
+        sim.adversaries = list(adversaries)
+        sim.monitors = list(monitors)
+        sim._bind_adversaries_and_monitors()
     return sim
 
 

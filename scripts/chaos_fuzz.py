@@ -1,0 +1,450 @@
+"""Chaos fuzzing: random adversary x fault compositions under monitors.
+
+Jepsen for the consensus sim: every episode composes a seeded random
+subset of in-loop Byzantine strategies (``sim/adversary.py``) with a
+seeded random ``FaultPlan`` (drops / duplicates / reorders / crash
+windows), runs it through ``Simulation`` with the full monitor stack
+(``sim/monitors.py``) attached, and demands ZERO violations. Every
+decision — which strategies, which probabilities, which windows — is a
+``stateless_unit`` hash of (seed, episode), so any episode reproduces
+in isolation, in any order, on any backend.
+
+A violating episode writes a **repro bundle**:
+
+    <out>/bundle_ep<N>/
+        config.json      episode composition (seeds, strategies, faults)
+        checkpoint.bin   Simulation.checkpoint() at the episode START
+        events.jsonl     telemetry event log of the violating run
+        violations.json  the monitor verdicts
+        shrink.json      greedy shrink log (when shrinking ran)
+        config.min.json  minimized composition that still violates
+
+Replay contract: ``--replay <bundle>`` rebuilds the run from
+``Simulation.resume(checkpoint.bin)`` + the config's seeds and must
+reproduce the same violations (monitor, kind, slot). The shrink pass
+greedily drops strategies / fault kinds / crash windows while the
+violation persists — the minimized config is strictly smaller.
+
+``--doctor`` forces conflicting finalized checkpoints into two views at
+a chosen slot (no real equivocation behind them): the
+``AccountableSafetyMonitor`` must flag a ``protocol_violation`` (its
+evidence set cannot reach 1/3) and a bundle must appear — the CI
+negative proving the pipeline fails loudly.
+
+Usage:
+    python scripts/chaos_fuzz.py --episodes 20 --seed 7 --out chaos_out/
+    python scripts/chaos_fuzz.py --doctor --out chaos_out/
+    python scripts/chaos_fuzz.py --replay chaos_out/bundle_ep0/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pos_evolution_tpu.config import minimal_config, use_config  # noqa: E402
+from pos_evolution_tpu.sim.faults import stateless_unit  # noqa: E402
+
+SCHEMA = 1
+
+# stateless_unit decision domains for episode composition
+_D_FAULTS, _D_CRASH, _D_STRAT, _D_PARAM = 10, 11, 12, 13
+
+
+# -- episode composition (pure function of seed + episode index) ---------------
+
+def episode_config(seed: int, episode: int, n_validators: int = 64,
+                   n_slots: int = 24, doctor: bool = False) -> dict:
+    """Derive one episode's full composition from (seed, episode) alone."""
+    u = lambda dom, k: stateless_unit(seed, dom, episode, k)  # noqa: E731
+    cfg = {
+        "schema": SCHEMA,
+        "seed": int(seed),
+        "episode": int(episode),
+        "n_validators": int(n_validators),
+        "n_slots": int(n_slots),
+        "n_groups": 2,
+        "monitors": {"accountable_broadcast": True,
+                     # a <1/3-Byzantine faulted run legitimately trails
+                     # 2-3 epochs post-GST (see DESIGN.md §13); the bound
+                     # flags a STALL, not slowness. The monitor arms at
+                     # ceil(gst/epoch)+bound — with GST at n_slots/3 it is
+                     # live inside episodes of >= ~7 epochs (the slow
+                     # sweep); short smoke episodes end before it arms,
+                     # and audit only safety/parity.
+                     "liveness_bound_epochs": 4},
+        "doctor": None,
+    }
+    from pos_evolution_tpu.config import cfg as active_cfg
+    c = active_cfg()
+    gst_slot = max(2, n_slots // 3)
+    faults = {
+        "seed": int(seed) * 1_000_003 + episode,
+        "drop_p": round(u(_D_FAULTS, 0) * 0.15, 4),
+        "duplicate_p": round(u(_D_FAULTS, 1) * 0.10, 4),
+        "reorder_p": round(u(_D_FAULTS, 2) * 0.20, 4),
+        "reorder_max_delay": 4.0,
+        "gst": gst_slot * c.seconds_per_slot,
+        "crashes": [],
+    }
+    if u(_D_CRASH, 0) < 0.5:
+        crash = 2 + int(u(_D_CRASH, 1) * 4)
+        rejoin = crash + 2 + int(u(_D_CRASH, 2) * 4)
+        if rejoin < n_slots - 2:
+            # only group 1 ever crashes: group 0 must stay alive as the
+            # checkpoint-sync donor
+            faults["crashes"].append(
+                {"group": 1, "crash_slot": crash, "rejoin_slot": rejoin})
+    cfg["faults"] = faults
+
+    # controlled sets: disjoint, total < 1/3 of the validator set
+    budget = n_validators // 3 - 1
+    cursor = 0
+    adversaries = []
+    if u(_D_STRAT, 0) < 0.8:
+        k = min(budget - cursor, 4 + int(u(_D_PARAM, 0) * 8))
+        adversaries.append({
+            "kind": "RandomByzantine",
+            "controlled": list(range(cursor, cursor + k)),
+            "seed": int(seed) * 7_919 + episode,
+            "p_equivocate": round(0.15 + u(_D_PARAM, 1) * 0.3, 4),
+            "p_stale_vote": round(u(_D_PARAM, 2) * 0.3, 4),
+            "p_abstain": round(u(_D_PARAM, 3) * 0.3, 4),
+            "p_double_propose": round(u(_D_PARAM, 4), 4),
+        })
+        cursor += k
+    if u(_D_STRAT, 1) < 0.5:
+        k = min(budget - cursor, 2 + int(u(_D_PARAM, 5) * 4))
+        if k > 0:
+            adversaries.append({
+                "kind": "Equivocator",
+                "controlled": list(range(cursor, cursor + k)),
+                "slots": None,
+            })
+            cursor += k
+    if u(_D_STRAT, 2) < 0.5:
+        k = min(budget - cursor, 2 + int(u(_D_PARAM, 6) * 4))
+        release = 3 + int(u(_D_PARAM, 7) * (n_slots - 6))
+        if k > 0:
+            adversaries.append({
+                "kind": "Withholder",
+                "controlled": list(range(cursor, cursor + k)),
+                "fork_slot": max(2, release - 2),
+                "release_slot": release,
+                "release_phase": "before_attest",
+                "vote_slots": [max(2, release - 2), max(2, release - 1)],
+                "propose_on_release": False,
+            })
+            cursor += k
+    cfg["adversaries"] = adversaries
+    if doctor:
+        cfg["doctor"] = {"slot": min(n_slots - 2, max(4, n_slots // 2)),
+                         "epoch": 1}
+    return cfg
+
+
+# -- config -> live objects ----------------------------------------------------
+
+def build_adversaries(cfg: dict) -> list:
+    from pos_evolution_tpu.sim.adversary import (
+        Equivocator,
+        RandomByzantine,
+        Withholder,
+    )
+    out = []
+    for a in cfg.get("adversaries", ()):
+        kind = a["kind"]
+        if kind == "RandomByzantine":
+            out.append(RandomByzantine(
+                controlled=a["controlled"], seed=a["seed"],
+                p_equivocate=a["p_equivocate"],
+                p_stale_vote=a["p_stale_vote"], p_abstain=a["p_abstain"],
+                p_double_propose=a["p_double_propose"]))
+        elif kind == "Equivocator":
+            out.append(Equivocator(controlled=a["controlled"],
+                                   slots=a.get("slots")))
+        elif kind == "Withholder":
+            out.append(Withholder(
+                controlled=a["controlled"], fork_slot=a["fork_slot"],
+                release_slot=a["release_slot"],
+                release_phase=a["release_phase"],
+                vote_slots=a["vote_slots"],
+                propose_on_release=a["propose_on_release"]))
+        else:
+            raise ValueError(f"unknown strategy kind {kind!r}")
+    return out
+
+
+def build_schedule(cfg: dict):
+    from pos_evolution_tpu.sim.faults import CrashWindow, FaultPlan
+    from pos_evolution_tpu.sim.schedule import (
+        honest_schedule,
+        partition_schedule,
+    )
+    f = cfg["faults"]
+    plan = FaultPlan(
+        seed=f["seed"], drop_p=f["drop_p"], duplicate_p=f["duplicate_p"],
+        reorder_p=f["reorder_p"], reorder_max_delay=f["reorder_max_delay"],
+        gst=f["gst"],
+        crashes=tuple(CrashWindow(w["group"], w["crash_slot"],
+                                  w["rejoin_slot"])
+                      for w in f["crashes"]))
+    n = cfg["n_validators"]
+    sched = (honest_schedule(n) if cfg["n_groups"] == 1
+             else partition_schedule(n, cfg["n_groups"]))
+    sched.faults = plan
+    return sched
+
+
+def build_monitors(cfg: dict) -> list:
+    from pos_evolution_tpu.sim.monitors import (
+        AccountableSafetyMonitor,
+        FinalityLivenessMonitor,
+        ForkChoiceParityMonitor,
+    )
+    m = cfg.get("monitors", {})
+    return [AccountableSafetyMonitor(
+                broadcast_evidence=m.get("accountable_broadcast", True)),
+            FinalityLivenessMonitor(
+                bound_epochs=m.get("liveness_bound_epochs", 6)),
+            ForkChoiceParityMonitor()]
+
+
+def _doctor_stores(sim, epoch: int) -> None:
+    """Force CONFLICTING finalized checkpoints into the first two views —
+    no equivocation behind them, so the monitor's evidence set cannot
+    reach 1/3 and it must report a protocol_violation (the CI negative:
+    a safety break the slasher cannot account for fails loudly)."""
+    from pos_evolution_tpu.specs.containers import Checkpoint
+    sim.groups[0].store.finalized_checkpoint = Checkpoint(
+        epoch=epoch, root=b"\x0d" * 32)
+    sim.groups[1].store.finalized_checkpoint = Checkpoint(
+        epoch=epoch, root=b"\x0e" * 32)
+
+
+def run_episode(cfg: dict, events_path: str | None = None,
+                resume_from: bytes | None = None) -> dict:
+    """Run one composed episode; returns violations + the episode-start
+    checkpoint (the repro-bundle payload). ``resume_from`` replays from a
+    bundle's checkpoint through ``Simulation.resume`` instead of
+    constructing fresh — the replay contract."""
+    from pos_evolution_tpu.sim.driver import Simulation
+    from pos_evolution_tpu.telemetry import Telemetry
+
+    telemetry = (Telemetry.to_file(events_path)
+                 if events_path is not None else None)
+    adversaries = build_adversaries(cfg)
+    monitors = build_monitors(cfg)
+    schedule = build_schedule(cfg)
+    try:
+        if resume_from is not None:
+            sim = Simulation.resume(resume_from, schedule=schedule,
+                                    telemetry=telemetry,
+                                    adversaries=adversaries,
+                                    monitors=monitors)
+            checkpoint = resume_from
+        else:
+            sim = Simulation(cfg["n_validators"], schedule=schedule,
+                             telemetry=telemetry, adversaries=adversaries,
+                             monitors=monitors)
+            checkpoint = sim.checkpoint()
+        doctor = cfg.get("doctor")
+        while sim.slot <= cfg["n_slots"]:
+            sim.run_slot()
+            if doctor is not None and sim.slot - 1 == doctor["slot"]:
+                _doctor_stores(sim, doctor["epoch"])
+    finally:
+        # a crashed episode must not leak the JSONL handle (the partial
+        # log itself is the caller's to keep or remove)
+        if telemetry is not None:
+            telemetry.close()
+    return {
+        "violations": sim.monitor_violations,
+        "finalized": [sim.finalized_epoch(g)
+                      for g in range(len(sim.groups))],
+        "checkpoint": checkpoint,
+    }
+
+
+# -- shrink --------------------------------------------------------------------
+
+def _components(cfg: dict) -> list[tuple[str, object]]:
+    """Every independently removable piece of a composition."""
+    out = [("adversary", i) for i in range(len(cfg["adversaries"]))]
+    out += [("fault", k) for k in ("drop_p", "duplicate_p", "reorder_p")
+            if cfg["faults"][k] > 0]
+    out += [("crash", i) for i in range(len(cfg["faults"]["crashes"]))]
+    return out
+
+
+def _without(cfg: dict, component: tuple[str, object]) -> dict:
+    import copy
+    out = copy.deepcopy(cfg)
+    kind, key = component
+    if kind == "adversary":
+        del out["adversaries"][key]
+    elif kind == "fault":
+        out["faults"][key] = 0.0
+    elif kind == "crash":
+        del out["faults"]["crashes"][key]
+    return out
+
+
+def _same_violation(violations: list[dict], reference: dict) -> bool:
+    return any(v["monitor"] == reference["monitor"]
+               and v["kind"] == reference["kind"] for v in violations)
+
+
+def shrink(cfg: dict, reference_violation: dict) -> tuple[dict, list[dict]]:
+    """Greedy delta-debugging: drop one component at a time, keep the
+    removal whenever the reference violation still reproduces. Each
+    accepted step strictly reduces the composition; the loop restarts
+    after every acceptance so index-shifting removals stay sound."""
+    log = []
+    current = cfg
+    progress = True
+    while progress:
+        progress = False
+        for comp in _components(current):
+            candidate = _without(current, comp)
+            result = run_episode(candidate)
+            ok = _same_violation(result["violations"], reference_violation)
+            log.append({"removed": list(comp), "still_violates": ok,
+                        "n_components": len(_components(candidate))})
+            if ok:
+                current = candidate
+                progress = True
+                break
+    return current, log
+
+
+# -- bundles -------------------------------------------------------------------
+
+def write_bundle(out_dir: str, cfg: dict, result: dict,
+                 events_src: str | None, do_shrink: bool = True) -> str:
+    bundle = os.path.join(out_dir, f"bundle_ep{cfg['episode']}")
+    os.makedirs(bundle, exist_ok=True)
+    with open(os.path.join(bundle, "config.json"), "w") as fh:
+        json.dump(cfg, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    with open(os.path.join(bundle, "checkpoint.bin"), "wb") as fh:
+        fh.write(result["checkpoint"])
+    with open(os.path.join(bundle, "violations.json"), "w") as fh:
+        json.dump(result["violations"], fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    if events_src and os.path.exists(events_src):
+        shutil.move(events_src, os.path.join(bundle, "events.jsonl"))
+    if do_shrink and result["violations"]:
+        minimized, log = shrink(cfg, result["violations"][0])
+        with open(os.path.join(bundle, "shrink.json"), "w") as fh:
+            json.dump({"steps": log,
+                       "before": len(_components(cfg)),
+                       "after": len(_components(minimized))}, fh, indent=1)
+            fh.write("\n")
+        with open(os.path.join(bundle, "config.min.json"), "w") as fh:
+            json.dump(minimized, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    return bundle
+
+
+def replay_bundle(bundle: str) -> dict:
+    """Re-run a bundle from its checkpoint via ``Simulation.resume`` and
+    compare the violations against the recorded ones."""
+    with open(os.path.join(bundle, "config.json")) as fh:
+        cfg = json.load(fh)
+    with open(os.path.join(bundle, "checkpoint.bin"), "rb") as fh:
+        checkpoint = fh.read()
+    with open(os.path.join(bundle, "violations.json")) as fh:
+        recorded = json.load(fh)
+    result = run_episode(cfg, resume_from=checkpoint)
+    key = lambda v: (v["slot"], v["monitor"], v["kind"])  # noqa: E731
+    match = sorted(map(key, result["violations"])) == sorted(map(key, recorded))
+    return {"match": match, "replayed": result["violations"],
+            "recorded": recorded}
+
+
+# -- CLI -----------------------------------------------------------------------
+
+def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
+         out_dir: str, doctor: bool = False, do_shrink: bool = True,
+         step_timeout: float | None = None, episode_indices=None) -> dict:
+    from pos_evolution_tpu.utils.watchdog import Watchdog
+    os.makedirs(out_dir, exist_ok=True)
+    wd = Watchdog(path=os.path.join(out_dir, "chaos_partial.json"),
+                  tag="chaos_fuzz", timeout_s=step_timeout)
+    summary = {"episodes": 0, "violating": 0, "bundles": [],
+               "incidents": 0}
+    indices = (range(episodes) if episode_indices is None
+               else episode_indices)
+    for ep in indices:
+        cfg = episode_config(seed, ep, n_validators, n_slots, doctor=doctor)
+        events_path = os.path.join(out_dir, f"ep{ep}.events.jsonl")
+        result = wd.step(f"episode_{ep}", run_episode, cfg,
+                         events_path=events_path)
+        summary["episodes"] += 1
+        if result is None:         # watchdog incident (timeout / crash)
+            summary["incidents"] += 1
+            if os.path.exists(events_path):
+                os.remove(events_path)  # partial log of a dead episode
+            continue
+        if result["violations"]:
+            summary["violating"] += 1
+            bundle = write_bundle(out_dir, cfg, result, events_path,
+                                  do_shrink=do_shrink)
+            summary["bundles"].append(bundle)
+            print(f"episode {ep}: {len(result['violations'])} violation(s) "
+                  f"-> {bundle}")
+        else:
+            if os.path.exists(events_path):
+                os.remove(events_path)
+            print(f"episode {ep}: clean "
+                  f"(finalized={result['finalized']})")
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="chaos fuzz: adversary x fault compositions under "
+                    "safety/liveness monitors")
+    ap.add_argument("--episodes", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--validators", type=int, default=64)
+    ap.add_argument("--slots", type=int, default=24)
+    ap.add_argument("--out", default="chaos_out")
+    ap.add_argument("--doctor", action="store_true",
+                    help="force conflicting finalized checkpoints (the "
+                         "monitor must trip; CI negative)")
+    ap.add_argument("--no-shrink", action="store_true")
+    ap.add_argument("--step-timeout", type=float, default=None,
+                    help="watchdog per-episode timeout (seconds)")
+    ap.add_argument("--replay", metavar="BUNDLE",
+                    help="replay a repro bundle and verify the violation")
+    args = ap.parse_args(argv)
+
+    with use_config(minimal_config()):
+        if args.replay:
+            out = replay_bundle(args.replay)
+            print(json.dumps({"match": out["match"],
+                              "replayed": out["replayed"]}, indent=1))
+            return 0 if out["match"] else 1
+        summary = fuzz(args.episodes, args.seed, args.validators, args.slots,
+                       args.out, doctor=args.doctor,
+                       do_shrink=not args.no_shrink,
+                       step_timeout=args.step_timeout)
+        print(json.dumps({k: summary[k] for k in
+                          ("episodes", "violating", "incidents")}, indent=1))
+        if args.doctor:
+            # the doctored run MUST trip the safety monitor
+            return 0 if summary["violating"] > 0 else 1
+        # an episode that hung or crashed verified nothing — a clean
+        # verdict requires every episode to have actually run
+        return 1 if (summary["violating"] or summary["incidents"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

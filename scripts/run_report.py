@@ -12,6 +12,14 @@ Reconstructs, from the event log alone (no live ``Simulation``):
 - **handler percentiles** — p50/p95/count over every event carrying
   ``handler`` + ``duration_ms`` (deliveries and ``get_head`` queries);
 - **light-client lag** — worst/final head- and finality-lag per node;
+- the **property audit** — the online monitor verdicts
+  (``sim/monitors.py`` ``monitor`` events: accountable-safety /
+  liveness / fork-choice-parity violations with slot, evidence size and
+  slashable stake) next to the debug-gated ``invariant_violation``
+  events, the attached monitor/adversary roster from ``monitor_attach``,
+  and the repro-bundle path when the log lives inside a
+  ``scripts/chaos_fuzz.py`` bundle (auto-discovered via a sibling
+  ``violations.json``, or passed with ``--bundle``);
 - **top device ops** — folded in from a ``top_ops.json`` (the xplane
   summary of ``pos_evolution_tpu/profiling/xplane.py``). When
   ``--top-ops`` is not given, the report auto-discovers
@@ -77,8 +85,19 @@ def discover_top_ops(events_path: str, events=()) -> str | None:
     return None
 
 
+def discover_bundle(events_path: str) -> str | None:
+    """The chaos-fuzz repro bundle the event log belongs to, if any:
+    ``write_bundle`` moves the violating run's ``events.jsonl`` next to
+    its ``violations.json``, so a sibling marks the directory."""
+    here = os.path.dirname(os.path.abspath(events_path))
+    if os.path.exists(os.path.join(here, "violations.json")):
+        return here
+    return None
+
+
 def build_report(events: list[dict], top_ops: dict | None = None,
-                 cost: dict | None = None) -> dict:
+                 cost: dict | None = None,
+                 bundle: str | None = None) -> dict:
     """Pure JSONL -> report-dict transform (the testable core)."""
     by_type: dict[str, list[dict]] = {}
     for ev in events:
@@ -161,6 +180,37 @@ def build_report(events: list[dict], top_ops: dict | None = None,
         row["final_head_lag"] = e.get("head_lag")
         row["final_finality_lag"] = e.get("finality_lag")
 
+    # -- property audit (sim/monitors.py verdicts + invariant checker) --------
+    attach = (by_type.get("monitor_attach") or [{}])[0]
+    violations = [
+        {k: e.get(k) for k in ("slot", "monitor", "kind", "checkpoint",
+                               "groups", "epochs", "roots",
+                               "evidence_size", "slashable_stake",
+                               "total_stake", "epoch",
+                               "best_finalized_epoch", "lag_epochs",
+                               "bound_epochs", "group", "detail")
+         if e.get(k) is not None}
+        for e in by_type.get("monitor", [])]
+    slashing = by_type.get("slashing_detected", [])
+    audit = {
+        "monitors": attach.get("monitors") or [],
+        "adversaries": attach.get("adversaries") or [],
+        "violations": violations,
+        "invariant_violations": [
+            {k: e.get(k) for k in ("slot", "group", "check", "detail")
+             if e.get(k) is not None}
+            for e in by_type.get("invariant_violation", [])],
+        "slashing_evidence": {
+            "detections": sum(e.get("n_new", 0) for e in slashing),
+            "implicated_total":
+                slashing[-1].get("implicated_total") if slashing else 0,
+        },
+        "clean": (not violations
+                  and not by_type.get("invariant_violation")),
+    }
+    if bundle:
+        audit["repro_bundle"] = bundle
+
     report = {
         "schema_version": events[0]["v"] if events else None,
         "n_events": len(events),
@@ -176,6 +226,7 @@ def build_report(events: list[dict], top_ops: dict | None = None,
                 timeline[-1]["finalized_epoch"] if timeline else None,
         },
         "faults": {"counts": fault_counts, "effects": effects},
+        "property_audit": audit,
         "handlers": handlers,
         "light_clients": {str(k): v for k, v in sorted(lc.items())},
     }
@@ -248,6 +299,39 @@ def to_markdown(report: dict) -> str:
     if eff["watchdog_incidents"]:
         md.append(f"- watchdog incidents: {eff['watchdog_incidents']}")
 
+    audit = report.get("property_audit") or {}
+    md += ["", "## Property audit", ""]
+    roster = ", ".join(m.get("kind", "?") for m in audit.get("monitors", []))
+    adv = ", ".join(a.get("kind", "?") for a in audit.get("adversaries", []))
+    md.append(f"- monitors: {roster or 'none attached'}")
+    if adv:
+        md.append(f"- adversaries: {adv}")
+    se = audit.get("slashing_evidence") or {}
+    if se.get("detections"):
+        md.append(f"- slashing evidence: {se['detections']} detection(s), "
+                  f"{se['implicated_total']} validator(s) implicated")
+    if audit.get("clean", True):
+        if audit.get("monitors"):
+            md.append("- **all properties held** (no monitor or invariant "
+                      "violations)")
+        else:
+            md.append("- no monitors were attached — nothing was audited")
+    if audit.get("violations"):
+        md += ["", *_md_table(
+            ["slot", "monitor", "kind", "evidence", "slashable/total stake"],
+            [[v.get("slot"), v.get("monitor"), v.get("kind"),
+              v.get("evidence_size", ""),
+              (f"{v['slashable_stake']}/{v['total_stake']}"
+               if "slashable_stake" in v else "")]
+             for v in audit["violations"]])]
+    if audit.get("invariant_violations"):
+        md += ["", f"- invariant violations: "
+               f"{len(audit['invariant_violations'])}"]
+        for iv in audit["invariant_violations"][:10]:
+            md.append(f"  - {iv}")
+    if audit.get("repro_bundle"):
+        md.append(f"- repro bundle: `{audit['repro_bundle']}`")
+
     md += ["", "## Handler percentiles", ""]
     if report["handlers"]:
         md += _md_table(
@@ -318,6 +402,10 @@ def main(argv=None) -> int:
                          "auto-discovered next to the event log)")
     ap.add_argument("--cost",
                     help="profiling/cost.py JSON emission to fold in")
+    ap.add_argument("--bundle",
+                    help="chaos-fuzz repro bundle the log belongs to "
+                         "(default: auto-discovered when the log sits "
+                         "next to a violations.json)")
     args = ap.parse_args(argv)
 
     events = read_jsonl(args.events)
@@ -334,7 +422,8 @@ def main(argv=None) -> int:
     if args.cost and os.path.exists(args.cost):
         with open(args.cost) as fh:
             cost = json.load(fh)
-    report = build_report(events, top_ops=top_ops, cost=cost)
+    bundle = args.bundle or discover_bundle(args.events)
+    report = build_report(events, top_ops=top_ops, cost=cost, bundle=bundle)
     if args.json:
         with open(args.json, "w") as fh:
             json.dump(report, fh, indent=1, sort_keys=True)

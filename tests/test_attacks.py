@@ -1,11 +1,13 @@
 """Attack-scenario regression tests (SURVEY.md §2.10, §4.2).
 
-Each documented attack is reproduced against the real fork-choice stores
-with the reference's own numbers, and each documented mitigation is shown
-to block the corresponding attack.
+Each documented attack is reproduced with the reference's own numbers,
+and each documented mitigation is shown to block the corresponding
+attack. The headline scenarios run IN-LOOP through ``Simulation`` via
+``AdversaryStrategy`` (sim/adversary.py); ``TestScriptedOracleParity``
+pins their asserted outcomes bit-identical to the original one-shot
+scripted reproductions (``scripted_run_*``), which stay in the file as
+ground truth.
 """
-
-import pytest
 
 from pos_evolution_tpu.config import minimal_config, use_config
 from pos_evolution_tpu.sim.attacks import (
@@ -14,6 +16,9 @@ from pos_evolution_tpu.sim.attacks import (
     run_ex_ante_reorg,
     run_ex_ante_reorg_with_boost,
     run_lmd_balancing_attack,
+    scripted_run_ex_ante_reorg,
+    scripted_run_ex_ante_reorg_with_boost,
+    scripted_run_lmd_balancing_attack,
 )
 
 
@@ -71,6 +76,38 @@ class TestLMDBalancingDespiteBoost:
         assert r["viewB_R_votes"] == 150 and r["viewB_L_votes"] == 0
         assert all(r["heads_disagree"]), r["heads_disagree"]
         assert r["justified_A"] == 0 and r["justified_B"] == 0
+
+
+class TestScriptedOracleParity:
+    """The Simulation-driven scenarios must reproduce the scripted
+    oracles' asserted outcomes bit-identically: same booleans, same vote
+    ledgers, same justification — the refactor moved the adversary
+    in-loop without changing what the reference says happens."""
+
+    def test_ex_ante_reorg_all_boost_regimes(self):
+        for boost in (0, 25):
+            with use_config(minimal_config().replace(
+                    proposer_score_boost_percent=boost)):
+                sim_r = run_ex_ante_reorg(64)
+                ora_r = scripted_run_ex_ante_reorg(64)
+            for key in ("b3_reorged", "b2_canonical"):
+                assert sim_r[key] == ora_r[key], (boost, key)
+
+    def test_ex_ante_reorg_with_boost(self):
+        with use_config(minimal_config().replace(
+                proposer_score_boost_percent=80)):
+            sim_r = run_ex_ante_reorg_with_boost(800)
+            ora_r = scripted_run_ex_ante_reorg_with_boost(800)
+        for key in ("per_slot_committee", "b3_reorged", "b4_canonical",
+                    "b2_canonical"):
+            assert sim_r[key] == ora_r[key], key
+
+    def test_lmd_balancing(self):
+        with use_config(minimal_config().replace(
+                proposer_score_boost_percent=70)):
+            sim_r = run_lmd_balancing_attack(800)
+            ora_r = scripted_run_lmd_balancing_attack(800)
+        assert sim_r == ora_r
 
 
 class TestBalancingAttack:

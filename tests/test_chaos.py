@@ -1,0 +1,194 @@
+"""Chaos fuzzing pipeline tests (scripts/chaos_fuzz.py).
+
+Tier-1 smoke: two fixed-seed episodes of random adversary x fault
+compositions run clean under the full monitor stack. The doctored
+negative (forced conflicting finalized checkpoints with no equivocation
+behind them) must trip the ``AccountableSafetyMonitor`` as a
+``protocol_violation``, write a complete repro bundle, replay to the
+same violation from ``Simulation.resume`` + seeds, and shrink to a
+strictly smaller composition. Longer fuzz sweeps are ``slow``.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import chaos_fuzz  # noqa: E402
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+class TestEpisodeComposition:
+    def test_pure_function_of_seed_and_episode(self):
+        a = chaos_fuzz.episode_config(7, 3)
+        b = chaos_fuzz.episode_config(7, 3)
+        assert a == b
+        assert a != chaos_fuzz.episode_config(7, 4)
+        assert a != chaos_fuzz.episode_config(8, 3)
+
+    def test_controlled_sets_disjoint_and_below_one_third(self):
+        for ep in range(12):
+            cfg = chaos_fuzz.episode_config(1, ep)
+            seen = set()
+            for strat in cfg["adversaries"]:
+                s = set(strat["controlled"])
+                assert not (s & seen), "controlled sets overlap"
+                seen |= s
+            assert 3 * len(seen) < cfg["n_validators"]
+
+    def test_crash_windows_spare_the_donor_group(self):
+        for ep in range(20):
+            cfg = chaos_fuzz.episode_config(2, ep)
+            for w in cfg["faults"]["crashes"]:
+                assert w["group"] == 1  # group 0 is the checkpoint donor
+
+
+class TestChaosSmoke:
+    def test_two_fixed_seed_episodes_clean(self, tmp_path):
+        """The tier-1 smoke: two seeded episodes, full monitor stack,
+        zero violations, no bundles, no watchdog incidents."""
+        summary = chaos_fuzz.fuzz(
+            episodes=2, seed=5, n_validators=64, n_slots=16,
+            out_dir=str(tmp_path))
+        assert summary["episodes"] == 2
+        assert summary["violating"] == 0
+        assert summary["incidents"] == 0
+        assert summary["bundles"] == []
+        # clean episodes leave no event logs behind
+        assert not [f for f in os.listdir(tmp_path)
+                    if f.endswith(".events.jsonl")]
+
+    @pytest.mark.slow
+    def test_fuzz_sweep_clean(self, tmp_path):
+        """Wider sweep over compositions (the real fuzzing workload),
+        long enough (8 epochs, GST at 1/3) that the liveness monitor is
+        ARMED for the tail epochs — a stalled composition would flag."""
+        from pos_evolution_tpu.config import cfg
+        c = cfg()
+        summary = chaos_fuzz.fuzz(
+            episodes=6, seed=0, n_validators=64,
+            n_slots=8 * c.slots_per_epoch, out_dir=str(tmp_path))
+        assert summary["violating"] == 0
+        assert summary["incidents"] == 0
+        # the bound arithmetic the sweep relies on: monitors must be
+        # armed before the episode ends
+        ep = chaos_fuzz.episode_config(0, 0, 64, 8 * c.slots_per_epoch)
+        sec_per_epoch = c.seconds_per_slot * c.slots_per_epoch
+        armed = -(-int(ep["faults"]["gst"]) // sec_per_epoch)
+        assert (armed + ep["monitors"]["liveness_bound_epochs"]
+                < 8), "liveness monitor never arms inside the sweep"
+
+
+class TestDoctoredNegative:
+    @pytest.fixture(scope="class")
+    def doctored(self, tmp_path_factory):
+        """One doctored episode, bundle + shrink included (class-scoped:
+        the replay/shrink assertions reuse the same run)."""
+        from pos_evolution_tpu.config import minimal_config, use_config
+        out = tmp_path_factory.mktemp("chaos_doctor")
+        with use_config(minimal_config()):
+            summary = chaos_fuzz.fuzz(
+                episodes=1, seed=5, n_validators=64, n_slots=16,
+                out_dir=str(out), doctor=True)
+        return summary, out
+
+    def test_trips_safety_monitor_loudly(self, doctored):
+        summary, _ = doctored
+        assert summary["violating"] == 1
+        (bundle,) = summary["bundles"]
+        violations = json.load(open(os.path.join(bundle, "violations.json")))
+        v = violations[0]
+        assert v["monitor"] == "accountable_safety"
+        # no equivocation behind the forged conflict -> the evidence set
+        # CANNOT reach 1/3: a genuine (non-accountable) safety break
+        assert v["kind"] == "protocol_violation"
+        assert 3 * v["slashable_stake"] < v["total_stake"]
+
+    def test_bundle_is_complete(self, doctored):
+        _, out = doctored
+        bundle = os.path.join(str(out), "bundle_ep0")
+        for name in ("config.json", "checkpoint.bin", "violations.json",
+                     "events.jsonl", "shrink.json", "config.min.json"):
+            path = os.path.join(bundle, name)
+            assert os.path.exists(path), f"bundle missing {name}"
+            assert os.path.getsize(path) > 0
+
+    def test_replay_reproduces_violation(self, doctored):
+        summary, _ = doctored
+        out = chaos_fuzz.replay_bundle(summary["bundles"][0])
+        assert out["match"], (out["replayed"], out["recorded"])
+
+    def test_run_report_property_audit_section(self, doctored):
+        """The bundle's event log folds into the run report's property
+        audit: the violation row (slot, evidence size, stake) and the
+        repro-bundle path both surface, in JSON and markdown."""
+        import run_report
+        summary, _ = doctored
+        bundle = summary["bundles"][0]
+        events_path = os.path.join(bundle, "events.jsonl")
+        events = run_report.read_jsonl(events_path)
+        assert run_report.discover_bundle(events_path) == bundle
+        report = run_report.build_report(events, bundle=bundle)
+        audit = report["property_audit"]
+        assert audit["clean"] is False
+        assert audit["repro_bundle"] == bundle
+        (v,) = audit["violations"]
+        assert v["monitor"] == "accountable_safety"
+        assert v["kind"] == "protocol_violation"
+        assert v["slot"] is not None and v["evidence_size"] > 0
+        kinds = [m["kind"] for m in audit["monitors"]]
+        assert "AccountableSafetyMonitor" in kinds
+        md = run_report.to_markdown(report)
+        assert "## Property audit" in md
+        assert "protocol_violation" in md and bundle in md
+
+    def test_run_report_clean_audit(self):
+        """A monitor-free log must NOT claim the properties held — there
+        was no audit; a monitored clean log may."""
+        import run_report
+        report = run_report.build_report(
+            [{"v": 1, "type": "slot", "slot": 1, "finalized_epoch": 0}])
+        audit = report["property_audit"]
+        assert audit["clean"] is True and audit["violations"] == []
+        assert "nothing was audited" in run_report.to_markdown(report)
+        monitored = run_report.build_report([
+            {"v": 1, "type": "monitor_attach",
+             "monitors": [{"kind": "AccountableSafetyMonitor"}],
+             "adversaries": []},
+            {"v": 1, "type": "slot", "slot": 1, "finalized_epoch": 0}])
+        assert "all properties held" in run_report.to_markdown(monitored)
+
+    def test_run_report_violation_keys_survive(self):
+        """The structured JSON must keep the conflict's identifying keys
+        (groups / epochs / roots), not just the free-text detail."""
+        import run_report
+        report = run_report.build_report([
+            {"v": 1, "type": "monitor", "slot": 9,
+             "monitor": "accountable_safety", "kind": "protocol_violation",
+             "checkpoint": "finalized", "groups": [0, 1], "epochs": [1, 1],
+             "roots": ["0d0d", "0e0e"], "evidence_size": 7,
+             "slashable_stake": 224, "total_stake": 2048, "detail": "x"}])
+        (v,) = report["property_audit"]["violations"]
+        assert v["groups"] == [0, 1]
+        assert v["epochs"] == [1, 1]
+        assert v["roots"] == ["0d0d", "0e0e"]
+
+    def test_shrink_strictly_reduces(self, doctored):
+        summary, _ = doctored
+        bundle = summary["bundles"][0]
+        shrink = json.load(open(os.path.join(bundle, "shrink.json")))
+        assert shrink["after"] < shrink["before"]
+        minimized = json.load(open(os.path.join(bundle, "config.min.json")))
+        original = json.load(open(os.path.join(bundle, "config.json")))
+        assert (len(chaos_fuzz._components(minimized))
+                < len(chaos_fuzz._components(original)))
+        # the minimized composition still violates
+        result = chaos_fuzz.run_episode(minimized)
+        assert chaos_fuzz._same_violation(
+            result["violations"],
+            json.load(open(os.path.join(bundle, "violations.json")))[0])

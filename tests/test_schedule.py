@@ -1,0 +1,146 @@
+"""Dedicated coverage for sim/schedule.py (previously only exercised
+indirectly through test_sim): Schedule defaults and group algebra, the
+canned schedule builders, the adversarial split builders from
+sim/attacks.py, and the committee/proposer scheduling invariants the
+adversary engine's per-slot arithmetic relies on."""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config, use_config
+from pos_evolution_tpu.sim.schedule import (
+    Schedule,
+    faulty_schedule,
+    honest_schedule,
+    partition_schedule,
+)
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+class TestScheduleDefaults:
+    def test_honest_schedule_is_single_synchronous_view(self):
+        s = honest_schedule(16)
+        assert s.n_groups == 1
+        assert list(s.members(0)) == list(range(16))
+        assert list(s.honest_members(0)) == list(range(16))
+        assert s.awake(0, 0) and s.awake(99, 15)
+        assert s.block_delay(0, 1, 0) == 0.0
+        assert s.attestation_delay(0, 1, 0) == 0.0
+        assert s.faults is None
+
+    def test_group_of_coerced_to_int64(self):
+        s = Schedule(n_validators=4, group_of=[0, 1, 0, 1])
+        assert s.group_of.dtype == np.int64
+        assert s.n_groups == 2
+
+    def test_members_partition_the_validator_set(self):
+        s = partition_schedule(10, 3)
+        all_members = np.concatenate([s.members(g) for g in range(s.n_groups)])
+        assert sorted(all_members.tolist()) == list(range(10))
+
+    def test_honest_members_excludes_corrupted(self):
+        s = partition_schedule(8, 2, corrupted={0, 3})
+        assert 0 not in s.honest_members(0)
+        assert 3 not in s.honest_members(1)
+        assert set(s.members(0).tolist()) - set(s.honest_members(0).tolist()) \
+            == {0}
+
+    def test_faulty_schedule_attaches_plan(self):
+        from pos_evolution_tpu.sim.faults import FaultPlan
+        plan = FaultPlan(seed=1, drop_p=0.5)
+        assert faulty_schedule(8, plan).n_groups == 1
+        s = faulty_schedule(8, plan, n_groups=2)
+        assert s.faults is plan and s.n_groups == 2
+
+
+class TestAdversarialSplitBuilders:
+    def test_balanced_split_halves_the_honest_set(self):
+        from pos_evolution_tpu.sim.attacks import balanced_split_schedule
+        corrupted = set(range(10))
+        s = balanced_split_schedule(64, corrupted)
+        h0, h1 = s.honest_members(0), s.honest_members(1)
+        assert len(h0) == len(h1) == (64 - 10) // 2
+        assert s.block_delay(0, 1, 1) == 0.0  # not isolated
+
+    def test_split_brain_withholds_all_cross_group_delivery(self):
+        from pos_evolution_tpu.sim.attacks import split_brain_schedule
+        s = split_brain_schedule(64, set(range(10)))
+        v0 = int(s.members(0)[0])
+        v1 = int(s.members(1)[0])
+        assert s.block_delay(v0, 3, 0) == 0.0
+        assert s.block_delay(v0, 3, 1) is None
+        assert s.block_delay(v1, 3, 0) is None
+        assert s.attestation_delay(0, 3, 1) is None
+        assert s.attestation_delay(1, 3, 1) == 0.0
+
+    def test_committee_balanced_split_balances_every_epoch0_slot(self):
+        from pos_evolution_tpu.sim.adversary import slot_committee
+        from pos_evolution_tpu.sim.attacks import (
+            committee_balanced_split_schedule,
+        )
+        from pos_evolution_tpu.config import cfg
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import advance_state_to_slot
+        n = 64
+        corrupted = set(range(19))
+        s = committee_balanced_split_schedule(n, corrupted)
+        state, _ = make_genesis(n)
+        for slot in range(1, cfg().slots_per_epoch):
+            committee = [int(v) for v in slot_committee(
+                advance_state_to_slot(state, slot), slot)]
+            honest = [v for v in committee if v not in corrupted]
+            sides = [int(s.group_of[v]) for v in honest]
+            assert abs(sides.count(0) - sides.count(1)) <= 1, \
+                f"slot {slot} honest committee not balanced"
+
+
+class TestCommitteeProposerScheduling:
+    """The spec-side scheduling the Schedule's group policies are applied
+    over: every validator attests exactly once per epoch, and the
+    proposer rotation is a deterministic function of the state."""
+
+    def test_slot_committees_partition_the_epoch(self):
+        from pos_evolution_tpu.config import cfg
+        from pos_evolution_tpu.sim.adversary import slot_committee
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import advance_state_to_slot
+        n = 48
+        state, _ = make_genesis(n)
+        seen = []
+        for slot in range(cfg().slots_per_epoch):
+            view = advance_state_to_slot(state, max(slot, 1))
+            seen.extend(int(v) for v in slot_committee(view, slot))
+        assert sorted(seen) == list(range(n))
+
+    def test_proposer_is_deterministic_and_in_range(self):
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.helpers import (
+            get_beacon_proposer_index,
+        )
+        from pos_evolution_tpu.specs.validator import advance_state_to_slot
+        n = 32
+        state, _ = make_genesis(n)
+        for slot in (1, 2, 5):
+            view = advance_state_to_slot(state, slot)
+            p1 = int(get_beacon_proposer_index(view))
+            p2 = int(get_beacon_proposer_index(
+                advance_state_to_slot(state, slot)))
+            assert p1 == p2
+            assert 0 <= p1 < n
+
+    def test_committee_assignment_stable_across_config_reentry(self):
+        """Same config, same genesis -> same committees (what the chaos
+        fuzzer's episode-ordering independence rests on)."""
+        from pos_evolution_tpu.sim.adversary import slot_committee
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import advance_state_to_slot
+
+        def epoch0(n):
+            with use_config(minimal_config()):
+                state, _ = make_genesis(n)
+                return [tuple(int(v) for v in slot_committee(
+                    advance_state_to_slot(state, max(s, 1)), s))
+                    for s in range(minimal_config().slots_per_epoch)]
+
+        assert epoch0(48) == epoch0(48)
