@@ -78,3 +78,23 @@ def epoch_sweep(state, cfg, dense=None):
         cfg,
     )
 
+
+
+def block_sweep(state, rows) -> None:
+    """Fused per-block attestation application on device: one jitted scan
+    over the block's attestation batch with the swept columns kept
+    device-resident across consecutive blocks (bit-identical to
+    numpy_backend.block_sweep)."""
+    from pos_evolution_tpu.ops.transition import apply_attestation_rows_device
+    apply_attestation_rows_device(state, rows)
+
+
+def multi_block_apply(state, signed_blocks, validate_result=True,
+                      pre_block=None, on_applied=None) -> None:
+    """Batched multi-block apply: same carried-state loop as the host
+    path, but each block's attestation batch runs the jitted fused sweep
+    and consecutive blocks reuse its device-resident carry (bit-identical
+    to numpy_backend.multi_block_apply)."""
+    from pos_evolution_tpu.ops.transition import apply_block_chain
+    apply_block_chain(state, signed_blocks, validate_result,
+                      pre_block=pre_block, on_applied=on_applied)

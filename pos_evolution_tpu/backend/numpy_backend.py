@@ -76,3 +76,19 @@ def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
         if p >= 0:
             w[p] += w[i]
     return w
+
+
+def block_sweep(state, rows) -> None:
+    """Fused per-block attestation application (ops/transition.py contract):
+    the NumPy oracle sweep with per-block constants hoisted."""
+    from pos_evolution_tpu.ops.transition import apply_attestation_rows_host
+    apply_attestation_rows_host(state, rows)
+
+
+def multi_block_apply(state, signed_blocks, validate_result=True,
+                      pre_block=None, on_applied=None) -> None:
+    """Batched multi-block apply (backfill/checkpoint-sync): the host loop
+    over spec ``state_transition`` with one carried state object."""
+    from pos_evolution_tpu.ops.transition import apply_block_chain
+    apply_block_chain(state, signed_blocks, validate_result,
+                      pre_block=pre_block, on_applied=on_applied)

@@ -325,3 +325,25 @@ class ResidentForkChoice:
         # the int() readback is the query's one device->host transfer
         jaxrt.record_transfer(4, direction="d2h", site="resident_head")
         return self.roots[int(head_idx)]
+
+
+# --- batched multi-block apply (ISSUE 6 tentpole, backfill entry) -------------
+
+def apply_block_batch(state, signed_blocks, validate_result: bool = True,
+                      pre_block=None, on_applied=None) -> None:
+    """Apply a parent-linked run of signed blocks to ``state`` in place,
+    dispatched through the current ``ExecutionBackend``
+    (``multi_block_apply`` on both backends; bit-identical host path).
+
+    This is the state-level batched entry for backfill / checkpoint-sync
+    chains: one carried state object, the fused per-block sweep's resident
+    columns staying hot across consecutive blocks, incremental
+    merkleization diffing block-to-block. Store-level batching (commit
+    points, checkpoint bookkeeping) lives in
+    ``specs/forkchoice.on_block_batch``, which the sim driver's ancestor
+    backfill calls; use this function directly when only the final state
+    (plus optional per-block callbacks) matters.
+    """
+    from pos_evolution_tpu.backend import get_backend
+    get_backend().multi_block_apply(state, signed_blocks, validate_result,
+                                    pre_block=pre_block, on_applied=on_applied)

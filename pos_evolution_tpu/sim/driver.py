@@ -37,7 +37,7 @@ from pos_evolution_tpu.specs.validator import (
     make_sync_aggregate,
 )
 from pos_evolution_tpu.sim.schedule import Schedule, honest_schedule
-from pos_evolution_tpu.ssz import hash_tree_root
+from pos_evolution_tpu.ssz import cached_root, hash_tree_root
 
 
 @dataclass(order=True)
@@ -142,8 +142,9 @@ class ViewGroup:
 
     def _process_block(self, signed_block) -> None:
         """One ``on_block`` plus its carried attestations and the resident
-        mirror — shared by gossip delivery and ancestor backfill."""
-        block_root = hash_tree_root(signed_block.message)
+        mirror — the gossip-delivery entry (backfilled ancestor runs go
+        through ``_process_block_chain``)."""
+        block_root = cached_root(signed_block.message)
         if block_root in self.store.blocks:
             # redelivery (FaultPlan duplicate_p, or a backfilled block
             # arriving again via gossip): reprocessing would re-run the
@@ -152,11 +153,35 @@ class ViewGroup:
             # every real client's pipeline
             return
         self._call_handler(fc.on_block, signed_block)
+        self._absorb_block(signed_block, block_root)
+
+    def _process_block_chain(self, signed_blocks) -> None:
+        """A parent-linked backfill run through ``fc.on_block_batch`` —
+        one carried pre-state, one finalized-descent walk — then absorb
+        each committed block's carried ops. A mid-run reject commits the
+        prefix exactly like the sequential loop, so absorption walks the
+        run until the first uncommitted block even when the batch raises."""
+        pending = [sb for sb in signed_blocks
+                   if cached_root(sb.message) not in self.store.blocks]
+        if not pending:
+            return
+        try:
+            self._call_handler(fc.on_block_batch, pending)
+        finally:
+            for sb in pending:
+                block_root = cached_root(sb.message)
+                if block_root not in self.store.blocks:
+                    break
+                self._absorb_block(sb, block_root)
+
+    def _absorb_block(self, signed_block, block_root: bytes) -> None:
+        """Post-``on_block`` bookkeeping: resident-mirror row, block-carried
+        attestations, and the carried-root index for op-pool dedup."""
         if self.resident is not None:
             self.resident.note_block(self.store, block_root)
         carried = []
         for att in signed_block.message.body.attestations:
-            carried.append(hash_tree_root(att))
+            carried.append(cached_root(att))
             try:
                 idx = self._call_handler(fc.on_attestation, att,
                                          is_from_block=True)
@@ -191,7 +216,7 @@ class ViewGroup:
                         idx = self._call_handler(fc.on_attestation,
                                                  msg.payload)
                         self._mirror_attestation(msg.payload, idx)
-                    self.pool[hash_tree_root(msg.payload)] = msg.payload
+                    self.pool[cached_root(msg.payload)] = msg.payload
                 elif msg.kind == "slashing":
                     with track("on_attester_slashing"):
                         evil = self._call_handler(fc.on_attester_slashing,
@@ -419,8 +444,7 @@ class Simulation:
                 return  # unconnectable (pre-anchor history): let on_block fail
             missing.append(sb)
             parent = bytes(sb.message.parent_root)
-        for sb in reversed(missing):
-            dst._process_block(sb)
+        dst._process_block_chain(list(reversed(missing)))
 
     # -- fault layer (sim/faults.py) -------------------------------------------
 
@@ -573,7 +597,7 @@ class Simulation:
                 # A real proposer drops the op, not the proposal.
                 sb = build_block(group.store.block_states[head], slot,
                                  attestations=[], sync_aggregate=sync_agg)
-            block_root = hash_tree_root(sb.message)
+            block_root = cached_root(sb.message)
             self.block_archive[block_root] = sb
             self._observe("block", sb)
             if self.telemetry is not None:
@@ -805,6 +829,39 @@ class Simulation:
             self.telemetry.registry.gauge(
                 "justified_epoch", "group-0 justified epoch").set(
                 rec["justified_epoch"])
+            self._record_merkleization(slot)
+
+    def _record_merkleization(self, slot: int) -> None:
+        """Per-slot deltas of the incremental-merkleization counters
+        (``ssz/incremental.stats()``) and the fused-transition residency
+        counters (``ops/transition.session_stats()``) — both are
+        process-cumulative, so the driver keeps a mark and feeds only this
+        simulation's deltas to the MetricsRegistry (``ssz.htr_cache_hit``
+        etc.) plus one ``merkleization`` event per slot that saw activity.
+        ``run_report.py`` folds the events into its merkleization section."""
+        from pos_evolution_tpu.ssz import incremental
+        cur = {f"ssz.{k}": v for k, v in incremental.stats().items()}
+        try:
+            from pos_evolution_tpu.ops.transition import session_stats
+            cur.update({f"fused.{k}": v for k, v in session_stats().items()})
+        except Exception:
+            pass  # transition module unavailable: ssz counters still flow
+        mark = getattr(self, "_merkle_mark", None)
+        self._merkle_mark = cur
+        if mark is None:
+            # first record (fresh __init__ or a resumed checkpoint): the
+            # cumulative counters include other sims / pre-checkpoint work
+            # in this process, so the first slot only seeds the mark
+            return
+        delta = {k: v - mark.get(k, 0) for k, v in cur.items()
+                 if v - mark.get(k, 0)}
+        reg = self.telemetry.registry
+        for k, v in delta.items():
+            reg.counter(k, "incremental merkleization / fused transition "
+                           "(per-sim delta of the process counters)").inc(v)
+        if delta:
+            self.telemetry.bus.emit("merkleization", slot=slot, **{
+                k.replace(".", "_"): v for k, v in delta.items()})
 
     # -- light clients (lightclient/) ------------------------------------------
 

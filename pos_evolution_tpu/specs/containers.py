@@ -585,7 +585,20 @@ class BeaconState(Container):
                 setattr(out, f, [x.copy() if hasattr(x, "copy") else x for x in v])
             else:
                 setattr(out, f, v)
+        # Share the incremental-merkleization cache with the copy: the
+        # cache diffs against whatever it last hashed, so one cache serves
+        # the whole copy lineage (ssz/incremental.py sharing contract).
+        cache = self.__dict__.get("_htr_cache")
+        if cache is not None:
+            out._htr_cache = cache
         return out
+
+    def __ssz_root__(self) -> bytes:
+        """Route ``hash_tree_root(state)`` through the incremental
+        merkleizer (ssz/incremental.py): only dirty subtrees re-hash.
+        Bit-identical to ``BeaconState.htr`` (property-pinned)."""
+        from pos_evolution_tpu.ssz.incremental import state_root
+        return state_root(self)
 
 
 class LatestMessage:

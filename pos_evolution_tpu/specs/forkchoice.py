@@ -405,6 +405,100 @@ def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
         store.justified_checkpoint = state.current_justified_checkpoint
 
 
+def on_block_batch(store: Store, signed_blocks: list) -> None:
+    """A parent-linked run of blocks applied as one batch — the req/resp
+    backfill / checkpoint-sync path of real clients, with per-block
+    semantics exactly those of ``on_block`` (pos-evolution.md:986-1036):
+    same asserts, same per-block commit points, and a mid-run failure
+    leaves the already-committed prefix in place precisely like the
+    sequential loop would. What the batch amortizes:
+
+    - the finalized-descent ``get_ancestor`` walk runs once, for the run's
+      first parent. Each later block's parent is the in-run block just
+      committed, which descends from the finalized checkpoint by
+      induction — including when finalization advances *mid-run*: the new
+      finalized root then lies on this very chain, and every remaining
+      block descends through it. (The per-block ``slot > finalized_slot``
+      assert is still evaluated against the live store.) This turns the
+      O(K · chain-depth) backfill walk into O(depth + K).
+    - the pre-state is copied once and carried through the run via the
+      ``ExecutionBackend``'s ``multi_block_apply``, so the fused block
+      sweep's device-resident columns stay hot across consecutive blocks
+      instead of re-uploading per block, and only the *stored* snapshots
+      are copied.
+    """
+    c = cfg()
+    if not signed_blocks:
+        return
+    parent_root = bytes(signed_blocks[0].message.parent_root)
+    assert parent_root in store.block_states, "unknown parent"
+    finalized_slot = compute_start_slot_at_epoch(int(store.finalized_checkpoint.epoch))
+    assert get_ancestor(store, parent_root, finalized_slot) \
+        == bytes(store.finalized_checkpoint.root), "not a descendant of finalized"
+
+    # Linkage + from-the-future checks for the whole run before any mutation
+    # (the sequential loop would also reject these before touching the store).
+    prev_root = parent_root
+    for sb in signed_blocks:
+        block = sb.message
+        assert bytes(block.parent_root) == prev_root, "batch not parent-linked"
+        assert get_current_slot(store) >= int(block.slot), "block from the future"
+        prev_root = hash_tree_root(block)
+
+    from pos_evolution_tpu.backend import get_backend
+    from pos_evolution_tpu.specs.merge import (
+        is_merge_transition_block, validate_merge_block)
+
+    state = store.block_states[parent_root].copy()
+    last_root = prev_root
+    merge_flag = [False]
+
+    def pre_block(sb, pre_state):
+        block = sb.message
+        fslot = compute_start_slot_at_epoch(int(store.finalized_checkpoint.epoch))
+        assert int(block.slot) > fslot, "block at or before finalized slot"
+        merge_flag[0] = is_merge_transition_block(pre_state, block.body)
+
+    def commit(sb, post_state):
+        block = sb.message
+        if merge_flag[0]:
+            validate_merge_block(block, pow_view=store.pow_chain)
+        block_root = hash_tree_root(block)
+        store.blocks[block_root] = block
+        # the working state keeps advancing; store a snapshot (the run's
+        # last block stores the working state itself)
+        store.block_states[block_root] = (
+            post_state if block_root == last_root else post_state.copy())
+
+        time_into_slot = (store.time - store.genesis_time) % c.seconds_per_slot
+        is_before_attesting_interval = \
+            time_into_slot < c.seconds_per_slot // c.intervals_per_slot
+        if get_current_slot(store) == int(block.slot) and is_before_attesting_interval:
+            store.proposer_boost_root = block_root
+
+        if int(post_state.current_justified_checkpoint.epoch) \
+                > int(store.justified_checkpoint.epoch):
+            if int(post_state.current_justified_checkpoint.epoch) \
+                    > int(store.best_justified_checkpoint.epoch):
+                store.best_justified_checkpoint = post_state.current_justified_checkpoint
+            if should_update_justified_checkpoint(
+                    store, post_state.current_justified_checkpoint):
+                store.justified_checkpoint = post_state.current_justified_checkpoint
+        if int(post_state.finalized_checkpoint.epoch) \
+                > int(store.finalized_checkpoint.epoch):
+            store.finalized_checkpoint = post_state.finalized_checkpoint
+            store.justified_checkpoint = post_state.current_justified_checkpoint
+
+    get_backend().multi_block_apply(state, signed_blocks, validate_result=True,
+                                    pre_block=pre_block, on_applied=commit)
+
+
+# Prefix-commit contract marker: a mid-run reject leaves the committed
+# prefix in the store by design (exactly like the sequential loop). The
+# debug StoreInvariantChecker honors this instead of flagging a torn write.
+on_block_batch.commits_prefix = True
+
+
 def prune_store(store: Store) -> int:
     """Drop blocks/states that cannot affect fork choice anymore: everything
     not descending from (or equal to) the finalized checkpoint block.

@@ -18,7 +18,6 @@ from pos_evolution_tpu.config import (
     DOMAIN_SYNC_COMMITTEE,
     DOMAIN_VOLUNTARY_EXIT,
     FAR_FUTURE_EPOCH,
-    PARTICIPATION_FLAG_WEIGHTS,
     PROPOSER_WEIGHT,
     SYNC_REWARD_WEIGHT,
     WEIGHT_DENOMINATOR,
@@ -46,7 +45,6 @@ from pos_evolution_tpu.specs.helpers import (
     decrease_balance,
     get_attestation_participation_flag_indices,
     get_attesting_indices,
-    get_base_reward,
     get_base_reward_per_increment,
     get_beacon_committee,
     get_beacon_proposer_index,
@@ -171,8 +169,17 @@ def process_operations(state: BeaconState, body) -> None:
         process_proposer_slashing(state, op)
     for op in body.attester_slashings:
         process_attester_slashing(state, op)
-    for op in body.attestations:
-        process_attestation(state, op)
+    # Attestations: validate sequentially (spec order), then apply the whole
+    # block's batch as ONE fused sweep through the ExecutionBackend
+    # (ops/transition.py). Bit-identical to the per-attestation reference
+    # loop: validation reads only state that attestation application never
+    # mutates (committees/seeds, checkpoints, block roots, pubkeys), and the
+    # sweep preserves sequential flag/reward semantics within the batch.
+    atts = list(body.attestations)
+    if atts:
+        rows = [_validate_attestation(state, op) for op in atts]
+        from pos_evolution_tpu.backend import get_backend
+        get_backend().block_sweep(state, rows)
     for op in body.deposits:
         process_deposit(state, op)
     for op in body.voluntary_exits:
@@ -181,7 +188,13 @@ def process_operations(state: BeaconState, body) -> None:
 
 # --- attestations (pos-evolution.md:722-755) ----------------------------------
 
-def process_attestation(state: BeaconState, attestation: Attestation) -> None:
+def _validate_attestation(state: BeaconState, attestation: Attestation):
+    """Everything ``process_attestation`` checks before its first mutation.
+
+    Returns the validated row ``(attesting_indices int64[k], flag_indices,
+    is_current)`` consumed by the fused sweep
+    (``ops/transition.apply_attestation_rows_*``).
+    """
     c = cfg()
     data = attestation.data
     assert int(data.target.epoch) in (get_previous_epoch(state), get_current_epoch(state))
@@ -200,29 +213,22 @@ def process_attestation(state: BeaconState, attestation: Attestation) -> None:
     assert is_valid_indexed_attestation(
         state, get_indexed_attestation(state, attestation)), "bad attestation signature"
 
-    if int(data.target.epoch) == get_current_epoch(state):
-        epoch_participation = state.current_epoch_participation
-    else:
-        epoch_participation = state.previous_epoch_participation
-
-    # Vectorized flag update + proposer reward (reference loop :744-749).
     attesting = get_attesting_indices(state, data, bits).astype(np.int64)
-    base_rewards = np.array([get_base_reward(state, int(i)) for i in attesting],
-                            dtype=np.int64)
-    proposer_reward_numerator = 0
-    new_flags = epoch_participation[attesting]
-    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
-        if flag_index not in participation_flag_indices:
-            continue
-        unset = ((new_flags >> np.uint8(flag_index)) & np.uint8(1)) == 0
-        proposer_reward_numerator += int(base_rewards[unset].sum()) * weight
-        new_flags = new_flags | np.uint8(1 << flag_index)
-    epoch_participation[attesting] = new_flags
+    is_current = int(data.target.epoch) == get_current_epoch(state)
+    return attesting, participation_flag_indices, is_current
 
-    proposer_reward_denominator = ((WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
-                                   * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
-    proposer_reward = proposer_reward_numerator // proposer_reward_denominator
-    increase_balance(state, get_beacon_proposer_index(state), proposer_reward)
+
+def process_attestation(state: BeaconState, attestation: Attestation) -> None:
+    """Reference-shaped single-attestation entry (validation + host apply).
+
+    Hot paths batch through ``process_operations``; this keeps the spec
+    signature for tests and one-off call sites, applying via the NumPy
+    oracle sweep (bit-identical to the reference loop :744-749 — the
+    per-attester ``get_base_reward`` collapses to the hoisted
+    per-increment constant, same integer arithmetic)."""
+    row = _validate_attestation(state, attestation)
+    from pos_evolution_tpu.ops.transition import apply_attestation_rows_host
+    apply_attestation_rows_host(state, [row])
 
 
 # --- deposits (pos-evolution.md:139-175) --------------------------------------

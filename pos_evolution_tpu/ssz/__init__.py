@@ -15,6 +15,7 @@ from pos_evolution_tpu.ssz.core import (
     Sedes,
     Vector,
     boolean,
+    cached_root,
     deserialize,
     hash_tree_root,
     serialize,

@@ -22,7 +22,7 @@ __all__ = [
     "Sedes", "uint8", "uint16", "uint32", "uint64", "boolean",
     "ByteVector", "ByteList", "Bytes4", "Bytes20", "Bytes32", "Bytes48", "Bytes96",
     "Vector", "List", "Bitvector", "Bitlist", "Container",
-    "hash_tree_root", "serialize", "deserialize",
+    "hash_tree_root", "cached_root", "serialize", "deserialize",
 ]
 
 OFFSET_SIZE = 4
@@ -532,7 +532,12 @@ class Container(metaclass=ContainerMeta):
         return type(self).htr(self)
 
     def copy(self) -> "Container":
-        return _copy.deepcopy(self)
+        out = _copy.deepcopy(self)
+        # a memoized root (cached_root) or an incremental-merkleization
+        # cache must not ride into a copy that may be mutated
+        out.__dict__.pop("_htr_memo", None)
+        out.__dict__.pop("_htr_cache", None)
+        return out
 
     def __eq__(self, other):
         if type(self) is not type(other):
@@ -600,8 +605,15 @@ def hash_tree_root(value, sedes=None) -> bytes:
 
     Objects that define ``__ssz_root__`` (e.g. the dense validator registry)
     hash themselves; containers know their own schema; anything else needs an
-    explicit ``sedes``.
+    explicit ``sedes``. A root memoized with ``cached_root`` (immutable
+    gossip objects: blocks, attestations) is honored first.
     """
+    if sedes is None:
+        d = getattr(value, "__dict__", None)
+        if d is not None:
+            memo = d.get("_htr_memo")
+            if memo is not None:
+                return memo
     custom = getattr(value, "__ssz_root__", None)
     if custom is not None and sedes is None:
         return custom()
@@ -610,6 +622,23 @@ def hash_tree_root(value, sedes=None) -> bytes:
             return type(value).htr(value)
         raise TypeError("hash_tree_root of a bare value requires a sedes")
     return _sedes_of(sedes).htr(value)
+
+
+def cached_root(value) -> bytes:
+    """``hash_tree_root`` memoized on the object (``_htr_memo``).
+
+    Only for objects that are immutable once rooted — the driver's gossip
+    payloads (signed blocks, attestations), whose roots were being
+    recomputed at origination, gossip delivery, pool insert, and backfill.
+    ``Container.copy()`` strips the memo, so copy-then-mutate flows
+    (adversarial equivocation builders) cannot observe a stale root.
+    """
+    d = value.__dict__
+    memo = d.get("_htr_memo")
+    if memo is None:
+        memo = hash_tree_root(value)
+        d["_htr_memo"] = memo
+    return memo
 
 
 def serialize(value, sedes=None) -> bytes:

@@ -1,0 +1,474 @@
+"""Incremental SSZ merkleization: persistent hash trees + dirty-subtree rehash.
+
+``hash_tree_root(state)`` used to re-merkleize every chunk of every field on
+every call — at 64K validators that is ~1.5M SHA-256 compressions per root,
+and the simulator asks for a state root several times per slot
+(``process_slot``, the post-block state-root check, head-state advances).
+SCALE_DEMO_r05 measured the consequence: ``on_block`` p50 of 1.39s with the
+state transition — not fork choice — as the wall.
+
+This module keeps a **persistent hash tree per big field** and re-hashes only
+the O(dirty · log n) paths above mutated chunks:
+
+- ``ChunkTree``      — one padded SSZ merkle tree over (N, 32) chunks with
+                       diff-based dirty detection (the spec layer mutates
+                       numpy columns in place, so mutations are *detected*
+                       by comparing against the last-seen leaves — a memcmp,
+                       not a hash — and never need explicit invalidation
+                       hooks). Dirty subtrees re-hash in batched
+                       ``sha256_pairs`` level sweeps, the level-sweep kernel
+                       shape of the MTU tree-unit paper (arxiv 2507.16793).
+- ``RegistryTree``   — the validator registry: column-level compares find
+                       dirty rows, only those rows re-run the 8-leaf
+                       validator merkleization, then the roots feed a
+                       ``ChunkTree`` capped at VALIDATOR_REGISTRY_LIMIT.
+- ``ContainerTreeCache`` — per-container orchestration: registry/list/vector
+                       fields get trees, small fields get serialize-compare
+                       root memos, and the field roots themselves sit in one
+                       more ``ChunkTree``.
+
+Correctness contract: **bit-identical to full re-merkleization** — the trees
+reproduce ``merkleize_chunks(chunks, limit)`` (+ ``mix_in_length``) exactly,
+including virtual zero-subtree padding to the type limit and list
+grow/shrink; ``tests/test_incremental_ssz.py`` pins this property under
+randomized mutation. A cache is an *optimization handle*, never a source of
+truth: a state that has never seen a cache (deserialized snapshots, copies
+from before the wiring) simply rebuilds on first use.
+
+Sharing contract: ``BeaconState.copy()`` hands the copy the *same* cache
+object. Diff-based detection makes that safe — whichever state asks for its
+root next diffs against whatever the cache last hashed, so fork siblings and
+parent/child states share one ~O(state) cache per lineage instead of one per
+stored state. (Single-threaded simulation; the cache is not locked.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pos_evolution_tpu.ssz.hash import sha256, sha256_pairs
+from pos_evolution_tpu.ssz.merkle import ZERO_HASHES, mix_in_length
+
+__all__ = [
+    "ChunkTree", "RegistryTree", "ContainerTreeCache",
+    "state_root", "stats", "reset_stats", "set_enabled",
+]
+
+
+# --- telemetry ----------------------------------------------------------------
+# Module-level cumulative counters; the sim driver snapshots deltas into its
+# MetricsRegistry each slot and run_report.py renders them as the
+# merkleization section.
+
+_STATS = {
+    "htr_calls": 0,        # incremental container-root computations
+    "htr_cache_hit": 0,    # field roots served without any re-hashing
+    "htr_cache_miss": 0,   # field roots that needed (partial) re-hashing
+    "dirty_chunks": 0,     # leaf chunks re-hashed across all trees
+    "rebuilds": 0,         # full tree (re)builds (first use / shrink / limit change)
+}
+
+_ENABLED = True
+
+
+def stats() -> dict:
+    return dict(_STATS)
+
+
+def reset_stats() -> None:
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def set_enabled(flag: bool) -> bool:
+    """Global switch (tests / A-B benches): when False, ``state_root``
+    falls back to full re-merkleization. Returns the previous value."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(flag)
+    return prev
+
+
+# --- persistent chunk tree ----------------------------------------------------
+
+def _depth_for(limit: int) -> int:
+    if limit <= 1:
+        return 0
+    return (limit - 1).bit_length()
+
+
+class ChunkTree:
+    """Persistent merkle tree over an (N, 32) uint8 chunk array.
+
+    ``limit`` is the chunk limit of the SSZ type (virtual zero padding up to
+    ``2**ceil(log2(limit))`` leaves); ``limit=None`` is the vector rule (pad
+    to the next power of two of the runtime count). ``root(chunks)`` diffs
+    the chunks against the last-seen leaves and re-hashes only the dirty
+    paths; a shrink or a limit change rebuilds from scratch (lists shrink
+    only at rare resets — eth1 vote clearing — so rebuilds stay off the hot
+    path).
+    """
+
+    __slots__ = ("limit", "count", "levels", "_root")
+
+    def __init__(self, limit: int | None = None):
+        self.limit = limit
+        self.count = -1
+        self.levels: list[np.ndarray] | None = None
+        self._root = b""
+
+    # -- public ---------------------------------------------------------------
+
+    def root(self, chunks: np.ndarray) -> bytes:
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+        if chunks.ndim == 1:
+            chunks = chunks.reshape(-1, 32)
+        n = chunks.shape[0]
+        if self.limit is not None and n > self.limit:
+            raise ValueError(f"{n} chunks exceed limit {self.limit}")
+        if self.levels is None or n < self.count:
+            return self._rebuild(chunks)
+        if n == self.count and np.array_equal(self.levels[0], chunks):
+            _STATS["htr_cache_hit"] += 1
+            return self._root
+        m = self.count
+        diff = (self.levels[0][: min(m, n)] != chunks[: min(m, n)]).any(axis=1)
+        dirty = np.nonzero(diff)[0]
+        if n > m:
+            dirty = np.concatenate(
+                [dirty, np.arange(m, n, dtype=np.int64)]).astype(np.int64)
+        if dirty.size == 0:
+            # pure equality (count unchanged) was handled above; reaching
+            # here with an empty dirty set means nothing changed
+            _STATS["htr_cache_hit"] += 1
+            return self._root
+        self._update(chunks, dirty, n)
+        _STATS["htr_cache_miss"] += 1
+        _STATS["dirty_chunks"] += int(dirty.size)
+        return self._root
+
+    def update_rows(self, chunks: np.ndarray, dirty: np.ndarray) -> bytes:
+        """Like ``root`` but with the dirty leaf set supplied by the caller
+        (``RegistryTree`` already knows which validator rows changed, so the
+        chunk-level compare would be redundant work). ``dirty`` must be a
+        superset of the changed rows; shrink/first-use still rebuilds."""
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8).reshape(-1, 32)
+        n = chunks.shape[0]
+        if self.limit is not None and n > self.limit:
+            raise ValueError(f"{n} chunks exceed limit {self.limit}")
+        if self.levels is None or n < self.count:
+            return self._rebuild(chunks)
+        dirty = np.asarray(dirty, dtype=np.int64)
+        if n > self.count:
+            dirty = np.concatenate(
+                [dirty, np.arange(self.count, n, dtype=np.int64)])
+        dirty = np.unique(dirty)
+        if dirty.size == 0 and n == self.count:
+            _STATS["htr_cache_hit"] += 1
+            return self._root
+        self._update(chunks, dirty, n)
+        _STATS["htr_cache_miss"] += 1
+        _STATS["dirty_chunks"] += int(dirty.size)
+        return self._root
+
+    # -- internals ------------------------------------------------------------
+
+    def _effective_depth(self, n: int) -> int:
+        limit = self.limit if self.limit is not None else max(n, 1)
+        return _depth_for(limit)
+
+    def _rebuild(self, chunks: np.ndarray) -> bytes:
+        n = chunks.shape[0]
+        _STATS["rebuilds"] += 1
+        _STATS["htr_cache_miss"] += 1
+        _STATS["dirty_chunks"] += n
+        self.count = n
+        if n == 0:
+            self.levels = [np.empty((0, 32), dtype=np.uint8)]
+            self._root = ZERO_HASHES[self._effective_depth(0)].tobytes()
+            return self._root
+        levels = [chunks.copy()]
+        layer = levels[0]
+        level = 0
+        while layer.shape[0] > 1:
+            if layer.shape[0] % 2 == 1:
+                layer = np.concatenate(
+                    [layer, ZERO_HASHES[level][None, :]], axis=0)
+            layer = sha256_pairs(layer[0::2], layer[1::2])
+            levels.append(layer)
+            level += 1
+        self.levels = levels
+        self._root = self._cap(levels[-1][0], level)
+        return self._root
+
+    def _update(self, chunks: np.ndarray, dirty: np.ndarray, n: int) -> None:
+        levels = self.levels
+        if n != self.count:
+            levels[0] = chunks.copy()
+        else:
+            levels[0][dirty] = chunks[dirty]
+        self.count = n
+        size = n
+        k = 0
+        while size > 1:
+            parents = np.unique(dirty >> 1)
+            next_size = (size + 1) // 2
+            if len(levels) <= k + 1:
+                levels.append(np.zeros((next_size, 32), dtype=np.uint8))
+            elif levels[k + 1].shape[0] != next_size:
+                grown = np.zeros((next_size, 32), dtype=np.uint8)
+                keep = min(levels[k + 1].shape[0], next_size)
+                grown[:keep] = levels[k + 1][:keep]
+                levels[k + 1] = grown
+            child = levels[k]
+            left = child[2 * parents]
+            right_idx = 2 * parents + 1
+            in_range = right_idx < size
+            right = np.empty((parents.shape[0], 32), dtype=np.uint8)
+            if in_range.any():
+                right[in_range] = child[right_idx[in_range]]
+            if (~in_range).any():
+                right[~in_range] = ZERO_HASHES[k]
+            levels[k + 1][parents] = sha256_pairs(
+                np.ascontiguousarray(left), right)
+            dirty = parents
+            size = next_size
+            k += 1
+        del levels[k + 1:]
+        self._root = self._cap(levels[k][0], k)
+
+    def _cap(self, top: np.ndarray, k: int) -> bytes:
+        """Combine the top of the occupied subtree with virtual zero
+        subtrees up to the type-limit depth (the SSZ padding rule)."""
+        root = top.tobytes()
+        for level in range(k, self._effective_depth(self.count)):
+            root = sha256(root + ZERO_HASHES[level].tobytes())
+        return root
+
+
+# --- validator registry -------------------------------------------------------
+
+_SCALAR_COLS = ("effective_balance", "slashed", "activation_eligibility_epoch",
+                "activation_epoch", "exit_epoch", "withdrawable_epoch")
+_ROW_COLS = ("pubkeys", "withdrawal_credentials")
+
+
+def _validator_roots_rows(reg, idx: np.ndarray) -> np.ndarray:
+    """``ValidatorRegistry.validator_roots`` restricted to rows ``idx``
+    (same batched 8-leaf merkleization, bit-identical per row)."""
+    k = idx.shape[0]
+    leaves = np.zeros((k, 8, 32), dtype=np.uint8)
+    pk = reg.pubkeys[idx]
+    pk_hi = np.zeros((k, 32), dtype=np.uint8)
+    pk_hi[:, :16] = pk[:, 32:]
+    leaves[:, 0] = sha256_pairs(np.ascontiguousarray(pk[:, :32]), pk_hi)
+    leaves[:, 1] = reg.withdrawal_credentials[idx]
+    leaves[:, 2, :8] = reg.effective_balance[idx].astype(
+        "<u8").view(np.uint8).reshape(k, 8)
+    leaves[:, 3, 0] = reg.slashed[idx].astype(np.uint8)
+    for j, f in enumerate(("activation_eligibility_epoch", "activation_epoch",
+                           "exit_epoch", "withdrawable_epoch")):
+        leaves[:, 4 + j, :8] = getattr(reg, f)[idx].astype(
+            "<u8").view(np.uint8).reshape(k, 8)
+    layer = leaves.reshape(k * 8, 32)
+    for _ in range(3):
+        layer = sha256_pairs(layer[0::2], layer[1::2])
+    return layer.reshape(k, 32)
+
+
+class RegistryTree:
+    """Incremental ``List[Validator, VALIDATOR_REGISTRY_LIMIT]`` root.
+
+    Keeps a copy of every registry column plus the per-validator roots;
+    ``root(reg)`` finds dirty rows by column compare (``np.array_equal``
+    fast path per column — most blocks touch no registry column at all),
+    re-merkleizes only those validators, and pushes the changed roots into
+    a limit-capped ``ChunkTree``.
+    """
+
+    __slots__ = ("_cols", "_roots", "_tree", "_limit")
+
+    def __init__(self):
+        self._cols: dict | None = None
+        self._roots: np.ndarray | None = None
+        self._tree: ChunkTree | None = None
+        self._limit = -1
+
+    def root(self, reg, limit: int) -> bytes:
+        n = len(reg)
+        if self._tree is None or limit != self._limit:
+            self._limit = limit
+            self._tree = ChunkTree(limit)
+            self._cols = None
+        if self._cols is None or n < self._roots.shape[0]:
+            self._roots = reg.validator_roots()
+            self._snapshot(reg, np.arange(n, dtype=np.int64), n)
+            tree_root = self._tree.update_rows(
+                self._roots, np.arange(n, dtype=np.int64))
+            return mix_in_length(tree_root, n)
+
+        old_n = self._roots.shape[0]
+        m = min(old_n, n)
+        dirty_mask = None
+        for f in _SCALAR_COLS + _ROW_COLS:
+            new_col = getattr(reg, f)
+            old_col = self._cols[f]
+            if new_col.shape[0] == old_col.shape[0] and \
+                    np.array_equal(new_col, old_col):
+                continue
+            d = new_col[:m] != old_col[:m]
+            if d.ndim == 2:
+                d = d.any(axis=1)
+            dirty_mask = d if dirty_mask is None else (dirty_mask | d)
+        dirty = (np.nonzero(dirty_mask)[0].astype(np.int64)
+                 if dirty_mask is not None else np.empty(0, dtype=np.int64))
+        if n > old_n:
+            dirty = np.concatenate(
+                [dirty, np.arange(old_n, n, dtype=np.int64)])
+        if dirty.size:
+            new_roots = _validator_roots_rows(reg, dirty)
+            if n > old_n:
+                grown = np.zeros((n, 32), dtype=np.uint8)
+                grown[:old_n] = self._roots
+                self._roots = grown
+            self._roots[dirty] = new_roots
+            self._snapshot(reg, dirty, n)
+        tree_root = self._tree.update_rows(self._roots, dirty)
+        return mix_in_length(tree_root, n)
+
+    def _snapshot(self, reg, dirty: np.ndarray, n: int) -> None:
+        """Refresh the column copies for the rows just re-hashed."""
+        if self._cols is None or n != self._cols["effective_balance"].shape[0]:
+            self._cols = {f: getattr(reg, f).copy()
+                          for f in _SCALAR_COLS + _ROW_COLS}
+            return
+        for f in _SCALAR_COLS + _ROW_COLS:
+            self._cols[f][dirty] = getattr(reg, f)[dirty]
+
+
+# --- per-container orchestration ----------------------------------------------
+
+def _pack_uint_chunks(arr: np.ndarray, byte_len: int) -> np.ndarray:
+    """Basic-uint list/vector -> (ceil(bytes/32), 32) zero-padded chunks."""
+    raw = np.ascontiguousarray(arr).astype(f"<u{byte_len}").view(np.uint8)
+    n_bytes = raw.size
+    if n_bytes == 0:
+        return np.empty((0, 32), dtype=np.uint8)
+    padded = np.zeros(((n_bytes + 31) // 32) * 32, dtype=np.uint8)
+    padded[:n_bytes] = raw.reshape(-1)
+    return padded.reshape(-1, 32)
+
+
+class _TreeField:
+    """A field backed by a ``ChunkTree`` (+ optional length mix-in)."""
+
+    __slots__ = ("chunker", "mix", "length_of", "tree")
+
+    def __init__(self, chunker, mix: bool, length_of, limit: int | None):
+        self.chunker = chunker
+        self.mix = mix
+        self.length_of = length_of
+        self.tree = ChunkTree(limit)
+
+    def root(self, value) -> bytes:
+        r = self.tree.root(self.chunker(value))
+        if self.mix:
+            r = mix_in_length(r, self.length_of(value))
+        return r
+
+
+class _SmallField:
+    """Serialize-compare memo for cheap fields: identical serialization
+    implies identical root (SSZ serialization is injective per sedes)."""
+
+    __slots__ = ("sedes", "_blob", "_root")
+
+    def __init__(self, sedes):
+        self.sedes = sedes
+        self._blob = None
+        self._root = b""
+
+    def root(self, value) -> bytes:
+        blob = self.sedes.serialize(value)
+        if blob == self._blob:
+            _STATS["htr_cache_hit"] += 1
+            return self._root
+        _STATS["htr_cache_miss"] += 1
+        self._blob = blob
+        self._root = self.sedes.htr(value)
+        return self._root
+
+
+class _RegistryField:
+    __slots__ = ("reg_tree",)
+
+    def __init__(self):
+        self.reg_tree = RegistryTree()
+
+    def root(self, value) -> bytes:
+        from pos_evolution_tpu.config import cfg
+        return self.reg_tree.root(value, cfg().validator_registry_limit)
+
+
+class ContainerTreeCache:
+    """Incremental ``hash_tree_root`` for one container lineage.
+
+    Field handlers are derived from the container's sedes inventory: the
+    dense registry, root-row vectors/lists and packed uint lists/vectors
+    get persistent trees; everything else gets a serialize-compare memo.
+    """
+
+    def __init__(self, cls):
+        from pos_evolution_tpu.specs import containers as _c
+        from pos_evolution_tpu.ssz.core import _sedes_of
+        self.cls = cls
+        self.fields = {}
+        for fname, s in cls._fields.items():
+            sedes = _sedes_of(s)
+            if isinstance(sedes, _c._RegistrySedes):
+                self.fields[fname] = _RegistryField()
+            elif isinstance(sedes, _c.Bytes32Rows):
+                self.fields[fname] = _TreeField(
+                    chunker=lambda v: v,
+                    mix=sedes.is_list,
+                    length_of=lambda v: np.ascontiguousarray(
+                        v, dtype=np.uint8).reshape(-1, 32).shape[0],
+                    limit=sedes.limit if sedes.is_list else None)
+            elif isinstance(sedes, _c._U64ListSedes):
+                per_chunk = 32 // sedes.byte_len
+                limit_chunks = (sedes.limit + per_chunk - 1) // per_chunk
+                self.fields[fname] = _TreeField(
+                    chunker=(lambda bl: lambda v: _pack_uint_chunks(v, bl))(
+                        sedes.byte_len),
+                    mix=True,
+                    length_of=lambda v: np.asarray(v).shape[0],
+                    limit=limit_chunks)
+            elif isinstance(sedes, _c._U64VectorSedes):
+                self.fields[fname] = _TreeField(
+                    chunker=lambda v: _pack_uint_chunks(v, 8),
+                    mix=False, length_of=None, limit=None)
+            else:
+                self.fields[fname] = _SmallField(sedes)
+        self.top = ChunkTree(None)
+
+    def root(self, value) -> bytes:
+        _STATS["htr_calls"] += 1
+        roots = b"".join(self.fields[f].root(getattr(value, f))
+                         for f in self.cls._fields)
+        chunks = np.frombuffer(roots, dtype=np.uint8).reshape(-1, 32)
+        return self.top.root(chunks)
+
+
+# --- BeaconState entry point --------------------------------------------------
+
+def state_root(state) -> bytes:
+    """Incremental ``hash_tree_root`` for a BeaconState: attach (or reuse)
+    the lineage cache and fold in only the dirty subtrees. Falls back to
+    full re-merkleization when disabled via ``set_enabled(False)``."""
+    if not _ENABLED:
+        return type(state).htr(state)
+    cache = state.__dict__.get("_htr_cache")
+    if cache is None or cache.cls is not type(state):
+        cache = ContainerTreeCache(type(state))
+        state._htr_cache = cache
+    return cache.root(state)

@@ -12,6 +12,10 @@ Reconstructs, from the event log alone (no live ``Simulation``):
 - **handler percentiles** — p50/p95/count over every event carrying
   ``handler`` + ``duration_ms`` (deliveries and ``get_head`` queries);
 - **light-client lag** — worst/final head- and finality-lag per node;
+- **merkleization** — totals + hit rate of the incremental-SSZ and
+  fused-transition counters (``merkleization`` events: per-slot deltas of
+  ``ssz.htr_cache_hit`` / ``ssz.htr_cache_miss`` / dirty-chunk counts and
+  the fused sweep's upload/patch/reuse residency decisions);
 - the **property audit** — the online monitor verdicts
   (``sim/monitors.py`` ``monitor`` events: accountable-safety /
   liveness / fork-choice-parity violations with slot, evidence size and
@@ -180,6 +184,24 @@ def build_report(events: list[dict], top_ops: dict | None = None,
         row["final_head_lag"] = e.get("head_lag")
         row["final_finality_lag"] = e.get("finality_lag")
 
+    # -- merkleization (ssz/incremental.py + ops/transition.py counters) ------
+    merk_events = by_type.get("merkleization", [])
+    merk_totals: dict[str, int] = {}
+    for e in merk_events:
+        for k, v in e.items():
+            if k.startswith(("ssz_", "fused_")) and isinstance(v, (int, float)):
+                merk_totals[k] = merk_totals.get(k, 0) + v
+    merkleization = None
+    if merk_totals:
+        hits = merk_totals.get("ssz_htr_cache_hit", 0)
+        misses = merk_totals.get("ssz_htr_cache_miss", 0)
+        merkleization = {
+            "slots_with_activity": len(merk_events),
+            "totals": dict(sorted(merk_totals.items())),
+            "htr_hit_rate": (round(hits / (hits + misses), 4)
+                             if hits + misses else None),
+        }
+
     # -- property audit (sim/monitors.py verdicts + invariant checker) --------
     attach = (by_type.get("monitor_attach") or [{}])[0]
     violations = [
@@ -230,6 +252,8 @@ def build_report(events: list[dict], top_ops: dict | None = None,
         "handlers": handlers,
         "light_clients": {str(k): v for k, v in sorted(lc.items())},
     }
+    if merkleization:
+        report["merkleization"] = merkleization
     if top_ops:
         report["top_device_ops"] = top_ops
     if cost:
@@ -331,6 +355,17 @@ def to_markdown(report: dict) -> str:
             md.append(f"  - {iv}")
     if audit.get("repro_bundle"):
         md.append(f"- repro bundle: `{audit['repro_bundle']}`")
+
+    if report.get("merkleization"):
+        merk = report["merkleization"]
+        md += ["", "## Merkleization", ""]
+        if merk.get("htr_hit_rate") is not None:
+            md.append(f"- field-root cache hit rate: "
+                      f"**{merk['htr_hit_rate']:.1%}** over "
+                      f"{merk['slots_with_activity']} active slot(s)")
+        md += ["", *_md_table(
+            ["counter", "total"],
+            [[k, v] for k, v in merk["totals"].items()])]
 
     md += ["", "## Handler percentiles", ""]
     if report["handlers"]:

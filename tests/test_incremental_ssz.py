@@ -1,0 +1,535 @@
+"""Incremental SSZ merkleization + fused block transition (ISSUE 6).
+
+Two bit-identity contracts, both pinned by randomized property tests:
+
+1. **Incremental == full merkleization.** ``ssz/incremental.py``'s
+   persistent trees must reproduce ``merkleize_chunks`` (+
+   ``mix_in_length``) exactly under arbitrary mutation sequences —
+   point writes, wholesale rewrites, list grow/shrink, zero-content
+   appends whose only root effect is the length mix-in.
+2. **Fused transition == spec reference.** The batched attestation sweep
+   (``ops/transition.py``, dispatched via ``ExecutionBackend``) must give
+   the same post-state as the reference per-attestation loop — per
+   attestation on the host path, per block chain across both backends.
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.backend import set_backend
+from pos_evolution_tpu.config import (
+    PARTICIPATION_FLAG_WEIGHTS,
+    PROPOSER_WEIGHT,
+    WEIGHT_DENOMINATOR,
+    cfg,
+)
+from pos_evolution_tpu.specs.containers import (
+    BeaconState,
+    SignedBeaconBlock,
+    Validator,
+)
+from pos_evolution_tpu.specs.genesis import make_genesis
+from pos_evolution_tpu.specs.helpers import (
+    get_base_reward,
+    get_beacon_proposer_index,
+    increase_balance,
+)
+from pos_evolution_tpu.specs.transition import (
+    _validate_attestation,
+    process_slots,
+    state_transition,
+)
+from pos_evolution_tpu.specs.validator import attest_all_committees, build_block
+from pos_evolution_tpu.ssz import cached_root, hash_tree_root
+from pos_evolution_tpu.ssz.incremental import (
+    ChunkTree,
+    RegistryTree,
+    reset_stats,
+    set_enabled,
+    state_root,
+    stats,
+)
+from pos_evolution_tpu.ssz.merkle import merkleize_chunks, mix_in_length
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+def _rand_chunks(rng, n):
+    return rng.integers(0, 256, size=(n, 32)).astype(np.uint8)
+
+
+# --- ChunkTree vs merkleize_chunks --------------------------------------------
+
+class TestChunkTree:
+    @pytest.mark.parametrize("limit", [None, 1, 16, 1024, 2**35])
+    def test_randomized_mutations_bit_identical(self, limit):
+        rng = np.random.default_rng(0xC0 + (limit or 0) % 97)
+        tree = ChunkTree(limit)
+        cap = min(limit if limit is not None else 64, 64)
+        n = int(rng.integers(0, min(cap, 12) + 1))
+        chunks = _rand_chunks(rng, n)
+        for round_ in range(40):
+            move = rng.integers(0, 5)
+            if move == 0 and chunks.shape[0] < cap:            # append
+                chunks = np.concatenate(
+                    [chunks, _rand_chunks(rng, int(rng.integers(1, 4)))])
+                chunks = chunks[:cap]
+            elif move == 1 and chunks.shape[0] > 0:            # shrink
+                chunks = chunks[:int(rng.integers(0, chunks.shape[0] + 1))]
+            elif move == 2 and chunks.shape[0] > 0:            # point writes
+                k = int(rng.integers(1, chunks.shape[0] + 1))
+                rows = rng.choice(chunks.shape[0], size=k, replace=False)
+                chunks = chunks.copy()
+                chunks[rows] = _rand_chunks(rng, k)
+            elif move == 3:                                    # rewrite
+                chunks = _rand_chunks(rng, int(rng.integers(0, cap + 1)))
+            # move == 4: no-op round (cache-hit path)
+            assert tree.root(chunks) == merkleize_chunks(chunks, limit), \
+                f"divergence at round {round_} (n={chunks.shape[0]})"
+
+    def test_zero_content_append_changes_nothing_at_chunk_level(self):
+        # The tree caches on chunk CONTENT; the length mix-in lives with the
+        # caller. Appending zero bytes that do not alter any packed chunk
+        # must serve the cached root (the mix_in_length edge is the
+        # caller's job — pinned at state level below).
+        tree = ChunkTree(64)
+        chunks = np.zeros((4, 32), dtype=np.uint8)
+        r1 = tree.root(chunks)
+        assert r1 == merkleize_chunks(chunks, 64)
+        before = stats()["dirty_chunks"]
+        assert tree.root(chunks.copy()) == r1
+        assert stats()["dirty_chunks"] == before  # pure cache hit
+
+    def test_odd_count_zero_padding(self):
+        rng = np.random.default_rng(7)
+        tree = ChunkTree(None)
+        for n in (1, 3, 5, 7, 9, 6, 2):
+            chunks = _rand_chunks(rng, n)
+            assert tree.root(chunks) == merkleize_chunks(chunks, None)
+
+    def test_empty(self):
+        for limit in (None, 1, 8, 2**30):
+            assert ChunkTree(limit).root(
+                np.empty((0, 32), dtype=np.uint8)) == \
+                merkleize_chunks(np.empty((0, 32), dtype=np.uint8), limit)
+
+    def test_limit_overflow_raises(self):
+        with pytest.raises(ValueError):
+            ChunkTree(2).root(_rand_chunks(np.random.default_rng(1), 3))
+
+
+# --- RegistryTree vs full registry merkleization ------------------------------
+
+class TestRegistryTree:
+    def _full_root(self, reg):
+        limit = cfg().validator_registry_limit
+        return mix_in_length(
+            merkleize_chunks(reg.validator_roots(), limit), len(reg))
+
+    def test_randomized_registry_mutations(self):
+        state, _ = make_genesis(16)
+        reg = state.validators
+        tree = RegistryTree()
+        limit = cfg().validator_registry_limit
+        rng = np.random.default_rng(21)
+        assert tree.root(reg, limit) == self._full_root(reg)
+        for _ in range(25):
+            move = rng.integers(0, 4)
+            if move == 0:      # scalar column point write
+                i = int(rng.integers(0, len(reg)))
+                reg.effective_balance[i] = np.uint64(rng.integers(1, 2**35))
+            elif move == 1:    # slash + exit epochs
+                i = int(rng.integers(0, len(reg)))
+                reg.slashed[i] = True
+                reg.exit_epoch[i] = np.uint64(rng.integers(0, 2**20))
+            elif move == 2:    # row column write (credentials)
+                i = int(rng.integers(0, len(reg)))
+                reg.withdrawal_credentials[i] = rng.integers(
+                    0, 256, 32).astype(np.uint8)
+            else:              # append a validator (registry grow)
+                v = Validator()
+                v.effective_balance = np.uint64(32 * 10**9)
+                reg.append(v)
+            assert tree.root(reg, limit) == self._full_root(reg)
+
+    def test_no_mutation_is_a_cache_hit(self):
+        state, _ = make_genesis(8)
+        tree = RegistryTree()
+        limit = cfg().validator_registry_limit
+        r1 = tree.root(state.validators, limit)
+        before = stats()["dirty_chunks"]
+        assert tree.root(state.validators, limit) == r1
+        assert stats()["dirty_chunks"] == before
+
+
+# --- BeaconState: incremental state_root == full htr --------------------------
+
+def _mutate_state(state, rng, round_):
+    """One randomized mutation drawn from the shapes the transition layer
+    actually performs — returns a tag for failure messages."""
+    n = len(state.validators)
+    move = int(rng.integers(0, 10))
+    if move == 0:
+        rows = rng.choice(n, size=int(rng.integers(1, n)), replace=False)
+        state.balances[rows] += np.uint64(1000)
+        return "balances"
+    if move == 1:
+        rows = rng.choice(n, size=int(rng.integers(1, n)), replace=False)
+        state.current_epoch_participation[rows] |= np.uint8(
+            rng.integers(1, 8))
+        return "participation"
+    if move == 2:
+        state.slot = int(state.slot) + 1
+        return "slot"
+    if move == 3:
+        i = int(rng.integers(0, state.randao_mixes.shape[0]))
+        state.randao_mixes[i] = rng.integers(0, 256, 32).astype(np.uint8)
+        return "randao"
+    if move == 4:
+        i = int(rng.integers(0, state.block_roots.shape[0]))
+        state.block_roots[i] = rng.integers(0, 256, 32).astype(np.uint8)
+        return "block_roots"
+    if move == 5:  # list GROW: historical roots accumulate
+        state.historical_roots = np.concatenate(
+            [state.historical_roots,
+             rng.integers(0, 256, (1, 32)).astype(np.uint8)])
+        return "historical_roots grow"
+    if move == 6:  # eth1 vote list grow, periodically cleared (SHRINK)
+        if round_ % 7 == 6 and len(state.eth1_data_votes):
+            state.eth1_data_votes = []
+            return "eth1_data_votes clear"
+        state.eth1_data_votes = list(state.eth1_data_votes) + [
+            state.eth1_data.copy()]
+        return "eth1_data_votes grow"
+    if move == 7:
+        state.validators.effective_balance[
+            int(rng.integers(0, n))] = np.uint64(31 * 10**9)
+        return "registry effective_balance"
+    if move == 8:  # registry + parallel columns grow (deposit shape)
+        state.validators.append(Validator())
+        for f in ("balances", "previous_epoch_participation",
+                  "current_epoch_participation", "inactivity_scores"):
+            col = getattr(state, f)
+            setattr(state, f, np.concatenate(
+                [col, np.zeros(1, dtype=col.dtype)]))
+        return "deposit grow"
+    state.justification_bits = np.roll(state.justification_bits, 1)
+    state.finalized_checkpoint.epoch = int(
+        state.finalized_checkpoint.epoch) + 1
+    return "finality"
+
+
+class TestIncrementalStateRoot:
+    def test_randomized_state_mutations_bit_identical(self):
+        state, _ = make_genesis(16)
+        rng = np.random.default_rng(1234)
+        full = BeaconState.htr  # the from-scratch oracle
+        assert state_root(state) == full(state)
+        for round_ in range(60):
+            tag = _mutate_state(state, rng, round_)
+            assert state_root(state) == full(state), \
+                f"divergence after round {round_}: {tag}"
+
+    def test_zero_append_mix_in_length_edge(self):
+        # Appending a ZERO balance/participation row can leave every packed
+        # chunk byte-identical (8 uint64 per chunk); the root must still
+        # change, via the length mix-in alone.
+        state, _ = make_genesis(8)  # 8 balances = exactly one chunk
+        assert state_root(state) == BeaconState.htr(state)
+        state.balances = np.concatenate(
+            [state.balances, np.zeros(0, dtype=np.uint64)])
+        r8 = state_root(state)
+        state.previous_epoch_participation = np.concatenate(
+            [state.previous_epoch_participation,
+             np.zeros(1, dtype=np.uint8)])  # 9th zero byte: chunk unchanged
+        r9 = state_root(state)
+        assert r8 != r9
+        assert r9 == BeaconState.htr(state)
+
+    def test_hash_tree_root_routes_through_incremental(self):
+        state, _ = make_genesis(8)
+        reset_stats()
+        assert hash_tree_root(state) == BeaconState.htr(state)
+        assert stats()["htr_calls"] == 1  # __ssz_root__ hook engaged
+
+    def test_disabled_falls_back_to_full(self):
+        state, _ = make_genesis(8)
+        prev = set_enabled(False)
+        try:
+            reset_stats()
+            assert state_root(state) == BeaconState.htr(state)
+            assert stats()["htr_calls"] == 0
+        finally:
+            set_enabled(prev)
+
+    def test_copy_shares_cache_and_both_roots_stay_correct(self):
+        state, _ = make_genesis(16)
+        state_root(state)  # warm the cache
+        fork = state.copy()
+        assert fork.__dict__.get("_htr_cache") is \
+            state.__dict__.get("_htr_cache")
+        # diverge both sides; whichever asks next diffs against the other's
+        # last-hashed leaves — roots must stay exact either way
+        state.balances[0] += np.uint64(7)
+        fork.balances[1] += np.uint64(9)
+        for s in (state, fork, state, fork):
+            assert state_root(s) == BeaconState.htr(s)
+
+    def test_base_container_copy_strips_cache(self):
+        # Container.copy() (deepcopy path for non-BeaconState containers)
+        # must not carry a memoized root into a mutable copy.
+        state, _ = make_genesis(8)
+        sb = build_block(state.copy(), 1)
+        root = cached_root(sb.message)
+        assert sb.message.__dict__.get("_htr_memo") == root
+        twin = sb.message.copy()
+        assert "_htr_memo" not in twin.__dict__
+        twin.slot = int(twin.slot) + 1
+        assert cached_root(twin) != root
+        assert cached_root(sb.message) == root == hash_tree_root(sb.message)
+
+
+# --- fused transition parity --------------------------------------------------
+
+def _reference_process_attestation(state, attestation):
+    """The pre-fusion spec loop (reference :744-749): per-attester
+    ``get_base_reward``, sequential flag updates, per-flag unset-gated
+    proposer-reward numerator. Kept verbatim as the parity oracle."""
+    attesting, flag_indices, is_current = _validate_attestation(
+        state, attestation)
+    participation = (state.current_epoch_participation if is_current
+                     else state.previous_epoch_participation)
+    base_rewards = np.array(
+        [get_base_reward(state, int(i)) for i in attesting], dtype=np.int64)
+    numerator = 0
+    new_flags = participation[attesting]
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        if flag_index not in flag_indices:
+            continue
+        unset = ((new_flags >> np.uint8(flag_index)) & np.uint8(1)) == 0
+        numerator += int(base_rewards[unset].sum()) * weight
+        new_flags = new_flags | np.uint8(1 << flag_index)
+    participation[attesting] = new_flags
+    denom = ((WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+             * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
+    increase_balance(state, get_beacon_proposer_index(state),
+                     numerator // denom)
+
+
+def _build_chain(n_validators, slots):
+    """One honest chain: returns (genesis_state, [signed blocks])."""
+    state, _ = make_genesis(n_validators)
+    genesis = state.copy()
+    blocks, atts = [], []
+    for slot in range(1, slots + 1):
+        sb = build_block(state, slot, attestations=atts)
+        state_transition(state, sb, True)
+        atts = attest_all_committees(state, slot, cached_root(sb.message))
+        blocks.append(sb)
+    return genesis, blocks
+
+
+def _assert_swept_columns_equal(a, b, tag):
+    for f in ("balances", "current_epoch_participation",
+              "previous_epoch_participation"):
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f"{tag}: {f}"
+
+
+class TestFusedTransitionParity:
+    def test_sweeps_equal_reference_loop_on_real_blocks(self):
+        """For every block of an honest chain: the reference sequential
+        per-attestation loop, the batched host sweep, and the batched
+        device sweep must mutate identical pre-states identically."""
+        from pos_evolution_tpu.ops import transition as optr
+        genesis, blocks = _build_chain(32, 2 * cfg().slots_per_epoch + 2)
+        optr.reset_session()
+        state = genesis.copy()
+        try:
+            for sb in blocks:
+                atts = list(sb.message.body.attestations)
+                if atts:
+                    pre = state.copy()
+                    process_slots(pre, int(sb.message.slot))
+                    ref_s, host_s, dev_s = (pre.copy(), pre.copy(),
+                                            pre.copy())
+                    for att in atts:
+                        _reference_process_attestation(ref_s, att)
+                    rows = [_validate_attestation(host_s, a) for a in atts]
+                    optr.apply_attestation_rows_host(host_s, rows)
+                    optr.apply_attestation_rows_device(dev_s, rows)
+                    tag = f"slot {int(sb.message.slot)}"
+                    _assert_swept_columns_equal(ref_s, host_s, tag)
+                    _assert_swept_columns_equal(ref_s, dev_s, tag)
+                state_transition(state, sb, True)
+        finally:
+            optr.reset_session()
+
+    @pytest.mark.parametrize("backend", ["numpy", "jax"])
+    def test_chain_replay_matches_block_state_roots(self, backend):
+        """``state_transition(validate_result=True)`` re-checks every
+        block's embedded state_root — replaying the chain under each
+        backend is therefore a per-block bit-identity test against the
+        roots the build-time states committed to."""
+        from pos_evolution_tpu.ops.transition import reset_session
+        genesis, blocks = _build_chain(32, 2 * cfg().slots_per_epoch + 3)
+        set_backend(backend)
+        reset_session()
+        try:
+            replay = genesis.copy()
+            for sb in blocks:
+                state_transition(replay, sb, True)
+            assert hash_tree_root(replay) == bytes(
+                blocks[-1].message.state_root)
+        finally:
+            set_backend("numpy")
+
+    def test_device_session_reuse_patch_upload_decisions(self):
+        """The residency session's three regimes, driven directly: an
+        untouched state reuses the carry, a few perturbed rows (what
+        sync-aggregate rewards do between blocks at scale) scatter-patch
+        it, a wholesale rewrite re-uploads — and every regime stays
+        bit-identical to the host sweep. (At toy validator counts real
+        blocks always re-upload — sync rewards touch every row — which is
+        why this drives the session synthetically.)"""
+        from pos_evolution_tpu.ops import transition as optr
+        state, _ = make_genesis(64)
+        state.slot = 5
+        rng = np.random.default_rng(3)
+
+        def rows_for(seed):
+            r = np.random.default_rng(seed)
+            return [(np.sort(r.choice(64, size=8, replace=False))
+                     .astype(np.int64), [0, 1], True)]
+
+        optr.reset_session()
+        mark = optr.session_stats()  # process-cumulative: assert deltas
+
+        def since():
+            return {k: v - mark[k] for k, v in optr.session_stats().items()}
+
+        try:
+            def sweep(seed):
+                host, dev = state.copy(), state.copy()
+                optr.apply_attestation_rows_host(host, rows_for(seed))
+                optr.apply_attestation_rows_device(dev, rows_for(seed))
+                _assert_swept_columns_equal(host, dev, f"seed {seed}")
+                # adopt the device write-back as the next pre-state
+                for f in ("balances", "previous_epoch_participation",
+                          "current_epoch_participation"):
+                    setattr(state, f, getattr(dev, f))
+
+            sweep(0)
+            assert since()["uploads"] == 1
+            sweep(1)   # untouched since write-back: pure reuse
+            assert since()["reuses"] == 1
+            state.balances[rng.choice(64, 3, replace=False)] += np.uint64(5)
+            sweep(2)   # 3 of 64 rows moved: scatter-patch
+            assert since()["patches"] == 1
+            state.balances = state.balances + np.uint64(1)  # wholesale
+            sweep(3)
+            assert since()["uploads"] == 2
+        finally:
+            optr.reset_session()
+
+    def test_multi_block_apply_equals_sequential(self):
+        from pos_evolution_tpu.ops.resident import apply_block_batch
+        genesis, blocks = _build_chain(32, cfg().slots_per_epoch + 2)
+        seq = genesis.copy()
+        for sb in blocks:
+            state_transition(seq, sb, True)
+        for backend in ("numpy", "jax"):
+            from pos_evolution_tpu.ops.transition import reset_session
+            set_backend(backend)
+            reset_session()
+            try:
+                batch = genesis.copy()
+                seen = []
+                apply_block_batch(
+                    batch, blocks,
+                    on_applied=lambda sb, st: seen.append(
+                        int(sb.message.slot)))
+                assert seen == [int(sb.message.slot) for sb in blocks]
+                assert hash_tree_root(batch) == hash_tree_root(seq), backend
+            finally:
+                set_backend("numpy")
+
+    def test_on_block_batch_equals_sequential_on_block(self):
+        from pos_evolution_tpu.specs import forkchoice as fc
+        genesis, blocks = _build_chain(32, cfg().slots_per_epoch + 2)
+        spe, sps = cfg().slots_per_epoch, cfg().seconds_per_slot
+
+        def fresh_store():
+            state, anchor = make_genesis(32)
+            store = fc.get_forkchoice_store(state, anchor)
+            fc.on_tick(store, store.genesis_time
+                       + (len(blocks) + 1) * sps)
+            return store
+
+        seq, bat = fresh_store(), fresh_store()
+        for sb in blocks:
+            fc.on_block(seq, sb)
+        fc.on_block_batch(bat, list(blocks))
+        assert set(seq.blocks) == set(bat.blocks)
+        assert seq.justified_checkpoint.as_key() == \
+            bat.justified_checkpoint.as_key()
+        assert seq.finalized_checkpoint.as_key() == \
+            bat.finalized_checkpoint.as_key()
+        for root in seq.blocks:
+            assert hash_tree_root(seq.block_states[root]) == \
+                hash_tree_root(bat.block_states[root]), \
+                f"state divergence at {root.hex()[:12]}"
+
+    def test_on_block_batch_commits_prefix_on_mid_run_failure(self):
+        from pos_evolution_tpu.specs import forkchoice as fc
+        genesis, blocks = _build_chain(32, 6)
+        state, anchor = make_genesis(32)
+        store = fc.get_forkchoice_store(state, anchor)
+        fc.on_tick(store, store.genesis_time + 10 * cfg().seconds_per_slot)
+        bad = blocks[3].message.copy()
+        bad.state_root = b"\x00" * 32  # corrupt the batch TAIL: mutating a
+        # mid-run block changes its root and the suffix no longer
+        # parent-links, which the batch pre-pass rejects before ANY
+        # commit — also worth pinning:
+        bad_signed = SignedBeaconBlock(message=bad,
+                                       signature=blocks[3].signature)
+        with pytest.raises(AssertionError):
+            fc.on_block_batch(store, blocks[:3] + [bad_signed] + blocks[4:])
+        assert all(cached_root(sb.message) not in store.blocks
+                   for sb in blocks[:3]), "linkage reject must commit nothing"
+        # intact prefix + corrupt tail: the transition fails MID-RUN and
+        # the committed prefix stays, exactly like the sequential loop
+        with pytest.raises(AssertionError):
+            fc.on_block_batch(store, blocks[:3] + [bad_signed])
+        committed = {cached_root(sb.message) for sb in blocks[:3]}
+        assert committed <= set(store.blocks)
+        for root in committed:
+            assert root in store.block_states
+        assert cached_root(bad) not in store.blocks
+
+    def test_prefix_commit_is_not_an_invariant_violation(self):
+        """The debug StoreInvariantChecker must not report the batch's
+        documented prefix-commit as a torn write — while still flagging a
+        handler WITHOUT the contract marker that mutates on failure."""
+        from pos_evolution_tpu.specs import forkchoice as fc
+        from pos_evolution_tpu.utils.metrics import StoreInvariantChecker
+        genesis, blocks = _build_chain(32, 6)
+        state, anchor = make_genesis(32)
+        store = fc.get_forkchoice_store(state, anchor)
+        fc.on_tick(store, store.genesis_time + 10 * cfg().seconds_per_slot)
+        bad = blocks[3].message.copy()
+        bad.state_root = b"\x00" * 32
+        bad_signed = SignedBeaconBlock(message=bad,
+                                       signature=blocks[3].signature)
+        checker = StoreInvariantChecker(store)
+        with pytest.raises(AssertionError):
+            checker.call(fc.on_block_batch, blocks[:3] + [bad_signed])
+        assert checker.violations == []  # prefix commit is the contract
+        assert cached_root(blocks[0].message) in store.blocks
+
+        def torn(store_, _arg):
+            del store_.blocks[cached_root(blocks[0].message)]
+            raise AssertionError("fail after mutating")
+
+        with pytest.raises(AssertionError):
+            checker.call(torn, None)
+        assert len(checker.violations) == 1  # unmarked handlers still flag
