@@ -42,6 +42,21 @@ def sync_update_verify(batch):
     return verify_batch_device(batch)
 
 
+def das_verify(batch):
+    """Batched DAS sample verification on device: one SHA-256 lane per
+    sampled cell + the jitted scan merkle walk (bit-identical to
+    numpy_backend.das_verify)."""
+    from pos_evolution_tpu.ops.das_verify import verify_samples_device
+    return verify_samples_device(batch)
+
+
+def das_reconstruct(cells: np.ndarray, present: np.ndarray):
+    """Erasure-reconstruction consistency check as jitted GF(2^8)
+    gather/XOR matmuls (bit-identical to numpy_backend.das_reconstruct)."""
+    from pos_evolution_tpu.ops.das_verify import reconstruct_check_device
+    return reconstruct_check_device(cells, present)
+
+
 def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
     """Same contract as numpy_backend.subtree_weights (parent[i] < i)."""
     w = node_weight.astype(np.int64).copy()

@@ -62,6 +62,19 @@ def sync_update_verify(batch):
     return verify_batch_host(batch)
 
 
+def das_verify(batch):
+    """Batched DAS sample verification (ops/das_verify.py contract):
+    hashlib/NumPy leaf hashing + vectorized merkle walks."""
+    from pos_evolution_tpu.ops.das_verify import verify_samples_host
+    return verify_samples_host(batch)
+
+
+def das_reconstruct(cells: np.ndarray, present: np.ndarray):
+    """Erasure-reconstruction consistency check (any >=50% of cells)."""
+    from pos_evolution_tpu.ops.das_verify import reconstruct_check_host
+    return reconstruct_check_host(cells, present)
+
+
 def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
     """Accumulate each node's weight into all ancestors.
 

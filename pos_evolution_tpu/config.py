@@ -130,6 +130,17 @@ class Config:
     terminal_block_hash: bytes = b"\x00" * 32
     terminal_block_hash_activation_epoch: int = 2**64 - 1
 
+    # --- data availability sampling (das/, DESIGN.md §15) ---
+    # One blob = ``das_cells_per_blob`` data cells of ``das_cell_bytes``
+    # bytes; Reed-Solomon extension doubles it to a 2k-cell grid, any k of
+    # which reconstruct the blob. 2k must stay <= 256 (GF(2^8) evaluation
+    # points) and power-of-two (the commitment tree is a padded binary
+    # merkle tree over the extended grid).
+    das_cell_bytes: int = 64
+    das_cells_per_blob: int = 16
+    das_max_blobs_per_block: int = 2
+    das_samples_per_client: int = 8
+
     # --- protocol-variant knobs (L7) ---
     # Vote expiry period η: ∞ (None→2**62) = LMD, 1 = Goldfish
     # (pos-evolution.md:1585).
@@ -173,6 +184,8 @@ def minimal_config() -> Config:
         safe_slots_to_update_justified=2,
         epochs_per_eth1_voting_period=4,
         inactivity_penalty_quotient=2**24,
+        das_cells_per_blob=8,
+        das_samples_per_client=4,
     )
 
 

@@ -1,0 +1,63 @@
+"""Data-availability-sampling subsystem (DESIGN.md §15).
+
+Four layers, wired through every existing level of the stack:
+
+- **data** — erasure-coded blobs (``das/erasure.py``), SSZ blob sidecars
+  over the extended cell grid (``das/containers.py``), and pluggable
+  cell commitments with generalized-index multiproofs
+  (``das/commitment.py``; KZG slots in here when ROADMAP item 3 lands);
+- **verification** — batched (client, cell) sample checks and the
+  50%-erasure reconstruction check on both ``ExecutionBackend`` paths
+  (``ops/das_verify.py``);
+- **availability** — deterministic blob production + per-view stores
+  feeding the fork-choice data-availability gate (``das/engine.py``,
+  ``specs/forkchoice.on_block``);
+- **serving** — a vectorized 10^5+ sampling-client population with
+  request coalescing, LRU proof/update caches and p50/p95 latency
+  metrics (``das/sampler.py``, ``das/server.py``), driven per slot by
+  ``sim/driver.py`` and reported by ``scripts/run_report.py``.
+"""
+
+from pos_evolution_tpu.das.commitment import (
+    CellCommitmentScheme,
+    MerkleCellScheme,
+    get_scheme,
+    register_scheme,
+)
+from pos_evolution_tpu.das.containers import (
+    MAX_EXTENDED_CELLS,
+    BlobSidecar,
+    CellRows,
+    das_graffiti,
+    parse_das_graffiti,
+)
+from pos_evolution_tpu.das.engine import BlobEngine, BlobStore
+from pos_evolution_tpu.das.erasure import (
+    extend_blob,
+    extension_matrix,
+    gf_matmul,
+    reconstruct_blob,
+)
+from pos_evolution_tpu.das.sampler import SamplingClientPopulation
+from pos_evolution_tpu.das.server import DasServer, LRUCache
+
+__all__ = [
+    "MAX_EXTENDED_CELLS",
+    "BlobEngine",
+    "BlobSidecar",
+    "BlobStore",
+    "CellCommitmentScheme",
+    "CellRows",
+    "DasServer",
+    "LRUCache",
+    "MerkleCellScheme",
+    "SamplingClientPopulation",
+    "das_graffiti",
+    "extend_blob",
+    "extension_matrix",
+    "get_scheme",
+    "gf_matmul",
+    "parse_das_graffiti",
+    "reconstruct_blob",
+    "register_scheme",
+]

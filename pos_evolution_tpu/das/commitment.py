@@ -1,0 +1,148 @@
+"""Pluggable cell-commitment schemes for the DAS grid.
+
+A commitment scheme binds one blob's extended cell grid to a 32-byte
+commitment and proves individual cells (or batches of cells) against it.
+The default ``MerkleCellScheme`` is a padded binary merkle tree over the
+per-cell SHA-256 leaves — every tree level is one batched
+``sha256_pairs`` sweep (ssz/hash.py on host, ops/sha256.py on device),
+the level-sweep kernel shape of the MTU tree-unit paper (arxiv
+2507.16793) — with generalized-index multiproofs
+(``ssz.merkle.build_multiproof``) standing in for the polynomial
+multiproofs of arxiv 2604.16559.
+
+The scheme is a seam, not a constant: commitments travel as opaque
+32-byte roots and every verifier goes through the scheme object, so a
+pairing-based KZG scheme (ROADMAP item 3's device pairing) can register
+under a new name and slot in without touching the sidecar containers,
+the availability gate, or the serving layer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pos_evolution_tpu.config import cfg
+from pos_evolution_tpu.ssz.hash import sha256_batch
+from pos_evolution_tpu.ssz.merkle import (
+    ZERO_HASHES,
+    _tree_levels,
+    build_multiproof,
+    merkle_tree_branch,
+    merkleize_chunks,
+    verify_multiproof,
+)
+
+__all__ = [
+    "CellCommitmentScheme",
+    "MerkleCellScheme",
+    "register_scheme",
+    "get_scheme",
+]
+
+
+class CellCommitmentScheme:
+    """Contract every scheme implements over an (n_cells, cell_bytes) grid."""
+
+    name = "abstract"
+
+    def cell_leaves(self, cells: np.ndarray) -> np.ndarray:
+        """(n, 32) leaf values the commitment tree/polynomial is built over."""
+        raise NotImplementedError
+
+    def commit(self, cells: np.ndarray) -> bytes:
+        """32-byte commitment to the full extended grid."""
+        raise NotImplementedError
+
+    def branch(self, cells: np.ndarray, index: int) -> np.ndarray:
+        """(depth, 32) single-cell inclusion proof for ``cells[index]``."""
+        raise NotImplementedError
+
+    def prove_cells(self, cells: np.ndarray, indices) -> list[bytes]:
+        """One aggregated proof for a batch of cell indices."""
+        raise NotImplementedError
+
+    def verify_cells(self, commitment: bytes, cells: np.ndarray, indices,
+                     proof: list[bytes]) -> bool:
+        """Check a batch of (index, cell) pairs against ``commitment``."""
+        raise NotImplementedError
+
+
+class MerkleCellScheme(CellCommitmentScheme):
+    """SHA-256 merkle commitment over per-cell leaves.
+
+    The grid's 2k cell count is a power of two, so the tree is exactly
+    depth log2(2k) with no virtual padding; single-cell branches feed the
+    batched device walk in ``ops/das_verify.py`` and multi-cell proofs use
+    the generalized-index multiproof (shared prefixes shipped once).
+    """
+
+    name = "merkle"
+
+    @staticmethod
+    def depth_for(n_cells: int) -> int:
+        return max(int(n_cells - 1).bit_length(), 0)
+
+    def cell_leaves(self, cells: np.ndarray) -> np.ndarray:
+        return sha256_batch(np.ascontiguousarray(cells, dtype=np.uint8))
+
+    def commit(self, cells: np.ndarray) -> bytes:
+        return merkleize_chunks(self.cell_leaves(cells))
+
+    def branch(self, cells: np.ndarray, index: int) -> np.ndarray:
+        leaves = self.cell_leaves(cells)
+        sibs = merkle_tree_branch(leaves, int(index),
+                                  self.depth_for(leaves.shape[0]))
+        return np.frombuffer(b"".join(sibs), dtype=np.uint8).reshape(-1, 32)
+
+    def branches(self, cells: np.ndarray, indices) -> tuple[np.ndarray, np.ndarray]:
+        """(leaves[indices], (len(indices), depth, 32) branches) for the
+        batched sample-verification kernel — leaves hashed once, every
+        branch read off one shared tree."""
+        leaves = self.cell_leaves(cells)
+        depth = self.depth_for(leaves.shape[0])
+        levels = _tree_levels(leaves, depth)  # hash the tree ONCE
+        out = np.zeros((len(indices), depth, 32), dtype=np.uint8)
+        for j, i in enumerate(indices):
+            idx = int(i)
+            for d in range(depth):
+                layer, sib = levels[d], idx ^ 1
+                out[j, d] = (layer[sib] if sib < layer.shape[0]
+                             else ZERO_HASHES[d])
+                idx >>= 1
+        return leaves[np.asarray(indices, dtype=np.int64)], out
+
+    def prove_cells(self, cells: np.ndarray, indices) -> list[bytes]:
+        leaves = self.cell_leaves(cells)
+        return build_multiproof(leaves, [int(i) for i in indices],
+                                self.depth_for(leaves.shape[0]))
+
+    def verify_cells(self, commitment: bytes, cells: np.ndarray, indices,
+                     proof: list[bytes]) -> bool:
+        # hash only the sampled cells — the verifier never sees the grid
+        leaves = sha256_batch(np.ascontiguousarray(cells, dtype=np.uint8))
+        n_cells = 2 * cfg().das_cells_per_blob
+        return verify_multiproof([leaves[j].tobytes()
+                                  for j in range(leaves.shape[0])],
+                                 [int(i) for i in indices], proof,
+                                 self.depth_for(n_cells), commitment)
+
+
+_SCHEMES: dict[str, type] = {}
+
+
+def register_scheme(cls) -> type:
+    """Register a ``CellCommitmentScheme`` subclass by its ``name`` —
+    the hook a future pairing-based (KZG) scheme plugs into."""
+    _SCHEMES[cls.name] = cls
+    return cls
+
+
+def get_scheme(name: str = "merkle") -> CellCommitmentScheme:
+    try:
+        return _SCHEMES[name]()
+    except KeyError:
+        raise ValueError(f"unknown cell-commitment scheme {name!r}; "
+                         f"registered: {sorted(_SCHEMES)}") from None
+
+
+register_scheme(MerkleCellScheme)

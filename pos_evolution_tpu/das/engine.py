@@ -1,0 +1,215 @@
+"""Blob production + per-view availability: the full-node side of DAS.
+
+``BlobEngine`` is the deterministic blob workload: a blob's data cells
+are a seeded pure function of (slot, parent_root, blob_index), so the
+proposer, every verifying view group, and a resumed simulation all
+regenerate byte-identical sidecars from the chain alone — the same
+replay-from-seed posture as ``sim/faults.py``.
+
+``BlobStore`` is one view group's availability state: sidecars arrive by
+gossip (or req/resp backfill), get verified — commitment recomputed over
+the full grid, then the erasure-consistency check from a 50% subset
+through the ``ExecutionBackend`` (``ops/das_verify.reconstruct_check``)
+— and ``is_available`` answers the fork-choice gate: a block whose
+graffiti carries the DAS marker imports only once every committed
+sidecar is held and verified (specs/forkchoice.on_block, gated exactly
+like the merge payload validation).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from pos_evolution_tpu.config import cfg
+from pos_evolution_tpu.das.commitment import CellCommitmentScheme, get_scheme
+from pos_evolution_tpu.das.containers import (
+    BlobSidecar,
+    commitments_digest,
+    das_graffiti,
+    parse_das_graffiti,
+    validate_das_config,
+)
+from pos_evolution_tpu.das.erasure import extend_blob
+from pos_evolution_tpu.ssz.hash import sha256_batch
+
+__all__ = ["BlobEngine", "BlobStore"]
+
+
+class BlobEngine:
+    """Deterministic blob workload + sidecar factory (one per Simulation)."""
+
+    def __init__(self, n_blobs: int | None = None, scheme: str = "merkle",
+                 seed: int = 0):
+        validate_das_config()
+        self.n_blobs = n_blobs
+        self.scheme: CellCommitmentScheme = (
+            scheme if isinstance(scheme, CellCommitmentScheme)
+            else get_scheme(scheme))
+        self.seed = int(seed)
+
+    def blobs_per_block(self) -> int:
+        return (cfg().das_max_blobs_per_block if self.n_blobs is None
+                else self.n_blobs)
+
+    def blob_data(self, slot: int, parent_root: bytes,
+                  blob_index: int) -> np.ndarray:
+        """(k, cell_bytes) seeded data cells — one SHA-256 counter stream
+        per blob, batched across the whole grid."""
+        c = cfg()
+        total = c.das_cells_per_blob * c.das_cell_bytes
+        n_hashes = (total + 31) // 32
+        msgs = np.zeros((n_hashes, 52), dtype=np.uint8)
+        msgs[:, :8] = np.frombuffer(
+            self.seed.to_bytes(8, "little"), dtype=np.uint8)
+        msgs[:, 8:16] = np.frombuffer(
+            int(slot).to_bytes(8, "little"), dtype=np.uint8)
+        msgs[:, 16:48] = np.frombuffer(bytes(parent_root), dtype=np.uint8)
+        msgs[:, 48] = blob_index & 0xFF
+        msgs[:, 49:52] = np.arange(n_hashes, dtype="<u4").view(
+            np.uint8).reshape(n_hashes, 4)[:, :3]
+        stream = sha256_batch(msgs).reshape(-1)[:total]
+        return stream.reshape(c.das_cells_per_blob, c.das_cell_bytes)
+
+    def build_for(self, slot: int, parent_root: bytes
+                  ) -> tuple[list[np.ndarray], list[bytes], bytes]:
+        """Everything a proposer needs BEFORE the block exists: the
+        extended grids, their commitments, and the graffiti marker the
+        block must carry (state_root covers graffiti, so the marker goes
+        in at build time)."""
+        grids, commitments = [], []
+        for i in range(self.blobs_per_block()):
+            grid = extend_blob(self.blob_data(slot, parent_root, i))
+            grids.append(grid)
+            commitments.append(self.scheme.commit(grid))
+        return grids, commitments, das_graffiti(commitments)
+
+    def sidecars_for(self, signed_block, block_root: bytes,
+                     grids: list[np.ndarray],
+                     commitments: list[bytes]) -> list[BlobSidecar]:
+        block = signed_block.message
+        return [BlobSidecar(slot=int(block.slot),
+                            proposer_index=int(block.proposer_index),
+                            block_root=bytes(block_root),
+                            blob_index=i,
+                            n_blobs=len(grids),
+                            cells=grid,
+                            commitment=commitments[i])
+                for i, grid in enumerate(grids)]
+
+    def regenerate(self, signed_block, block_root: bytes) -> list[BlobSidecar]:
+        """Rebuild a block's sidecars from the block alone (resume path /
+        late joiners): blob content is a pure function of the seed."""
+        block = signed_block.message
+        grids, commitments, _ = self.build_for(int(block.slot),
+                                               bytes(block.parent_root))
+        return self.sidecars_for(signed_block, block_root, grids, commitments)
+
+    def describe(self) -> dict:
+        return {"kind": "blob_engine", "scheme": self.scheme.name,
+                "n_blobs": self.blobs_per_block(), "seed": self.seed}
+
+
+class BlobStore:
+    """One view group's DAS availability state (hangs off ``Store.blob_store``)."""
+
+    def __init__(self, engine: BlobEngine, registry=None, group: int = -1):
+        self.engine = engine
+        self.registry = registry
+        self.group = group
+        # (block_root, blob_index) -> {commitment: verified BlobSidecar}.
+        # Candidate SETS, not first-writer-wins: a sidecar that is
+        # self-consistent under its own (wrong) commitment still verifies
+        # here, and must not block the honest one for the same slot — the
+        # block's graffiti digest picks the real set at gate time.
+        self.sidecars: dict[tuple[bytes, int], dict[bytes, BlobSidecar]] = {}
+        # block_root -> the candidate-per-index selection whose commitment
+        # set matched the graffiti digest (memo filled by is_available)
+        self._resolved: dict[bytes, list[BlobSidecar]] = {}
+
+    # -- ingest ----------------------------------------------------------------
+
+    def _count(self, name: str, help_: str) -> None:
+        if self.registry is not None:
+            self.registry.counter(name, help_).inc(group=self.group)
+
+    def on_sidecar(self, sc: BlobSidecar) -> bool:
+        """Gossip/backfill ingest: verify, then index. Verification =
+        geometry + commitment recompute over the full grid + the
+        50%-erasure consistency check through the ExecutionBackend (a
+        corrupted or miscommitted sidecar is rejected, counted, and never
+        feeds the availability gate)."""
+        c = cfg()
+        key = (bytes(sc.block_root), int(sc.blob_index))
+        com = bytes(sc.commitment)
+        if com in self.sidecars.get(key, ()):
+            self._count("das_sidecar_duplicates_total",
+                        "sidecar redeliveries ignored by the blob store")
+            return True
+        cells = np.ascontiguousarray(sc.cells, dtype=np.uint8)
+        ok = (cells.shape == (2 * c.das_cells_per_blob, c.das_cell_bytes)
+              and int(sc.blob_index) < int(sc.n_blobs))
+        if ok:
+            ok = self.engine.scheme.commit(cells) == bytes(sc.commitment)
+        if ok:
+            from pos_evolution_tpu.ops.das_verify import reconstruct_check
+            # reconstruct from the PARITY half (a data-half mask would make
+            # the interpolation matrix the identity — data compared to
+            # itself): the k data cells interpolated back from the parity
+            # evaluations must equal the claimed data half, and their
+            # re-extension must reproduce the claimed parity half, so the
+            # whole grid lies on one degree-<k polynomial
+            half = np.zeros(cells.shape[0], dtype=bool)
+            half[c.das_cells_per_blob:] = True
+            recon_ok, data = reconstruct_check(cells, half)
+            ok = recon_ok and bool(
+                (data == cells[: c.das_cells_per_blob]).all())
+        if not ok:
+            self._count("das_sidecars_rejected_total",
+                        "sidecars failing commitment/erasure verification")
+            return False
+        self.sidecars.setdefault(key, {})[com] = sc
+        self._count("das_sidecars_accepted_total",
+                    "sidecars verified and stored")
+        return True
+
+    # -- availability gate -----------------------------------------------------
+
+    def is_available(self, block_root: bytes, block) -> bool:
+        """The fork-choice data-availability predicate: for every blob the
+        block's graffiti marker commits to, some verified candidate is
+        held whose commitment set matches the marker digest. Blocks
+        without the marker (no blobs, or a non-DAS proposer) gate
+        vacuously."""
+        meta = parse_das_graffiti(bytes(block.body.graffiti))
+        if meta is None:
+            return True
+        n_blobs, digest = meta
+        root = bytes(block_root)
+        if root in self._resolved:
+            return True
+        candidates = [list(self.sidecars.get((root, i), {}).values())
+                      for i in range(n_blobs)]
+        if any(not held for held in candidates):
+            return False
+        # honest traffic has exactly one candidate per index; a Byzantine
+        # flood is bounded rather than searched exhaustively
+        for pick in itertools.islice(itertools.product(*candidates), 256):
+            if commitments_digest(
+                    [bytes(sc.commitment) for sc in pick]) == digest:
+                self._resolved[root] = list(pick)
+                return True
+        return False
+
+    def sidecars_for_block(self, block_root: bytes) -> list[BlobSidecar]:
+        root = bytes(block_root)
+        if root in self._resolved:
+            return list(self._resolved[root])
+        out = []
+        i = 0
+        while (root, i) in self.sidecars:
+            held = self.sidecars[(root, i)]
+            out.append(next(iter(held.values())))
+            i += 1
+        return out

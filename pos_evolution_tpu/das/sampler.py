@@ -1,0 +1,77 @@
+"""Sampling-client population: 10^5-10^6 DAS light clients as arrays.
+
+A DAS client's behaviour is tiny — pick a few (blob, cell) coordinates
+per block, request them, verify the proofs — so the population is
+modelled the way the validator registry is: struct-of-arrays, no
+per-client Python objects. Cell selection is a seeded stateless hash of
+(seed, client_id, block_root), batched through ``ssz.hash.sha256_batch``
+(one digest per client per block), so any run — or any single client —
+is exactly reproducible, the ``sim/faults.stateless_unit`` posture at
+population scale.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pos_evolution_tpu.config import cfg
+from pos_evolution_tpu.ssz.hash import sha256_batch
+
+__all__ = ["SamplingClientPopulation"]
+
+# bytes of digest consumed per sample (u16 cell draw + u8 blob draw)
+_BYTES_PER_SAMPLE = 3
+_SAMPLES_PER_DIGEST = 32 // _BYTES_PER_SAMPLE  # 10
+
+
+class SamplingClientPopulation:
+    """N sampling clients with seeded per-client cell selection."""
+
+    def __init__(self, n_clients: int, samples_per_client: int | None = None,
+                 seed: int = 0):
+        self.n = int(n_clients)
+        self.samples = (cfg().das_samples_per_client
+                        if samples_per_client is None
+                        else int(samples_per_client))
+        self.seed = int(seed)
+        # per-client verdict bookkeeping across served blocks
+        self.blocks_sampled = 0
+        self.samples_drawn = 0
+
+    def _digests(self, block_root: bytes, round_: int) -> np.ndarray:
+        """(n, 32) per-client digests for one selection round."""
+        msgs = np.zeros((self.n, 49), dtype=np.uint8)
+        msgs[:, :8] = np.frombuffer(self.seed.to_bytes(8, "little"),
+                                    dtype=np.uint8)
+        msgs[:, 8:16] = np.arange(self.n, dtype="<u8").view(
+            np.uint8).reshape(self.n, 8)
+        msgs[:, 16:48] = np.frombuffer(bytes(block_root), dtype=np.uint8)
+        msgs[:, 48] = round_ & 0xFF
+        return sha256_batch(msgs)
+
+    def select_cells(self, block_root: bytes, n_blobs: int,
+                     n_cells: int) -> tuple[np.ndarray, np.ndarray]:
+        """Seeded (blob_ids, cell_ids), each (n_clients, samples_per_client).
+
+        One digest serves up to 10 samples; larger sample counts draw
+        further digests with a round counter. The modulo draw is biased by
+        < 2^-8 for power-of-two grids (n_cells divides 65536), i.e. exact
+        for every valid config.
+        """
+        s = self.samples
+        blob_ids = np.zeros((self.n, s), dtype=np.int64)
+        cell_ids = np.zeros((self.n, s), dtype=np.int64)
+        for j in range(s):
+            round_, slot_in = divmod(j, _SAMPLES_PER_DIGEST)
+            if slot_in == 0:
+                digests = self._digests(block_root, round_)
+            b = digests[:, slot_in * 3:slot_in * 3 + 3].astype(np.int64)
+            cell_ids[:, j] = (b[:, 0] | (b[:, 1] << 8)) % n_cells
+            blob_ids[:, j] = b[:, 2] % max(n_blobs, 1)
+        self.blocks_sampled += 1
+        self.samples_drawn += self.n * s
+        return blob_ids, cell_ids
+
+    def describe(self) -> dict:
+        return {"kind": "das_population", "clients": self.n,
+                "samples_per_client": self.samples, "seed": self.seed}

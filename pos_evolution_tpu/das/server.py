@@ -1,0 +1,216 @@
+"""DAS serving layer: request coalescing, LRU proof caches, latency metrics.
+
+The full-node side of serving 10^5+ sampling clients. The pipeline per
+served block:
+
+1. the population draws its seeded (blob, cell) coordinates — arrays,
+   never per-client objects (das/sampler.py);
+2. requests are **coalesced**: 10^5 clients x 8 samples collapse onto at
+   most ``n_blobs x 2k`` unique cells, so proof building and
+   verification cost scales with the grid, not the crowd;
+3. unique cells are answered from an **LRU proof-path cache** (hot cells
+   of recent blocks stay resident; misses batch-build branches off one
+   shared leaf tree per blob);
+4. the coalesced batch runs the ``ExecutionBackend`` sample-verification
+   kernel (``ops/das_verify.py``) once, and verdicts fan back out to
+   clients by the coalescing inverse index.
+
+The same LRU machinery caches **best light-client updates** by head root
+(``best_update``), so the per-slot ``build_update`` proof construction
+in the driver's light-client serving runs once per distinct head instead
+of once per slot.
+
+Per-request p50/p95 serving latency and cache hit/miss counts land on
+the ``MetricsRegistry``; the driver emits one ``das_serve`` event per
+served block, which ``scripts/run_report.py`` folds into its
+"DAS serving" section.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from collections import OrderedDict
+
+import numpy as np
+
+from pos_evolution_tpu.config import cfg
+from pos_evolution_tpu.das.commitment import CellCommitmentScheme
+from pos_evolution_tpu.ops.das_verify import DasSampleBatch, verify_das_samples
+
+__all__ = ["LRUCache", "DasServer"]
+
+_MISS = object()
+
+
+class LRUCache:
+    """Minimal ordered-dict LRU with hit/miss counters (no extra deps)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self._d: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        v = self._d.get(key, _MISS)
+        if v is _MISS:
+            self.misses += 1
+            return _MISS
+        self._d.move_to_end(key)
+        self.hits += 1
+        return v
+
+    def put(self, key, value) -> None:
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+class DasServer:
+    """Serves coalesced DAS samples (and cached best-updates) for one node."""
+
+    def __init__(self, scheme: CellCommitmentScheme, registry=None,
+                 proof_cache: int = 4096, update_cache: int = 64):
+        self.scheme = scheme
+        self.registry = registry
+        self.proof_cache = LRUCache(proof_cache)
+        self.update_cache = LRUCache(update_cache)
+        self.served_blocks = 0
+        self.samples_served = 0
+
+    # -- light-client best-update caching --------------------------------------
+
+    def best_update(self, store, head_root: bytes, archive=None):
+        """``lightclient.server.build_update`` memoized by head root —
+        proofs for one head are built once however many slots (or
+        clients) ask for it."""
+        key = bytes(head_root)
+        cached = self.update_cache.get(key)
+        if cached is not _MISS:
+            self._count("das_update_cache_hits_total",
+                        "best-update LRU hits")
+            return cached
+        from pos_evolution_tpu.lightclient.server import build_update
+        update = build_update(store, head_root, archive=archive)
+        self.update_cache.put(key, update)
+        self._count("das_update_cache_misses_total",
+                    "best-update LRU misses (built fresh)")
+        return update
+
+    # -- sample serving --------------------------------------------------------
+
+    def _count(self, name: str, help_: str, n: int = 1) -> None:
+        if self.registry is not None:
+            self.registry.counter(name, help_).inc(n)
+
+    def serve_samples(self, block_root: bytes, sidecars: list,
+                      population) -> dict:
+        """One block's sampling round for the whole population. Returns
+        the summary dict the driver emits as a ``das_serve`` event."""
+        c = cfg()
+        n_cells = 2 * c.das_cells_per_blob
+        n_blobs = len(sidecars)
+        assert n_blobs > 0, "serve_samples needs at least one sidecar"
+        blob_ids, cell_ids = population.select_cells(
+            bytes(block_root), n_blobs, n_cells)
+        flat = (blob_ids * n_cells + cell_ids).reshape(-1)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        u = uniq.shape[0]
+
+        depth = self.scheme.depth_for(n_cells)
+        cells = np.zeros((u, c.das_cell_bytes), dtype=np.uint8)
+        branches = np.zeros((u, depth, 32), dtype=np.uint8)
+        indices = np.zeros(u, dtype=np.int64)
+        commitments = np.zeros((u, 32), dtype=np.uint8)
+        latency = np.zeros(u, dtype=np.float64)
+
+        # phase 1: cache lookups (individually timed — they ARE the fast path)
+        miss_by_blob: dict[int, list[int]] = {}
+        for j, key_flat in enumerate(uniq):
+            blob, cell = int(key_flat) // n_cells, int(key_flat) % n_cells
+            indices[j] = cell
+            commitments[j] = np.frombuffer(
+                bytes(sidecars[blob].commitment), dtype=np.uint8)
+            t0 = _time.perf_counter()
+            hit = self.proof_cache.get((bytes(block_root), blob, cell))
+            latency[j] = _time.perf_counter() - t0
+            if hit is _MISS:
+                miss_by_blob.setdefault(blob, []).append(j)
+            else:
+                cells[j], branches[j] = hit
+
+        # phase 2: batch-build missing branches, one shared leaf tree per
+        # blob (a miss costs amortized O(log n_cells), not a tree rebuild)
+        for blob, slots in miss_by_blob.items():
+            t0 = _time.perf_counter()
+            grid = np.ascontiguousarray(sidecars[blob].cells, dtype=np.uint8)
+            want = [int(indices[j]) for j in slots]
+            _leaves, built = self.scheme.branches(grid, want)
+            for j, cell, branch in zip(slots, want, built):
+                cells[j] = grid[cell]
+                branches[j] = branch
+                self.proof_cache.put((bytes(block_root), blob, cell),
+                                     (grid[cell].copy(), branch.copy()))
+            per = (_time.perf_counter() - t0) / len(slots)
+            for j in slots:
+                latency[j] += per
+
+        # phase 3: ONE backend verification call for the coalesced batch
+        t0 = _time.perf_counter()
+        result = verify_das_samples(DasSampleBatch(
+            cells=cells, branches=branches, indices=indices,
+            commitments=commitments))
+        verify_s = _time.perf_counter() - t0
+        latency += verify_s / u
+
+        ok_flat = result["ok"][inverse].reshape(blob_ids.shape)
+        clients_ok = int(ok_flat.all(axis=1).sum())
+        n_samples = int(flat.shape[0])
+        failed = int((~result["ok"]).sum())
+
+        self.served_blocks += 1
+        self.samples_served += n_samples
+        cache_hits = u - sum(len(s) for s in miss_by_blob.values())
+        self._count("das_samples_total",
+                    "client cell samples served (pre-coalescing)", n_samples)
+        self._count("das_unique_requests_total",
+                    "coalesced unique (blob, cell) fetches", u)
+        self._count("das_proof_cache_hits_total",
+                    "proof-path LRU hits", cache_hits)
+        self._count("das_proof_cache_misses_total",
+                    "proof-path LRU misses", u - cache_hits)
+        if failed:
+            self._count("das_sample_verify_failures_total",
+                        "samples whose branch failed verification", failed)
+        if self.registry is not None:
+            hist = self.registry.histogram(
+                "das_request_seconds",
+                "per coalesced request serving latency")
+            for v in latency:
+                hist.observe(float(v))
+
+        return {
+            "clients": int(blob_ids.shape[0]),
+            "samples": n_samples,
+            "unique_requests": int(u),
+            "coalescing": round(n_samples / u, 2),
+            "blobs": n_blobs,
+            "cache_hits": int(cache_hits),
+            "cache_misses": int(u - cache_hits),
+            "cache_hit_rate": round(self.proof_cache.hit_rate, 4),
+            "verified": int(result["ok"].sum()),
+            "failed": failed,
+            "clients_all_ok": clients_ok,
+            "p50_ms": round(float(np.percentile(latency, 50)) * 1e3, 4),
+            "p95_ms": round(float(np.percentile(latency, 95)) * 1e3, 4),
+            "verify_ms": round(verify_s * 1e3, 4),
+        }

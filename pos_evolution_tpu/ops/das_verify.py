@@ -1,0 +1,213 @@
+"""Batched DAS sample verification + erasure-reconstruction check.
+
+A sampling client's unit of work is one (cell, branch, commitment)
+triple: hash the cell to its leaf, walk the branch, compare against the
+blob's commitment. This module runs *whole batches* of such samples —
+many clients x many cells at once — through either backend:
+
+- **host path**: ``sha256_batch`` leaf hashing + the same per-level
+  select/hash merkle walk as ``ops/sync_verify.merkle_roots_host``
+  (kept jax-free here so the numpy backend never imports jax);
+- **device path**: cells padded to SHA-256 word blocks on the host, leaf
+  digests computed by ``ops/sha256.sha256_words`` (one VPU lane per
+  cell), then the jitted ``lax.scan`` merkle walk from
+  ``ops/sync_verify`` — the batched Merkle/hash kernel shape of the MTU
+  tree-unit paper (arxiv 2507.16793).
+
+The 50%-reconstruction check (``reconstruct_check``) is the verifier's
+side of the erasure code: interpolate the data cells from any >=k of 2k
+present cells and confirm every present cell lies on the degree-<k
+polynomial — a single corrupted cell flips the verdict. GF(2^8)
+arithmetic is log/exp gathers + XOR on both backends.
+
+Both entry points dispatch through the ``ExecutionBackend``
+(``das_verify`` / ``das_reconstruct``); tests pin the two paths
+bit-identical on randomized (blob, sample, corruption) inputs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+import numpy as np
+
+from pos_evolution_tpu.das.erasure import (
+    GF_EXP,
+    GF_LOG,
+    extension_matrix,
+    lagrange_matrix,
+    reconstruct_blob,
+)
+from pos_evolution_tpu.ssz.hash import sha256_batch, sha256_pairs
+
+__all__ = [
+    "DasSampleBatch",
+    "verify_das_samples",
+    "verify_samples_host",
+    "verify_samples_device",
+    "reconstruct_check",
+    "reconstruct_check_host",
+    "reconstruct_check_device",
+]
+
+
+@dataclass
+class DasSampleBatch:
+    """Dense form of S coalesced samples (array-level only, so one batch
+    feeds either backend — the ``SyncUpdateBatch`` pattern)."""
+
+    cells: np.ndarray        # (S, cell_bytes) u8 — sampled cell payloads
+    branches: np.ndarray     # (S, D, 32) u8     — per-sample merkle branches
+    indices: np.ndarray      # (S,) i64          — cell index in the grid
+    commitments: np.ndarray  # (S, 32) u8        — expected grid commitments
+
+    @property
+    def size(self) -> int:
+        return self.cells.shape[0]
+
+
+def _index_bits(index: np.ndarray, depth: int) -> np.ndarray:
+    idx = np.asarray(index, dtype=np.int64)
+    return ((idx[:, None] >> np.arange(depth, dtype=np.int64)[None, :]) & 1
+            ).astype(bool)
+
+
+def _result(ok, roots, leaves) -> dict:
+    return {"ok": np.asarray(ok, dtype=bool),
+            "roots": np.asarray(roots, dtype=np.uint8),
+            "leaves": np.asarray(leaves, dtype=np.uint8)}
+
+
+# --- host path ----------------------------------------------------------------
+
+def verify_samples_host(batch: DasSampleBatch) -> dict:
+    """NumPy reference path (the oracle the device path must match)."""
+    leaves = sha256_batch(np.ascontiguousarray(batch.cells, dtype=np.uint8))
+    value = leaves
+    branches = np.asarray(batch.branches, dtype=np.uint8)
+    bits = _index_bits(batch.indices, branches.shape[1])
+    for d in range(branches.shape[1]):
+        sib = branches[:, d]
+        right_child = bits[:, d][:, None]
+        left = np.where(right_child, sib, value)
+        right = np.where(right_child, value, sib)
+        value = sha256_pairs(np.ascontiguousarray(left),
+                             np.ascontiguousarray(right))
+    ok = (value == np.asarray(batch.commitments, dtype=np.uint8)).all(axis=1)
+    return _result(ok, value, leaves)
+
+
+# --- device path --------------------------------------------------------------
+
+def verify_samples_device(batch: DasSampleBatch) -> dict:
+    """JAX/XLA path: leaf hashing + branch walk stay on device; only the
+    padded word arrays move host->device once per batch."""
+    import jax.numpy as jnp
+
+    from pos_evolution_tpu.ops.aggregation import messages_to_words
+    from pos_evolution_tpu.ops.sha256 import sha256_words
+    from pos_evolution_tpu.ops.sync_verify import (
+        _merkle_walk_device,
+        _words_to_rows,
+    )
+    from pos_evolution_tpu.ssz.hash import _pad_messages
+
+    s = batch.size
+    depth = batch.branches.shape[1]
+    cell_words = _pad_messages(
+        np.ascontiguousarray(batch.cells, dtype=np.uint8))
+    leaf_words = sha256_words(jnp.asarray(cell_words))
+    branch_words = messages_to_words(np.ascontiguousarray(
+        batch.branches, dtype=np.uint8).reshape(s * depth, 32)
+    ).reshape(s, depth, 8)
+    roots = _merkle_walk_device(leaf_words, jnp.asarray(branch_words),
+                                jnp.asarray(_index_bits(batch.indices, depth)))
+    root_rows = _words_to_rows(roots)
+    leaf_rows = _words_to_rows(leaf_words)
+    ok = (root_rows == np.asarray(batch.commitments, dtype=np.uint8)
+          ).all(axis=1)
+    return _result(ok, root_rows, leaf_rows)
+
+
+# --- erasure-reconstruction check ---------------------------------------------
+
+def reconstruct_check_host(cells: np.ndarray, present: np.ndarray
+                           ) -> tuple[bool, np.ndarray]:
+    """(consistent, data_cells) from any >=50% of the extended grid."""
+    data, _full, ok = reconstruct_blob(cells, present)
+    return ok, data
+
+
+@lru_cache(maxsize=None)
+def _reconstruct_kernel():
+    """Module-singleton jitted reconstruction kernel: built once per
+    process, retraced only per (k, cell_bytes) geometry — a fresh
+    ``@jax.jit`` closure per call would recompile every invocation."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _run(interp_m, ext_m, sel_cells, grid, avail_mask):
+        def gf_matmul(a, b):
+            log_a = jnp.asarray(GF_LOG)[a]
+            log_b = jnp.asarray(GF_LOG)[b]
+            acc = jnp.zeros((a.shape[0], b.shape[1]), dtype=jnp.uint8)
+            for t in range(a.shape[1]):  # k is static: unrolls under jit
+                prod = jnp.asarray(GF_EXP)[log_a[:, t][:, None]
+                                           + log_b[t][None, :]]
+                prod = jnp.where((a[:, t][:, None] == 0)
+                                 | (b[t][None, :] == 0),
+                                 jnp.uint8(0), prod)
+                acc = acc ^ prod
+            return acc
+
+        data = gf_matmul(interp_m, sel_cells)
+        full = jnp.concatenate([data, gf_matmul(ext_m, data)], axis=0)
+        ok = jnp.all(jnp.where(avail_mask[:, None], full == grid, True))
+        return ok, data
+
+    return _run
+
+
+def reconstruct_check_device(cells: np.ndarray, present: np.ndarray
+                             ) -> tuple[bool, np.ndarray]:
+    """Device twin: the GF(2^8) interpolation + re-extension as uint8
+    log/exp gathers and XOR reduction under jit (bit-identical to the
+    host path — integer table arithmetic has no rounding)."""
+    import jax.numpy as jnp
+
+    cells = np.ascontiguousarray(cells, dtype=np.uint8)
+    present = np.asarray(present, dtype=bool)
+    k = cells.shape[0] // 2
+    avail = np.nonzero(present)[0]
+    if avail.size < k:
+        raise ValueError(
+            f"reconstruction needs >= {k} of {2 * k} cells, got {avail.size}")
+    sel = avail[:k]
+    interp = lagrange_matrix(tuple(int(x) for x in sel), tuple(range(k)))
+    ext = extension_matrix(k)
+
+    ok, data = _reconstruct_kernel()(
+        jnp.asarray(interp), jnp.asarray(ext),
+        jnp.asarray(cells[sel]), jnp.asarray(cells),
+        jnp.asarray(present))
+    return bool(ok), np.asarray(data, dtype=np.uint8)
+
+
+# --- backend dispatch ---------------------------------------------------------
+
+def verify_das_samples(batch: DasSampleBatch) -> dict:
+    """Verify a coalesced sample batch through the active backend."""
+    from pos_evolution_tpu.backend import get_backend
+    fn = getattr(get_backend(), "das_verify", None)
+    return verify_samples_host(batch) if fn is None else fn(batch)
+
+
+def reconstruct_check(cells: np.ndarray, present: np.ndarray
+                      ) -> tuple[bool, np.ndarray]:
+    """Erasure-consistency check through the active backend."""
+    from pos_evolution_tpu.backend import get_backend
+    fn = getattr(get_backend(), "das_reconstruct", None)
+    return reconstruct_check_host(cells, present) if fn is None \
+        else fn(cells, present)

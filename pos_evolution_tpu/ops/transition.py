@@ -232,6 +232,70 @@ def _sweep_fn():
             else dev["donate"])
 
 
+def prewarm_block_sweep(state, max_attestations: int | None = None) -> int:
+    """Compile the fused block sweep for every padded shape a run over
+    ``state``'s registry can produce, before the first slot runs.
+
+    The sweep pads its batch to power-of-two (attestations x
+    committee-lane) shapes (``apply_attestation_rows_device``), so a new
+    shape appearing mid-run — blocks carrying 17 attestations for the
+    first time in epoch 2 — triggers an XLA compile exactly where the
+    driver is latency-sensitive (the ROADMAP item 2 ``get_head`` tail
+    absorbed these as compile-storm spikes). Warming the full pow2
+    lattice up front is a handful of compiles (log2(max_attestations) x
+    |committee-lane shapes|) and pins ``jax_backend_compiles_total`` flat
+    for the rest of the run (tests/test_das.py).
+
+    Executes the jitted sweep on zero-filled inputs (AOT ``lower().
+    compile()`` would not seed the jit dispatch cache) and returns the
+    number of shapes warmed.
+    """
+    from pos_evolution_tpu.config import cfg as _cfg
+    from pos_evolution_tpu.specs.helpers import (
+        active_validator_mask,
+        get_committee_count_per_slot,
+        get_current_epoch,
+    )
+
+    c = _cfg()
+    n = len(state.validators)
+    if max_attestations is None:
+        max_attestations = c.max_attestations
+    epoch = get_current_epoch(state)
+    count = get_committee_count_per_slot(state, epoch)
+    active = int(active_validator_mask(state, epoch).sum())
+    per_slot = max(active // c.slots_per_epoch, 1)
+    # committees split per-slot actives into count groups of size s or
+    # s+1 — but the sweep pads to the pow2 of the PER-AGGREGATE attesting
+    # count, so partial aggregates (FaultPlan drops, adversarial
+    # withholding) land on every pow2 lane below the full committee too
+    lane_hi = _next_pow2(per_slot // count + 1)
+    lanes = set()
+    lane = 1
+    while lane <= lane_hi:
+        lanes.add(lane)
+        lane *= 2
+
+    dev = _device()
+    jnp = dev["jnp"]
+    fn = _sweep_fn()
+    warmed = 0
+    a = 1
+    while a <= _next_pow2(max_attestations):
+        for lane in sorted(lanes):
+            # fresh carries per call: off-CPU the sweep donates them
+            fn(jnp.zeros(n, dtype=jnp.int64), jnp.zeros(n, dtype=jnp.uint8),
+               jnp.zeros(n, dtype=jnp.uint8), jnp.zeros(n, dtype=jnp.int64),
+               jnp.int64(0), jnp.int32(0),
+               jnp.zeros((a, lane), dtype=jnp.int32),
+               jnp.zeros((a, lane), dtype=bool),
+               jnp.zeros(a, dtype=bool),
+               jnp.zeros(a, dtype=jnp.uint8))
+            warmed += 1
+        a *= 2
+    return warmed
+
+
 class _Session:
     """Device residency across consecutive blocks (one per process).
 

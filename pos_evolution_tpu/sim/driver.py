@@ -66,7 +66,8 @@ def _span_id(kind: str, slot: int, src: int, msg_id: int) -> str:
 
 
 _HANDLER_OF = {"block": "on_block", "attestation": "on_attestation",
-               "slashing": "on_attester_slashing"}
+               "slashing": "on_attester_slashing",
+               "blob": "on_blob_sidecar"}
 
 
 class ViewGroup:
@@ -109,6 +110,10 @@ class ViewGroup:
         # Device-resident dense mirror (ops/resident.py) when the sim runs
         # accelerated fork choice; handlers below forward their deltas.
         self.resident = resident
+        # DAS availability view (das/engine.BlobStore) when the sim runs a
+        # blob workload; also attached to ``store.blob_store`` so on_block
+        # gates imports on verified sidecars (DESIGN.md §15).
+        self.blob_store = None
 
     def enqueue(self, time: float, kind: str, payload,
                 span: str | None = None) -> None:
@@ -223,6 +228,17 @@ class ViewGroup:
                                                   msg.payload)
                         if self.resident is not None:
                             self.resident.note_slashing(evil)
+                elif msg.kind == "blob":
+                    # sidecar gossip: verification (commitment recompute +
+                    # erasure consistency) happens inside the blob store;
+                    # a failed sidecar is a reject, not an exception
+                    with track("on_blob_sidecar"):
+                        accepted = (self.blob_store is not None
+                                    and self.blob_store.on_sidecar(
+                                        msg.payload))
+                    if not accepted:
+                        status = "reject"
+                        reason = "sidecar failed verification"
             except AssertionError as e:
                 # Invalid-at-this-time messages are dropped (the reference
                 # permits re-queueing, pos-evolution.md:967-968; the driver
@@ -253,7 +269,8 @@ class Simulation:
 
     def __init__(self, n_validators: int, schedule: Schedule | None = None,
                  genesis_time: int = 0, accelerated_forkchoice: bool = False,
-                 telemetry=None, profile=None, adversaries=(), monitors=()):
+                 telemetry=None, profile=None, adversaries=(), monitors=(),
+                 das=None, prewarm: bool = False, compile_cache=None):
         self.cfg = cfg()
         self.schedule = schedule or honest_schedule(n_validators)
         self.n_validators = n_validators
@@ -286,9 +303,53 @@ class Simulation:
         if self.schedule.faults is not None:
             self.schedule.faults.sink = (telemetry.bus
                                          if telemetry is not None else None)
+        # Compile hygiene (ROADMAP item 2 remainder): ``compile_cache``
+        # points jax's persistent compilation cache at a directory so
+        # repeat runs skip XLA backend compiles entirely; ``prewarm``
+        # AOT-warms every padded attestation-batch shape of the fused
+        # block sweep at init, so the epoch 2-3 get_head tail no longer
+        # absorbs compile storms as new shapes appear mid-run (pinned via
+        # the jax_backend_compiles_total counter in tests/test_das.py).
+        if compile_cache is not None:
+            import os as _os2
+
+            import jax as _jax
+            _jax.config.update("jax_compilation_cache_dir",
+                               _os2.fspath(compile_cache))
+            try:
+                _jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", -1)
+                _jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+            except Exception:
+                pass  # knob names drift across jax versions; dir is enough
         state, anchor = make_genesis(n_validators, genesis_time)
         self.genesis_state = state
         self.anchor_root = hash_tree_root(anchor)
+        if prewarm:
+            from pos_evolution_tpu.backend import get_backend
+            if getattr(get_backend(), "name", "") == "jax":
+                from pos_evolution_tpu.ops.transition import (
+                    prewarm_block_sweep,
+                )
+                prewarm_block_sweep(state)
+        # DAS blob workload (das/, DESIGN.md §15): ``das`` is a
+        # das.engine.BlobEngine (or True for the default one). Proposals
+        # then carry blob sidecars, every view group runs an availability
+        # store gating on_block imports, and ``attach_das_clients`` hangs
+        # a sampling population off the serving group. Like the schedule,
+        # the engine is passed again to ``resume`` (sidecar payloads are
+        # seeded pure functions of the chain, so a resumed run
+        # regenerates them bit-identically).
+        if das is True:
+            from pos_evolution_tpu.das import BlobEngine
+            das = BlobEngine()
+        self.das = das
+        self.blob_archive: dict[bytes, list] = {}
+        self.das_server = None
+        self.das_population = None
+        self._das_group = 0
+        self._das_window = 2
         # One PoW-chain view per Simulation (shared by its groups — the PoW
         # chain is objective): merge-transition state never leaks between
         # Simulation instances in the same process.
@@ -301,8 +362,17 @@ class Simulation:
             if accelerated_forkchoice:
                 from pos_evolution_tpu.ops.resident import ResidentForkChoice
                 resident = ResidentForkChoice(store)
-            return ViewGroup(g, store, self.schedule.members(g), resident,
-                             telemetry=telemetry)
+            group = ViewGroup(g, store, self.schedule.members(g), resident,
+                              telemetry=telemetry)
+            if self.das is not None:
+                from pos_evolution_tpu.das import BlobStore
+                group.blob_store = BlobStore(
+                    self.das,
+                    registry=(telemetry.registry if telemetry is not None
+                              else None),
+                    group=g)
+                store.blob_store = group.blob_store
+            return group
 
         self.groups = [_make_group(g) for g in range(self.schedule.n_groups)]
         self.slot = 0
@@ -436,6 +506,7 @@ class Simulation:
         rejoiner catches up from its anchor the same way. Deterministic
         (the archive is part of the checkpointed state), so resume
         replays it exactly."""
+        self._resolve_blobs(dst, signed_block)
         missing = []
         parent = bytes(signed_block.message.parent_root)
         while parent not in dst.store.blocks:
@@ -444,7 +515,33 @@ class Simulation:
                 return  # unconnectable (pre-anchor history): let on_block fail
             missing.append(sb)
             parent = bytes(sb.message.parent_root)
-        dst._process_block_chain(list(reversed(missing)))
+        chain = list(reversed(missing))
+        for sb in chain:
+            self._resolve_blobs(dst, sb)
+        dst._process_block_chain(chain)
+
+    def _resolve_blobs(self, group: ViewGroup, signed_block) -> None:
+        """Blob-by-root backfill (the sidecar req/resp of real DAS nets):
+        when a block is about to import but its committed sidecars never
+        arrived (FaultPlan drops, crash outages), pull them from the
+        archive and run them through the group's verifying store — a
+        dropped sidecar becomes a delayed one exactly like a dropped
+        block, keeping faults transient."""
+        if group.blob_store is None:
+            return
+        block = signed_block.message
+        root = cached_root(block)
+        if group.blob_store.is_available(root, block):
+            return
+        backfilled = 0
+        for sc in self.blob_archive.get(root, ()):
+            group.blob_store.on_sidecar(sc)
+            backfilled += 1
+        if backfilled and self.telemetry is not None:
+            self.telemetry.registry.counter(
+                "das_blob_backfills_total",
+                "sidecars pulled by req/resp at import time",
+            ).inc(backfilled, group=group.id)
 
     # -- fault layer (sim/faults.py) -------------------------------------------
 
@@ -587,18 +684,31 @@ class Simulation:
                                            head_state=head_state)
             sync_agg = self._make_sync_aggregate(group, slot, head,
                                                  head_state, round_index)
+            # DAS: blob payloads are committed at build time through the
+            # graffiti marker (state_root covers graffiti), so grids and
+            # commitments exist BEFORE the block does.
+            graffiti = b"\x00" * 32
+            das_grids = das_commitments = None
+            if self.das is not None:
+                das_grids, das_commitments, graffiti = \
+                    self.das.build_for(slot, head)
             try:
                 sb = build_block(group.store.block_states[head], slot,
-                                 attestations=atts, sync_aggregate=sync_agg)
+                                 attestations=atts, sync_aggregate=sync_agg,
+                                 graffiti=graffiti)
             except AssertionError:
                 # Rare fault-era residue: an attestation that passed the
                 # cheap packing filter is still unincludable (e.g. a
                 # committee reshuffled across an epoch-crossing fork).
                 # A real proposer drops the op, not the proposal.
                 sb = build_block(group.store.block_states[head], slot,
-                                 attestations=[], sync_aggregate=sync_agg)
+                                 attestations=[], sync_aggregate=sync_agg,
+                                 graffiti=graffiti)
             block_root = cached_root(sb.message)
             self.block_archive[block_root] = sb
+            if das_grids:
+                self.blob_archive[block_root] = self.das.sidecars_for(
+                    sb, block_root, das_grids, das_commitments)
             self._observe("block", sb)
             if self.telemetry is not None:
                 # lifecycle root span: propose -> per-group gossip edges
@@ -610,6 +720,15 @@ class Simulation:
                     n_attestations=len(atts))
             for dst in self.groups:
                 delay = self.schedule.block_delay(int(proposer), slot, dst.id)
+                # sidecars ride the block's gossip timing but their own
+                # fault decisions (a dropped sidecar with a delivered
+                # block leaves the block unimportable until the req/resp
+                # backfill pulls the blobs) — enqueued BEFORE the block so
+                # the in-order case verifies availability pre-import
+                for sc in self.blob_archive.get(block_root, ()):
+                    self._send(dst, t0, delay, "blob", sc, slot,
+                               src=int(proposer),
+                               msg_id=int(sc.blob_index))
                 self._send(dst, t0, delay, "block", sb, slot,
                            src=int(proposer), msg_id=0)
 
@@ -746,6 +865,7 @@ class Simulation:
         self._record_metrics(slot)
         self._run_monitors(slot)
         self._serve_light_clients(slot)
+        self._serve_das(slot)
         self.slot += 1
 
     def run_until_slot(self, slot: int) -> None:
@@ -903,9 +1023,15 @@ class Simulation:
         head = self._get_head(group)
         update = None
         if not group.crashed:
-            from pos_evolution_tpu.lightclient import build_update
-            update = build_update(group.store, head,
-                                  archive=self.block_archive)
+            if self.das_server is not None:
+                # best-update LRU (das/server.py): one proof build per
+                # distinct head, however many slots serve it
+                update = self.das_server.best_update(
+                    group.store, head, archive=self.block_archive)
+            else:
+                from pos_evolution_tpu.lightclient import build_update
+                update = build_update(group.store, head,
+                                      archive=self.block_archive)
         full_head_slot = int(group.store.blocks[head].slot)
         full_finalized_epoch = int(group.store.finalized_checkpoint.epoch)
         plan = self.schedule.faults
@@ -922,6 +1048,81 @@ class Simulation:
             if self.telemetry is not None:
                 self.telemetry.bus.emit("light_client_lag", node=node.id,
                                         **record)
+
+    # -- DAS sampling clients (das/, DESIGN.md §15) ----------------------------
+
+    def attach_das_clients(self, n_clients: int,
+                           samples_per_client: int | None = None,
+                           group: int = 0, seed: int = 0,
+                           proof_cache: int = 4096, update_cache: int = 64,
+                           window: int = 2):
+        """Attach a vectorized sampling-client population (10^5-10^6
+        clients as arrays, das/sampler.py) served once per slot from
+        ``group``'s head through a coalescing ``DasServer``. Clients
+        sample the newest ``window`` blocks of the canonical chain each
+        slot (the availability-window retry behaviour of real DAS nets)
+        — re-served blocks answer from the proof-path LRU, which is what
+        makes the cache-hit metrics meaningful. Also swaps the
+        light-client update serving onto the server's best-update LRU.
+        Not simulation state: a resumed run re-attaches."""
+        assert self.das is not None, \
+            "attach_das_clients requires Simulation(das=...)"
+        from pos_evolution_tpu.das import DasServer, SamplingClientPopulation
+        registry = (self.telemetry.registry if self.telemetry is not None
+                    else None)
+        self._das_group = group
+        self._das_window = max(int(window), 1)
+        self.das_server = DasServer(self.das.scheme, registry=registry,
+                                    proof_cache=proof_cache,
+                                    update_cache=update_cache)
+        self.das_population = SamplingClientPopulation(
+            n_clients, samples_per_client, seed=seed)
+        if registry is not None:
+            registry.gauge("das_clients",
+                           "attached DAS sampling clients").set(n_clients)
+        if self.telemetry is not None:
+            self.telemetry.bus.emit("das_attach",
+                                    **self.das_population.describe(),
+                                    engine=self.das.describe())
+        return self.das_population
+
+    def _serve_das(self, slot: int) -> None:
+        """End-of-slot sampling round: the serving group's head block's
+        sidecars are sampled by the whole population through the
+        coalescing server; the summary lands on the bus as a
+        ``das_serve`` event (run_report.py's "DAS serving" section)."""
+        if self.das_population is None:
+            return
+        from pos_evolution_tpu.das.containers import parse_das_graffiti
+        group = self.groups[self._das_group]
+        if group.crashed:
+            return
+        # the newest ``window`` canonical blocks that carry blobs — the
+        # head freshly, its recent ancestors again (their cells answer
+        # from the proof-path LRU warmed by the previous slots)
+        targets = []
+        root = self._get_head(group)
+        while len(targets) < self._das_window and root in group.store.blocks:
+            block = group.store.blocks[root]
+            if parse_das_graffiti(bytes(block.body.graffiti)) is not None:
+                targets.append((root, block))
+            if int(block.slot) == 0:
+                break
+            root = bytes(block.parent_root)
+        for age, (root, block) in enumerate(targets):
+            n_blobs = parse_das_graffiti(bytes(block.body.graffiti))[0]
+            sidecars = (group.blob_store.sidecars_for_block(root)
+                        if group.blob_store is not None else [])
+            if len(sidecars) < n_blobs:
+                sidecars = self.blob_archive.get(root, [])
+            if len(sidecars) < n_blobs:
+                continue  # serving node itself lacks the data
+            summary = self.das_server.serve_samples(root, sidecars,
+                                                    self.das_population)
+            if self.telemetry is not None:
+                self.telemetry.bus.emit("das_serve", slot=slot, age=age,
+                                        block_root=root.hex()[:16],
+                                        **summary)
 
     def flush_light_clients(self) -> None:
         """Serve one off-chain finality update for the serving group's
@@ -971,7 +1172,8 @@ class Simulation:
 
     @classmethod
     def resume(cls, data: bytes, schedule: Schedule | None = None,
-               telemetry=None, adversaries=(), monitors=()) -> "Simulation":
+               telemetry=None, adversaries=(), monitors=(),
+               das=None) -> "Simulation":
         """Rebuild a checkpointed simulation mid-run. ``schedule`` must be
         the same delivery/fault policy the original run used (schedules
         hold callables, which do not serialize); None resumes an honest
@@ -984,10 +1186,13 @@ class Simulation:
         (``RandomByzantine``) replays exactly from any checkpoint slot;
         stateful strategies and monitors replay exactly from an
         episode-START checkpoint — the repro-bundle contract of
-        ``scripts/chaos_fuzz.py``."""
+        ``scripts/chaos_fuzz.py``. ``das`` re-attaches a BlobEngine: blob
+        payloads regenerate from the seed and each view's verified-sidecar
+        set replays, so availability gating resumes where it stopped."""
         from pos_evolution_tpu.utils.snapshot import load_simulation
         return load_simulation(data, schedule=schedule, telemetry=telemetry,
-                               adversaries=adversaries, monitors=monitors)
+                               adversaries=adversaries, monitors=monitors,
+                               das=das)
 
     # -- accessors --
     def store(self, group: int = 0) -> fc.Store:

@@ -62,6 +62,12 @@ class Store:
     # module-level default registry in specs.merge (Simulation installs a
     # fresh per-instance view so sims never share PoW state).
     pow_chain: object = None
+    # Data-availability view (das/engine.BlobStore): when attached, on_block
+    # refuses blocks whose committed blob sidecars this view has not
+    # verified — the DAS analogue of the merge payload gate. Like pow_chain
+    # it is a live per-view object, never serialized (the driver reattaches
+    # it on resume).
+    blob_store: object = None
 
 
 def get_forkchoice_store(anchor_state: BeaconState, anchor_block: BeaconBlock,
@@ -372,6 +378,15 @@ def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
     assert get_ancestor(store, parent_root, finalized_slot) \
         == bytes(store.finalized_checkpoint.root), "not a descendant of finalized"
 
+    # [DAS] availability gate (das/, DESIGN.md §15): a block whose graffiti
+    # commits to blob sidecars imports only once this view holds and has
+    # verified all of them — same shape as the merge payload gate below,
+    # and before the (expensive) state transition like the spec's
+    # is_data_available check.
+    if store.blob_store is not None:
+        assert store.blob_store.is_available(hash_tree_root(block), block), \
+            "blob data not available"
+
     # Full state transition on a copy (pos-evolution.md:1009).
     state = pre_state.copy()
     state_transition(state, signed_block, True)
@@ -457,6 +472,13 @@ def on_block_batch(store: Store, signed_blocks: list) -> None:
         block = sb.message
         fslot = compute_start_slot_at_epoch(int(store.finalized_checkpoint.epoch))
         assert int(block.slot) > fslot, "block at or before finalized slot"
+        if store.blob_store is not None:
+            # same per-block availability gate as on_block; a mid-run
+            # unavailable block keeps the committed prefix (prefix-commit
+            # contract) exactly like any other per-block reject
+            assert store.blob_store.is_available(hash_tree_root(block),
+                                                 block), \
+                "blob data not available"
         merge_flag[0] = is_merge_transition_block(pre_state, block.body)
 
     def commit(sb, post_state):

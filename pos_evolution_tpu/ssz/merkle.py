@@ -21,6 +21,9 @@ __all__ = [
     "mix_in_length",
     "is_valid_merkle_branch",
     "merkle_tree_branch",
+    "multiproof_helper_gindices",
+    "build_multiproof",
+    "verify_multiproof",
     "next_pow_of_two",
 ]
 
@@ -103,6 +106,101 @@ def is_valid_merkle_branch(leaf: bytes, branch, depth: int, index: int, root: by
         else:
             value = sha256(value + sibling)
     return value == bytes(root)
+
+
+# --- generalized-index multiproofs --------------------------------------------
+# The SSZ multiproof dialect: node 1 is the root, node ``g``'s children are
+# ``2g`` and ``2g+1``, leaf ``i`` of a depth-``d`` tree is ``2**d + i``. One
+# proof covers MANY leaves by shipping only the siblings off the union of
+# their root paths — the commitment-side analogue of the polynomial
+# multiproofs in arxiv 2604.16559 (das/commitment.py serves these for
+# batched DAS cell samples).
+
+
+def multiproof_helper_gindices(leaf_indices, depth: int) -> list[int]:
+    """Sibling generalized indices a multiproof over ``leaf_indices`` must
+    carry, sorted descending (deepest first — the canonical SSZ order)."""
+    on_path: set[int] = {1}
+    for i in leaf_indices:
+        g = (1 << depth) + int(i)
+        while g > 1:
+            on_path.add(g)
+            g >>= 1
+    helpers = {g ^ 1 for g in on_path if g > 1 and (g ^ 1) not in on_path}
+    return sorted(helpers, reverse=True)
+
+
+def _tree_levels(leaves: np.ndarray, depth: int) -> list[np.ndarray]:
+    """All levels of the padded tree, leaves first (virtual zero padding
+    stays virtual: out-of-range nodes read from ``ZERO_HASHES``)."""
+    layer = np.ascontiguousarray(leaves, dtype=np.uint8).reshape(-1, 32)
+    levels = [layer]
+    for level in range(depth):
+        if layer.shape[0] % 2 == 1:
+            layer = np.concatenate([layer, ZERO_HASHES[level][None, :]], axis=0)
+        layer = sha256_pairs(layer[0::2], layer[1::2])
+        levels.append(layer)
+    return levels
+
+
+def _node_value(levels: list[np.ndarray], gindex: int, depth: int) -> bytes:
+    level = depth - (gindex.bit_length() - 1)
+    idx = gindex - (1 << (gindex.bit_length() - 1))
+    layer = levels[level]
+    if idx < layer.shape[0]:
+        return layer[idx].tobytes()
+    return ZERO_HASHES[level].tobytes()
+
+
+def build_multiproof(leaves: np.ndarray, leaf_indices, depth: int) -> list[bytes]:
+    """One proof for all ``leaf_indices`` of a depth-``depth`` tree over
+    ``leaves``: the helper-sibling values in ``multiproof_helper_gindices``
+    order. Shared path prefixes are shipped once, so proving c cells costs
+    ~c*(depth - log2 c) siblings instead of c*depth."""
+    levels = _tree_levels(leaves, depth)
+    return [_node_value(levels, g, depth)
+            for g in multiproof_helper_gindices(leaf_indices, depth)]
+
+
+def verify_multiproof(leaf_values, leaf_indices, proof, depth: int,
+                      root: bytes) -> bool:
+    """Recompute the root from leaves + helper siblings; level-by-level so
+    each sweep is ONE batched ``sha256_pairs`` call (the MTU tree-unit
+    shape of arxiv 2507.16793) rather than per-node scalar hashing."""
+    leaf_indices = [int(i) for i in leaf_indices]
+    helpers = multiproof_helper_gindices(leaf_indices, depth)
+    if len(proof) != len(helpers) or len(leaf_values) != len(leaf_indices):
+        return False
+    # duplicate gindices must agree — a dict would silently keep only the
+    # LAST value, letting a corrupted (index, value) pair verify whenever
+    # the same index also appears with the honest value (samplers draw
+    # cells with replacement, so duplicates are normal inputs here)
+    objects: dict[int, bytes] = {}
+    for g, v in zip(
+            ((1 << depth) + i for i in leaf_indices), leaf_values):
+        if objects.setdefault(g, bytes(v)) != bytes(v):
+            return False
+    for g, v in zip(helpers, proof):
+        if objects.setdefault(g, bytes(v)) != bytes(v):
+            return False
+    for length in range(depth + 1, 1, -1):  # bit_length of gindices, deep->shallow
+        parents, lefts, rights = [], [], []
+        for g in [g for g in objects if g.bit_length() == length]:
+            p = g >> 1
+            if p in objects or p in parents:
+                continue
+            left, right = objects.get(p << 1), objects.get((p << 1) | 1)
+            if left is None or right is None:
+                return False  # malformed proof: a needed sibling is absent
+            parents.append(p)
+            lefts.append(left)
+            rights.append(right)
+        if parents:
+            la = np.frombuffer(b"".join(lefts), dtype=np.uint8).reshape(-1, 32)
+            ra = np.frombuffer(b"".join(rights), dtype=np.uint8).reshape(-1, 32)
+            for p, digest in zip(parents, sha256_pairs(la, ra)):
+                objects[p] = digest.tobytes()
+    return objects.get(1) == bytes(root)
 
 
 def merkle_tree_branch(leaves: np.ndarray, index: int, depth: int) -> list[bytes]:

@@ -156,6 +156,9 @@ def _payload_class(kind: str):
         AttesterSlashing,
         SignedBeaconBlock,
     )
+    if kind == "blob":
+        from pos_evolution_tpu.das.containers import BlobSidecar
+        return BlobSidecar
     return {"block": SignedBeaconBlock, "attestation": Attestation,
             "slashing": AttesterSlashing}[kind]
 
@@ -180,11 +183,23 @@ def save_simulation(sim) -> bytes:
         "accelerated": sim.accelerated_forkchoice,
         "metrics": sim.metrics,
         "archive_roots": [r.hex() for r in sim.block_archive],
+        # DAS (das/, DESIGN.md §15): sidecar CONTENT is a seeded pure
+        # function of the chain, so only availability bookkeeping is
+        # recorded — which (block, blob) pairs each view had verified —
+        # plus the engine parameters, so ``resume(das=engine)`` can
+        # refuse a mismatched engine loudly (a wrong seed/scheme would
+        # regenerate self-consistent sidecars whose commitments never
+        # match any block's graffiti: the chain stalls silently forever).
+        "das": (sim.das.describe()
+                if getattr(sim, "das", None) is not None else None),
         "groups": [{
             "id": g.id,
             "seq": g._seq,
             "queue": [[m.time, m.seq, m.kind] for m in sorted(g.queue)],
             "n_pool": len(g.pool),
+            "blob_keys": [[r.hex(), i] for (r, i) in
+                          getattr(g, "blob_store", None).sidecars]
+            if getattr(g, "blob_store", None) is not None else [],
             "block_atts": {r.hex(): [a.hex() for a in atts]
                            for r, atts in g.block_atts.items()},
             # resident mirror supervision state: a degradation must
@@ -213,7 +228,7 @@ def save_simulation(sim) -> bytes:
 
 
 def load_simulation(data: bytes, schedule=None, telemetry=None,
-                    adversaries=(), monitors=()):
+                    adversaries=(), monitors=(), das=None):
     """Rebuild a ``save_simulation`` checkpoint into a live Simulation.
     ``schedule`` must be the run's original Schedule (with its FaultPlan)
     for faithful replay; crash flags re-derive from the plan + slot.
@@ -299,7 +314,44 @@ def load_simulation(data: bytes, schedule=None, telemetry=None,
         sim.adversaries = list(adversaries)
         sim.monitors = list(monitors)
         sim._bind_adversaries_and_monitors()
+    if meta.get("das") and das is not None:
+        _restore_das(sim, meta, das)
     return sim
+
+
+def _restore_das(sim, meta: dict, das) -> None:
+    """Reattach a DAS engine to a resumed run: regenerate every archived
+    block's sidecars from the seed (bit-identical by construction),
+    rebuild per-group blob stores, and replay exactly the sidecars each
+    view had verified at checkpoint time. Queued ``blob`` messages were
+    serialized with the rest of the queue and deliver normally."""
+    from pos_evolution_tpu.das import BlobStore
+    from pos_evolution_tpu.das.containers import parse_das_graffiti
+    if das.describe() != meta["das"]:
+        raise ValueError(
+            f"resumed DAS engine {das.describe()} does not match the "
+            f"checkpointed engine {meta['das']} — regenerated sidecars "
+            f"would never satisfy the availability gate")
+    sim.das = das
+    sim.blob_archive = {}
+    for root, sb in sim.block_archive.items():
+        if parse_das_graffiti(bytes(sb.message.body.graffiti)) is not None:
+            sim.blob_archive[root] = das.regenerate(sb, root)
+    registry = (sim.telemetry.registry if sim.telemetry is not None else None)
+    for g, gm in zip(sim.groups, meta["groups"]):
+        g.blob_store = BlobStore(das, registry=registry, group=g.id)
+        g.store.blob_store = g.blob_store
+        # insert directly: these sidecars were just regenerated from the
+        # trusted seed (bit-identical by construction), so re-running the
+        # full commitment + erasure verification per (group, block, blob)
+        # would only multiply resume latency and double-count the
+        # ``das_sidecars_accepted_total`` metric on the resumed registry
+        for root_hex, idx in gm.get("blob_keys", []):
+            root = bytes.fromhex(root_hex)
+            for sc in sim.blob_archive.get(root, ()):
+                if int(sc.blob_index) == int(idx):
+                    g.blob_store.sidecars.setdefault(
+                        (root, int(idx)), {})[bytes(sc.commitment)] = sc
 
 
 # --- dense-array host offload -------------------------------------------------

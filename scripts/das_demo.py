@@ -1,0 +1,139 @@
+"""DAS serving demo: 10^5+ sampling clients over a blob-carrying chain.
+
+Runs a small DAS-enabled simulation (proposals carry erasure-coded blob
+sidecars, every view group verifies availability before importing), then
+attaches a vectorized sampling-client population and serves it once per
+slot through the coalescing ``DasServer`` — the "millions of users,
+heavy traffic" workload of ROADMAP item 4 made concrete: population cost
+is arrays, serving cost is the coalesced unique-cell set, verification
+is one ``ExecutionBackend`` batch kernel per served block.
+
+Usage:
+    python scripts/das_demo.py [--clients 100000] [--epochs 3]
+        [--validators 64] [--samples N] [--backend numpy|jax]
+        [--events events.jsonl] [--json bench_das.json]
+        [--history bench_history.jsonl] [--seed 3]
+
+``--events`` records the run for ``scripts/run_report.py`` (the "DAS
+serving" section); ``--json`` writes a ``bench_das`` emission
+(telemetry counts + serving latency summary) and ``--history`` appends
+it to a ``profiling/history.py`` time-series so
+``scripts/perf_gate.py --history --kind bench_das`` bands it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pos_evolution_tpu.config import minimal_config, use_config  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=100_000)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--validators", type=int, default=64)
+    ap.add_argument("--samples", type=int, default=None,
+                    help="samples per client per block "
+                         "(default: cfg.das_samples_per_client)")
+    ap.add_argument("--backend", choices=("numpy", "jax"), default="numpy")
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--events", help="telemetry JSONL output path")
+    ap.add_argument("--json", help="write the bench_das emission here")
+    ap.add_argument("--history",
+                    help="append the emission to this bench_history.jsonl")
+    args = ap.parse_args(argv)
+
+    from pos_evolution_tpu.backend import set_backend
+    set_backend(args.backend)
+
+    with use_config(minimal_config()) as c:
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.telemetry import Telemetry
+        telemetry = (Telemetry.to_file(args.events) if args.events
+                     else Telemetry())
+        telemetry.install_jax_runtime()
+
+        print(f"== DAS serving demo: {args.clients} sampling clients, "
+              f"{args.validators} validators, backend={args.backend} ==")
+        sim = Simulation(args.validators, das=True, telemetry=telemetry)
+        sim.attach_das_clients(args.clients,
+                               samples_per_client=args.samples,
+                               seed=args.seed)
+        t0 = time.perf_counter()
+        sim.run_epochs(args.epochs)
+        wall_s = time.perf_counter() - t0
+
+        serves = telemetry.bus.of_type("das_serve")
+        assert serves, "no das_serve events — the chain carried no blobs?"
+        total_samples = sum(e["samples"] for e in serves)
+        total_unique = sum(e["unique_requests"] for e in serves)
+        failures = sum(e["failed"] for e in serves)
+        # medians across served blocks of the per-block per-request
+        # percentiles (matches run_report.py's "typical served block");
+        # the worst block's p95 is reported separately
+        p50s = sorted(e["p50_ms"] for e in serves)
+        p95s = sorted(e["p95_ms"] for e in serves)
+        p50 = p50s[len(p50s) // 2]
+        p95 = p95s[len(p95s) // 2]
+        worst_p95 = p95s[-1]
+        hit_rate = serves[-1]["cache_hit_rate"]
+
+        print(f"slots run: {sim.slot}, blocks served: {len(serves)}, "
+              f"wall: {wall_s:.1f}s")
+        print(f"samples served: {total_samples} "
+              f"(coalesced to {total_unique} unique cell fetches, "
+              f"{total_samples / max(total_unique, 1):.0f}x)")
+        print(f"serving latency per coalesced request: "
+              f"p50 {p50:.3f} ms, p95 {p95:.3f} ms "
+              f"(typical block; worst block p95 {worst_p95:.3f} ms)")
+        print(f"proof-path cache hit rate: {hit_rate:.1%}")
+        print(f"verification failures: {failures}")
+        print(f"clients fully satisfied at last serve: "
+              f"{serves[-1]['clients_all_ok']}/{args.clients}")
+        assert failures == 0, "honest chain must verify clean"
+        assert serves[-1]["clients_all_ok"] == args.clients
+
+        emission = {
+            "metric": "bench_das",
+            "backend": args.backend,
+            "clients": args.clients,
+            "validators": args.validators,
+            "epochs": args.epochs,
+            "wall_s": round(wall_s, 3),
+            "serving": {
+                "served_blocks": len(serves),
+                "samples_total": total_samples,
+                "unique_requests_total": total_unique,
+                "p50_ms": round(p50, 4),
+                "p95_ms": round(p95, 4),
+                "worst_p95_ms": round(worst_p95, 4),
+                "cache_hit_rate": hit_rate,
+                "failures": failures,
+            },
+            "telemetry": {"counts": telemetry.registry.counts()},
+        }
+        if args.json:
+            with open(args.json, "w") as fh:
+                json.dump(emission, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            print(f"emission -> {args.json}")
+        if args.history:
+            from pos_evolution_tpu.profiling import history
+            history.append_entry(args.history, emission, kind="bench_das")
+            print(f"history  -> {args.history} (kind=bench_das)")
+        if args.events:
+            telemetry.close()
+            print(f"events   -> {args.events}\n  next: "
+                  f"python scripts/run_report.py {args.events}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

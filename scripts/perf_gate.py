@@ -43,12 +43,27 @@ nor slips a slow drift through. Empty history passes vacuously
 (loudly); a history whose counts share no keys with the candidate is
 incomparable and refuses with exit 2, same as baseline mode.
 
+**Outlier quarantine** (``--max-abs-ratio R``, default off): MAD bands
+are robust, which cuts both ways — a grossly contaminated history entry
+(a bench that ran concurrently with a test suite, say the 18.7s run of
+CHANGES PR 6) is silently *absorbed* instead of surfaced, and with a
+small window it can drag the median enough to wave a regression
+through. With the flag on, any entry whose value differs from the
+median of the OTHER entries by more than a factor of R (either
+direction) is flagged LOUDLY as ``[QUARANTINE]`` in the report and
+excluded from the band. Series with fewer than 3 entries are never
+quarantined (too few points to tell an outlier from a level shift),
+zero-vs-nonzero comparisons are exempt (sparse counters legitimately
+toggle 0 <-> small; no meaningful ratio exists), and a series where
+EVERY entry implicates the others is kept raw but reported loudly as
+mutually inconsistent.
+
 Usage:
     python scripts/perf_gate.py --candidate fresh.json
         [--baseline BENCH_r05.json] [--rel-tol 1.25] [--abs-slack 4]
         [--count-only] [--strict-timing]
         [--history bench_history.jsonl] [--window 20] [--mad-k 4.0]
-        [--kind bench]
+        [--kind bench] [--max-abs-ratio 8.0]
 
 ``--baseline`` defaults to the newest ``BENCH_r*.json`` /
 ``BENCH_ALL_r*.json`` in the repo root, falling back to
@@ -190,10 +205,58 @@ def gate(baseline: dict, candidate: dict, rel_tol: float, abs_slack: float,
     return 0
 
 
+def quarantine_series(series: dict[str, list[float]], ratio: float,
+                      out, label: str = "") -> dict[str, list[float]]:
+    """Leave-one-out outlier quarantine for history series: drop (and
+    loudly flag) any value whose ratio to the median of the remaining
+    entries exceeds ``ratio`` in either direction. Returns the filtered
+    series; series shorter than 3 entries pass through untouched."""
+    from pos_evolution_tpu.profiling.history import median
+
+    tiny = 1e-12
+    cleaned: dict[str, list[float]] = {}
+    for key, xs in series.items():
+        if len(xs) < 3:
+            cleaned[key] = xs
+            continue
+        keep, dropped = [], []
+        for i, v in enumerate(xs):
+            m = median(xs[:i] + xs[i + 1:])
+            lo, hi_v = sorted((abs(v), abs(m)))
+            # zero-vs-anything has no meaningful ratio: sparse counters
+            # legitimately toggle 0 <-> small, and crying wolf on them
+            # would train operators to ignore the quarantine signal —
+            # leave those entries to the MAD band
+            r = 1.0 if lo <= tiny else hi_v / lo
+            (dropped if r > ratio else keep).append(v)
+        if dropped and keep:
+            print(f"  [QUARANTINE] {label}{key}: {len(dropped)} contaminated "
+                  f"history entr{'y' if len(dropped) == 1 else 'ies'} "
+                  f"(value{'s' if len(dropped) != 1 else ''} "
+                  f"{[round(d, 6) for d in dropped]} vs clean median "
+                  f"{median(keep):.6g}) exceed --max-abs-ratio {ratio:g} — "
+                  f"excluded from the band", file=out)
+            cleaned[key] = keep
+        elif dropped:
+            # every entry implicates every other: there is no clean core
+            # to band against, so keep the raw series but say so LOUDLY
+            # (silently passing it through is exactly what the flag is
+            # meant to prevent)
+            print(f"  [QUARANTINE] {label}{key}: series is mutually "
+                  f"inconsistent — all {len(xs)} entries exceed "
+                  f"--max-abs-ratio {ratio:g} against the others; keeping "
+                  f"the raw series, inspect this history by hand", file=out)
+            cleaned[key] = xs
+        else:
+            cleaned[key] = xs
+    return cleaned
+
+
 def gate_history(history_path: str, candidate: dict, window: int,
                  mad_k: float, abs_slack: float, rel_tol: float = 1.25,
                  kind: str | None = None, count_only: bool = True,
-                 strict_timing: bool = False, out=None) -> int:
+                 strict_timing: bool = False,
+                 max_abs_ratio: float | None = None, out=None) -> int:
     """Gate one emission against the robust band of its own history
     (``profiling/history.py``); returns the process exit code.
 
@@ -243,6 +306,8 @@ def gate_history(history_path: str, candidate: dict, window: int,
         print("note: newest history entry matches the candidate emission "
               "— excluded from the band (no self-gating)", file=out)
     series = hist.series_from_history(entries, extract_counts)
+    if max_abs_ratio:
+        series = quarantine_series(series, max_abs_ratio, out)
     if not entries:
         print(f"history {history_path}: EMPTY — gate passes VACUOUSLY "
               f"(first entry seeds the band)", file=out)
@@ -285,6 +350,9 @@ def gate_history(history_path: str, candidate: dict, window: int,
     if not count_only:
         c_times = extract_timings(candidate)
         t_series = hist.series_from_history(entries, extract_timings)
+        if max_abs_ratio:
+            t_series = quarantine_series(t_series, max_abs_ratio, out,
+                                         label="timing:")
         t_rows = hist.band_verdicts(c_times, t_series, k=mad_k,
                                     abs_slack=0.0,
                                     rel_slack=max(rel_tol - 1.0, 0.0))
@@ -335,6 +403,12 @@ def main(argv=None) -> int:
                     help="history emission kind to gate against (e.g. "
                          "bench / bench_all); required when the history "
                          "file holds mixed kinds")
+    ap.add_argument("--max-abs-ratio", type=float, default=None,
+                    help="history-mode outlier quarantine: flag LOUDLY and "
+                         "exclude history entries whose value differs from "
+                         "the median of the other entries by more than this "
+                         "factor (default: off — contaminated entries are "
+                         "only absorbed by the MAD band, silently)")
     args = ap.parse_args(argv)
 
     if args.history:
@@ -350,7 +424,8 @@ def main(argv=None) -> int:
                             mad_k=args.mad_k, abs_slack=args.abs_slack,
                             rel_tol=args.rel_tol, kind=args.kind,
                             count_only=args.count_only,
-                            strict_timing=args.strict_timing)
+                            strict_timing=args.strict_timing,
+                            max_abs_ratio=args.max_abs_ratio)
 
     baseline_path = args.baseline or default_baseline()
     if baseline_path is None or not os.path.exists(baseline_path):

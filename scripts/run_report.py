@@ -16,6 +16,10 @@ Reconstructs, from the event log alone (no live ``Simulation``):
   fused-transition counters (``merkleization`` events: per-slot deltas of
   ``ssz.htr_cache_hit`` / ``ssz.htr_cache_miss`` / dirty-chunk counts and
   the fused sweep's upload/patch/reuse residency decisions);
+- **DAS serving** — sampling-client population size, samples served /
+  coalesced unique fetches, per-request p50/p95 serving latency,
+  proof-path cache hit rate and verification failures, aggregated from
+  the per-block ``das_serve`` events (``das/server.py``);
 - the **property audit** — the online monitor verdicts
   (``sim/monitors.py`` ``monitor`` events: accountable-safety /
   liveness / fork-choice-parity violations with slot, evidence size and
@@ -202,6 +206,33 @@ def build_report(events: list[dict], top_ops: dict | None = None,
                              if hits + misses else None),
         }
 
+    # -- DAS serving (das/server.py summaries via das_serve events) -----------
+    das_events = by_type.get("das_serve", [])
+    das_serving = None
+    if das_events:
+        p50s = [float(e["p50_ms"]) for e in das_events if "p50_ms" in e]
+        p95s = [float(e["p95_ms"]) for e in das_events if "p95_ms" in e]
+        attach = (by_type.get("das_attach") or [{}])[0]
+        das_serving = {
+            "served_blocks": len(das_events),
+            "clients": das_events[-1].get("clients"),
+            "samples_per_client": attach.get("samples_per_client"),
+            "samples_total": sum(e.get("samples", 0) for e in das_events),
+            "unique_requests_total": sum(e.get("unique_requests", 0)
+                                         for e in das_events),
+            "verify_failures": sum(e.get("failed", 0) for e in das_events),
+            "clients_all_ok_final": das_events[-1].get("clients_all_ok"),
+            "cache_hit_rate": das_events[-1].get("cache_hit_rate"),
+            # medians ACROSS served blocks of the per-block per-request
+            # percentiles (the true pooled p95 would need the raw samples;
+            # a percentile-of-percentiles is ~the max, which worst_p95_ms
+            # already reports)
+            "p50_ms": round(_percentile(p50s, 50), 4),
+            "p95_ms": round(_percentile(p95s, 50), 4),
+            "worst_p95_ms": round(max(p95s), 4) if p95s else None,
+            "scheme": (attach.get("engine") or {}).get("scheme"),
+        }
+
     # -- property audit (sim/monitors.py verdicts + invariant checker) --------
     attach = (by_type.get("monitor_attach") or [{}])[0]
     violations = [
@@ -254,6 +285,8 @@ def build_report(events: list[dict], top_ops: dict | None = None,
     }
     if merkleization:
         report["merkleization"] = merkleization
+    if das_serving:
+        report["das_serving"] = das_serving
     if top_ops:
         report["top_device_ops"] = top_ops
     if cost:
@@ -366,6 +399,26 @@ def to_markdown(report: dict) -> str:
         md += ["", *_md_table(
             ["counter", "total"],
             [[k, v] for k, v in merk["totals"].items()])]
+
+    if report.get("das_serving"):
+        d = report["das_serving"]
+        md += ["", "## DAS serving", ""]
+        md.append(f"- clients: **{d['clients']}** "
+                  f"({d.get('samples_per_client', '?')} samples each, "
+                  f"scheme: {d.get('scheme', '?')})")
+        md.append(f"- samples served: **{d['samples_total']}** over "
+                  f"{d['served_blocks']} served block(s), coalesced to "
+                  f"{d['unique_requests_total']} unique cell fetches")
+        md.append(f"- serving latency per coalesced request: "
+                  f"p50 **{d['p50_ms']} ms**, p95 **{d['p95_ms']} ms** "
+                  f"(typical served block; worst block p95 "
+                  f"{d['worst_p95_ms']} ms)")
+        if d.get("cache_hit_rate") is not None:
+            md.append(f"- proof-path cache hit rate: "
+                      f"**{d['cache_hit_rate']:.1%}**")
+        md.append(f"- sample verification failures: {d['verify_failures']} "
+                  f"(clients fully satisfied at last serve: "
+                  f"{d['clients_all_ok_final']})")
 
     md += ["", "## Handler percentiles", ""]
     if report["handlers"]:

@@ -1,0 +1,654 @@
+"""DAS subsystem tests (das/, ops/das_verify.py, driver wiring, DESIGN.md §15).
+
+Pins, in order: the GF(2^8) erasure layer (field laws, any-50%
+reconstruction, corruption rejection), generalized-index multiproofs,
+the pluggable commitment scheme, host<->device bit-identity of the
+batched sample-verification and reconstruction kernels on randomized
+(blob, sample, corruption) inputs, the blob engine + availability store
++ fork-choice gate, the coalescing server with its LRU caches and
+latency metrics, the end-to-end faulted simulation with sidecar
+backfill, checkpoint/resume with a reattached engine, the run-report
+"DAS serving" section, and the compile-prewarm knob (ROADMAP item 2
+remainder) via ``jax_backend_compiles_total``.
+"""
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.das import erasure
+from pos_evolution_tpu.das.commitment import (
+    CellCommitmentScheme,
+    MerkleCellScheme,
+    get_scheme,
+    register_scheme,
+)
+from pos_evolution_tpu.ssz.merkle import (
+    build_multiproof,
+    is_valid_merkle_branch,
+    merkleize_chunks,
+    multiproof_helper_gindices,
+    verify_multiproof,
+)
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+# --- erasure layer ------------------------------------------------------------
+
+class TestErasure:
+    def test_field_laws(self):
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+            assert erasure.gf_mul(a, erasure.gf_mul(b, c)) == \
+                erasure.gf_mul(erasure.gf_mul(a, b), c)
+            assert erasure.gf_mul(a, b ^ c) == \
+                erasure.gf_mul(a, b) ^ erasure.gf_mul(a, c)
+        for a in range(1, 256):
+            assert erasure.gf_mul(a, erasure.gf_inv(a)) == 1
+
+    def test_gf_matmul_matches_scalar(self):
+        rng = np.random.default_rng(1)
+        a = rng.integers(0, 256, (3, 4), dtype=np.uint8)
+        b = rng.integers(0, 256, (4, 5), dtype=np.uint8)
+        out = erasure.gf_matmul(a, b)
+        for i in range(3):
+            for j in range(5):
+                acc = 0
+                for t in range(4):
+                    acc ^= erasure.gf_mul(int(a[i, t]), int(b[t, j]))
+                assert acc == int(out[i, j])
+
+    def test_extension_is_systematic_and_polynomial(self):
+        rng = np.random.default_rng(2)
+        k = 8
+        data = rng.integers(0, 256, (k, 16), dtype=np.uint8)
+        grid = erasure.extend_blob(data)
+        assert grid.shape == (2 * k, 16)
+        assert (grid[:k] == data).all()
+
+    def test_any_half_reconstructs(self):
+        rng = np.random.default_rng(3)
+        k = 8
+        data = rng.integers(0, 256, (k, 32), dtype=np.uint8)
+        grid = erasure.extend_blob(data)
+        for _ in range(10):
+            present = np.zeros(2 * k, dtype=bool)
+            extra = int(rng.integers(0, k))  # any >= 50% works, not just 50%
+            present[rng.choice(2 * k, k + extra, replace=False)] = True
+            rec, full, ok = erasure.reconstruct_blob(grid, present)
+            assert ok and (rec == data).all() and (full == grid).all()
+
+    def test_below_half_raises(self):
+        k = 8
+        grid = erasure.extend_blob(np.zeros((k, 8), dtype=np.uint8))
+        present = np.zeros(2 * k, dtype=bool)
+        present[: k - 1] = True
+        with pytest.raises(ValueError):
+            erasure.reconstruct_blob(grid, present)
+
+    def test_any_corrupted_present_cell_flips_verdict(self):
+        rng = np.random.default_rng(4)
+        k = 8
+        grid = erasure.extend_blob(
+            rng.integers(0, 256, (k, 8), dtype=np.uint8))
+        for _ in range(8):
+            bad = grid.copy()
+            row = int(rng.integers(0, 2 * k))
+            col = int(rng.integers(0, 8))
+            bad[row, col] ^= int(rng.integers(1, 256))
+            present = np.ones(2 * k, dtype=bool)
+            _, _, ok = erasure.reconstruct_blob(bad, present)
+            assert not ok, f"corruption at ({row},{col}) slipped through"
+
+
+# --- multiproofs --------------------------------------------------------------
+
+class TestMultiproof:
+    def _leaves(self, n, seed=0):
+        return np.random.default_rng(seed).integers(
+            0, 256, (n, 32), dtype=np.uint8)
+
+    def test_random_subsets_verify(self):
+        rng = np.random.default_rng(5)
+        leaves = self._leaves(16)
+        root = merkleize_chunks(leaves)
+        for _ in range(10):
+            count = int(rng.integers(1, 9))
+            idx = sorted(int(i) for i in
+                         rng.choice(16, count, replace=False))
+            proof = build_multiproof(leaves, idx, 4)
+            assert verify_multiproof([leaves[i].tobytes() for i in idx],
+                                     idx, proof, 4, root)
+
+    def test_multiproof_cheaper_than_branches(self):
+        leaves = self._leaves(32)
+        idx = list(range(8))  # adjacent leaves share almost every sibling
+        proof = build_multiproof(leaves, idx, 5)
+        assert len(proof) < 8 * 5
+
+    def test_wrong_leaf_or_proof_rejected(self):
+        leaves = self._leaves(16, seed=6)
+        root = merkleize_chunks(leaves)
+        idx = [2, 7, 11]
+        proof = build_multiproof(leaves, idx, 4)
+        good = [leaves[i].tobytes() for i in idx]
+        assert verify_multiproof(good, idx, proof, 4, root)
+        bad = list(good)
+        bad[1] = b"\x00" * 32
+        assert not verify_multiproof(bad, idx, proof, 4, root)
+        assert not verify_multiproof(good, idx, proof[:-1], 4, root)
+        assert not verify_multiproof(good, idx, proof, 4, b"\x13" * 32)
+
+    def test_duplicate_leaf_indices_must_agree(self):
+        """Samplers draw cells with replacement, so the same index can
+        arrive twice — a conflicting value at a repeated gindex must NOT
+        verify (a last-write-wins dict would silently keep the honest
+        copy and wave the corrupted one through)."""
+        leaves = self._leaves(16, seed=8)
+        root = merkleize_chunks(leaves)
+        proof = build_multiproof(leaves, [3], 4)
+        good = leaves[3].tobytes()
+        assert verify_multiproof([good, good], [3, 3], proof, 4, root)
+        assert not verify_multiproof([b"\x66" * 32, good], [3, 3],
+                                     proof, 4, root)
+        assert not verify_multiproof([good, b"\x66" * 32], [3, 3],
+                                     proof, 4, root)
+
+    def test_single_leaf_equals_plain_branch(self):
+        """Helpers for one leaf, deepest-first, ARE the plain branch."""
+        leaves = self._leaves(16, seed=7)
+        root = merkleize_chunks(leaves)
+        proof = build_multiproof(leaves, [5], 4)
+        assert len(multiproof_helper_gindices([5], 4)) == 4
+        assert is_valid_merkle_branch(leaves[5].tobytes(), proof, 4, 5, root)
+        assert verify_multiproof([leaves[5].tobytes()], [5], proof, 4, root)
+
+
+# --- commitment schemes -------------------------------------------------------
+
+class TestCommitment:
+    def _grid(self, seed=0):
+        from pos_evolution_tpu.config import cfg
+        rng = np.random.default_rng(seed)
+        c = cfg()
+        return erasure.extend_blob(rng.integers(
+            0, 256, (c.das_cells_per_blob, c.das_cell_bytes), dtype=np.uint8))
+
+    def test_branches_match_single_branch(self):
+        sch = get_scheme("merkle")
+        grid = self._grid()
+        leaves, branches = sch.branches(grid, [1, 6, 9])
+        for j, i in enumerate([1, 6, 9]):
+            single = sch.branch(grid, i)
+            assert (branches[j] == single).all()
+            assert is_valid_merkle_branch(
+                leaves[j].tobytes(),
+                [single[d].tobytes() for d in range(single.shape[0])],
+                single.shape[0], i, sch.commit(grid))
+
+    def test_multiproof_roundtrip_and_rejection(self):
+        sch = get_scheme("merkle")
+        grid = self._grid(1)
+        com = sch.commit(grid)
+        idx = [0, 3, 9, 14]
+        proof = sch.prove_cells(grid, idx)
+        assert sch.verify_cells(com, grid[idx], idx, proof)
+        bad = grid[idx].copy()
+        bad[2, 0] ^= 1
+        assert not sch.verify_cells(com, bad, idx, proof)
+
+    def test_scheme_registry_pluggable(self):
+        class XorScheme(CellCommitmentScheme):
+            name = "xor-test"
+        register_scheme(XorScheme)
+        assert isinstance(get_scheme("xor-test"), XorScheme)
+        assert isinstance(get_scheme("merkle"), MerkleCellScheme)
+        with pytest.raises(ValueError):
+            get_scheme("kzg-not-yet")
+
+
+# --- batched kernels: host == device on randomized inputs ---------------------
+
+class TestBackendParity:
+    def _batch(self, seed, corrupt_fraction=0.25):
+        """Random (blob, sample, corruption) batch + the expected verdicts."""
+        from pos_evolution_tpu.config import cfg
+        from pos_evolution_tpu.ops.das_verify import DasSampleBatch
+        rng = np.random.default_rng(seed)
+        c = cfg()
+        sch = get_scheme("merkle")
+        n_blobs = 3
+        grids = [erasure.extend_blob(rng.integers(
+            0, 256, (c.das_cells_per_blob, c.das_cell_bytes),
+            dtype=np.uint8)) for _ in range(n_blobs)]
+        coms = [sch.commit(g) for g in grids]
+        s = 24
+        blob_ids = rng.integers(0, n_blobs, s)
+        n_cells = 2 * c.das_cells_per_blob
+        cell_ids = rng.integers(0, n_cells, s)
+        depth = sch.depth_for(n_cells)
+        cells = np.zeros((s, c.das_cell_bytes), dtype=np.uint8)
+        branches = np.zeros((s, depth, 32), dtype=np.uint8)
+        commitments = np.zeros((s, 32), dtype=np.uint8)
+        for j in range(s):
+            g = grids[blob_ids[j]]
+            cells[j] = g[cell_ids[j]]
+            branches[j] = sch.branch(g, int(cell_ids[j]))
+            commitments[j] = np.frombuffer(coms[blob_ids[j]], dtype=np.uint8)
+        expect = np.ones(s, dtype=bool)
+        corrupt = rng.random(s) < corrupt_fraction
+        for j in np.nonzero(corrupt)[0]:
+            cells[j, int(rng.integers(0, c.das_cell_bytes))] ^= \
+                int(rng.integers(1, 256))
+            expect[j] = False
+        return DasSampleBatch(cells=cells, branches=branches,
+                              indices=cell_ids.astype(np.int64),
+                              commitments=commitments), expect
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_verify_samples_bit_identical(self, seed):
+        from pos_evolution_tpu.ops.das_verify import (
+            verify_samples_device,
+            verify_samples_host,
+        )
+        batch, expect = self._batch(seed)
+        h = verify_samples_host(batch)
+        d = verify_samples_device(batch)
+        assert (h["ok"] == expect).all(), "host verdicts wrong"
+        for key in ("ok", "roots", "leaves"):
+            assert (h[key] == d[key]).all(), f"host/device diverge on {key}"
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_reconstruct_bit_identical(self, seed):
+        from pos_evolution_tpu.config import cfg
+        from pos_evolution_tpu.ops.das_verify import (
+            reconstruct_check_device,
+            reconstruct_check_host,
+        )
+        rng = np.random.default_rng(seed)
+        c = cfg()
+        k = c.das_cells_per_blob
+        data = rng.integers(0, 256, (k, c.das_cell_bytes), dtype=np.uint8)
+        grid = erasure.extend_blob(data)
+        present = np.zeros(2 * k, dtype=bool)
+        present[rng.choice(2 * k, k + 2, replace=False)] = True
+        okh, dh = reconstruct_check_host(grid, present)
+        okd, dd = reconstruct_check_device(grid, present)
+        assert okh and okd and (dh == dd).all() and (dh == data).all()
+        # one corrupted PRESENT cell must flip both verdicts identically
+        bad = grid.copy()
+        row = int(np.nonzero(present)[0][-1])
+        bad[row, 0] ^= 0x5A
+        okh2, dh2 = reconstruct_check_host(bad, present)
+        okd2, dd2 = reconstruct_check_device(bad, present)
+        assert not okh2 and not okd2 and (dh2 == dd2).all()
+
+    def test_backend_dispatch(self):
+        from pos_evolution_tpu.backend import set_backend
+        from pos_evolution_tpu.ops.das_verify import verify_das_samples
+        batch, expect = self._batch(9)
+        try:
+            set_backend("numpy")
+            h = verify_das_samples(batch)
+            set_backend("jax")
+            d = verify_das_samples(batch)
+        finally:
+            set_backend("numpy")
+        assert (h["ok"] == d["ok"]).all()
+        assert (h["ok"] == expect).all()
+
+
+# --- blob engine + availability store -----------------------------------------
+
+class TestBlobEngineStore:
+    def test_sidecar_verification_and_gate(self):
+        from pos_evolution_tpu.das import BlobEngine, BlobStore
+        from pos_evolution_tpu.das.containers import parse_das_graffiti
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import build_block
+        from pos_evolution_tpu.ssz import hash_tree_root
+
+        state, anchor = make_genesis(16)
+        eng = BlobEngine(seed=11)
+        parent_root = hash_tree_root(anchor)
+        grids, coms, graffiti = eng.build_for(1, parent_root)
+        assert parse_das_graffiti(graffiti)[0] == len(grids)
+        sb = build_block(state, 1, graffiti=graffiti)
+        block_root = hash_tree_root(sb.message)
+        sidecars = eng.sidecars_for(sb, block_root, grids, coms)
+
+        store = BlobStore(eng)
+        assert not store.is_available(block_root, sb.message)
+        for sc in sidecars:
+            assert store.on_sidecar(sc)
+        assert store.is_available(block_root, sb.message)
+
+        # a corrupted sidecar is rejected and never feeds the gate
+        bad = sidecars[0].copy()
+        cells = np.asarray(bad.cells).copy()
+        cells[1, 2] ^= 1
+        bad.cells = cells
+        store2 = BlobStore(eng)
+        assert not store2.on_sidecar(bad)
+        # corrupt + recommitted: commitment matches but erasure check fails
+        bad2 = sidecars[0].copy()
+        bad2.cells = cells
+        bad2.commitment = eng.scheme.commit(cells)
+        assert not store2.on_sidecar(bad2)
+        assert not store2.is_available(block_root, sb.message)
+
+    def test_bad_das_geometry_is_loud(self):
+        """The documented config constraints are enforced at engine
+        construction: violating any of them would otherwise produce
+        structurally wrong roots or colliding payloads, silently."""
+        import dataclasses
+
+        from pos_evolution_tpu.config import cfg, use_config
+        from pos_evolution_tpu.das import BlobEngine
+        from pos_evolution_tpu.das.containers import (
+            CellRows,
+            validate_das_config,
+        )
+
+        validate_das_config()  # the active minimal config is fine
+        good = cfg()
+        for bad in (dataclasses.replace(good, das_cells_per_blob=12),
+                    dataclasses.replace(good, das_cells_per_blob=256),
+                    dataclasses.replace(good, das_cell_bytes=96),
+                    dataclasses.replace(good, das_max_blobs_per_block=300),
+                    dataclasses.replace(good, das_samples_per_client=0)):
+            with use_config(bad), pytest.raises(ValueError):
+                BlobEngine()
+        # the htr sweep guards its own geometry too (96B = 3 chunks)
+        with pytest.raises(ValueError):
+            CellRows().htr(np.zeros((2, 96), dtype=np.uint8))
+
+    def test_poisoned_sidecar_cannot_block_the_honest_one(self):
+        """A Byzantine sidecar that is self-consistent under its own
+        (wrong) commitment verifies in isolation — it must be held as a
+        CANDIDATE, not a first-writer-wins occupant, so the honest
+        sidecar still satisfies the graffiti-bound availability gate."""
+        from pos_evolution_tpu.das import BlobEngine, BlobStore
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import build_block
+        from pos_evolution_tpu.ssz import hash_tree_root
+
+        state, anchor = make_genesis(16)
+        eng = BlobEngine(seed=13)
+        grids, coms, graffiti = eng.build_for(1, hash_tree_root(anchor))
+        sb = build_block(state, 1, graffiti=graffiti)
+        block_root = hash_tree_root(sb.message)
+        sidecars = eng.sidecars_for(sb, block_root, grids, coms)
+
+        rng = np.random.default_rng(14)
+        evil_grid = erasure.extend_blob(rng.integers(
+            0, 256, (grids[0].shape[0] // 2, grids[0].shape[1]),
+            dtype=np.uint8))
+        evil = sidecars[0].copy()
+        evil.cells = evil_grid
+        evil.commitment = eng.scheme.commit(evil_grid)
+
+        store = BlobStore(eng)
+        assert store.on_sidecar(evil)  # self-consistent: verifies alone
+        for sc in sidecars:            # honest set arrives second
+            assert store.on_sidecar(sc)
+        assert store.is_available(block_root, sb.message)
+        served = store.sidecars_for_block(block_root)
+        assert [bytes(s.commitment) for s in served] == \
+            [bytes(c) for c in coms]
+
+    def test_regenerate_is_bit_identical(self):
+        from pos_evolution_tpu.das import BlobEngine
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import build_block
+        from pos_evolution_tpu.ssz import hash_tree_root
+        state, anchor = make_genesis(16)
+        eng = BlobEngine(seed=5)
+        parent_root = hash_tree_root(anchor)
+        grids, coms, graffiti = eng.build_for(1, parent_root)
+        sb = build_block(state, 1, graffiti=graffiti)
+        root = hash_tree_root(sb.message)
+        first = eng.sidecars_for(sb, root, grids, coms)
+        again = eng.regenerate(sb, root)
+        assert len(first) == len(again)
+        for a, b in zip(first, again):
+            assert hash_tree_root(a) == hash_tree_root(b)
+
+    def test_fork_choice_gate_blocks_unavailable(self):
+        from pos_evolution_tpu.das import BlobEngine, BlobStore
+        from pos_evolution_tpu.specs import forkchoice as fc
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import build_block
+        from pos_evolution_tpu.ssz import hash_tree_root
+
+        state, anchor = make_genesis(16)
+        store = fc.get_forkchoice_store(state, anchor)
+        eng = BlobEngine(seed=2)
+        store.blob_store = BlobStore(eng)
+        parent_root = hash_tree_root(anchor)
+        grids, coms, graffiti = eng.build_for(1, parent_root)
+        sb = build_block(state, 1, graffiti=graffiti)
+        fc.on_tick(store, store.genesis_time + 12)
+        with pytest.raises(AssertionError, match="blob data not available"):
+            fc.on_block(store, sb)
+        root = hash_tree_root(sb.message)
+        for sc in eng.sidecars_for(sb, root, grids, coms):
+            store.blob_store.on_sidecar(sc)
+        fc.on_block(store, sb)  # now imports
+        assert root in store.blocks
+
+
+# --- sampler + server ---------------------------------------------------------
+
+class TestSamplerServer:
+    def test_selection_deterministic_and_diverse(self):
+        from pos_evolution_tpu.das import SamplingClientPopulation
+        pop = SamplingClientPopulation(500, samples_per_client=4, seed=9)
+        b1, c1 = pop.select_cells(b"\x01" * 32, 2, 16)
+        pop2 = SamplingClientPopulation(500, samples_per_client=4, seed=9)
+        b2, c2 = pop2.select_cells(b"\x01" * 32, 2, 16)
+        assert (b1 == b2).all() and (c1 == c2).all()
+        b3, c3 = pop2.select_cells(b"\x02" * 32, 2, 16)
+        assert not (c1 == c3).all()  # selection depends on the block
+        assert c1.min() >= 0 and c1.max() < 16 and b1.max() < 2
+        # the population covers the grid (availability needs spread)
+        assert len(np.unique(b1 * 16 + c1)) == 32
+
+    def test_lru_cache_semantics(self):
+        from pos_evolution_tpu.das import LRUCache
+        from pos_evolution_tpu.das.server import _MISS
+        lru = LRUCache(2)
+        lru.put("a", 1)
+        lru.put("b", 2)
+        assert lru.get("a") == 1       # refreshes "a"
+        lru.put("c", 3)                # evicts "b" (LRU)
+        assert lru.get("b") is _MISS
+        assert lru.get("a") == 1 and lru.get("c") == 3
+        assert lru.hits == 3 and lru.misses == 1
+
+    def test_serve_coalesces_and_detects_corruption(self):
+        from pos_evolution_tpu.config import cfg
+        from pos_evolution_tpu.das import (
+            BlobEngine,
+            DasServer,
+            SamplingClientPopulation,
+        )
+        from pos_evolution_tpu.telemetry.registry import MetricsRegistry
+        c = cfg()
+        eng = BlobEngine(seed=4)
+        grids, coms, _ = eng.build_for(2, b"\x07" * 32)
+
+        class _FakeSidecar:
+            def __init__(self, cells, commitment):
+                self.cells = cells
+                self.commitment = commitment
+
+        sidecars = [_FakeSidecar(g, co) for g, co in zip(grids, coms)]
+        registry = MetricsRegistry()
+        server = DasServer(eng.scheme, registry=registry)
+        pop = SamplingClientPopulation(1000, samples_per_client=4, seed=1)
+        s1 = server.serve_samples(b"\x07" * 32, sidecars, pop)
+        assert s1["samples"] == 4000
+        assert s1["unique_requests"] <= 2 * 2 * c.das_cells_per_blob
+        assert s1["failed"] == 0 and s1["clients_all_ok"] == 1000
+        assert s1["p95_ms"] >= s1["p50_ms"] >= 0
+        # second serve of the same block: all unique fetches hit the LRU
+        s2 = server.serve_samples(b"\x07" * 32, sidecars, pop)
+        assert s2["cache_misses"] == 0
+        assert s2["cache_hits"] == s2["unique_requests"]
+        # a corrupted served cell -> failed samples, attributed to clients
+        bad_cells = np.asarray(grids[0]).copy()
+        bad_cells[:, 0] ^= 0xFF
+        sidecars[0].cells = bad_cells
+        server2 = DasServer(eng.scheme, registry=registry)
+        s3 = server2.serve_samples(b"\x08" * 32, sidecars, pop)
+        assert s3["failed"] > 0
+        assert s3["clients_all_ok"] < 1000
+        counts = registry.counts()
+        assert counts["das_samples_total"] == 12000
+        assert counts["das_sample_verify_failures_total"] == s3["failed"]
+        assert counts["das_request_seconds;stat=count"] == \
+            s1["unique_requests"] + s2["unique_requests"] \
+            + s3["unique_requests"]
+
+
+# --- end-to-end simulation ----------------------------------------------------
+
+class TestDasSimulation:
+    def test_faulted_das_sim_serves_and_reports(self, tmp_path):
+        """A lossy DAS run: dropped sidecars backfill at import time, the
+        population is served every slot, and the offline report carries
+        the DAS serving section."""
+        import json
+
+        from pos_evolution_tpu.config import cfg
+        from pos_evolution_tpu.sim import Simulation, faulty_schedule, lossy_plan
+        from pos_evolution_tpu.telemetry import Telemetry
+        c = cfg()
+        tel = Telemetry.to_file(str(tmp_path / "events.jsonl"))
+        plan = lossy_plan(seed=13, drop_p=0.15,
+                          gst=c.slots_per_epoch * c.seconds_per_slot)
+        sim = Simulation(32, schedule=faulty_schedule(32, plan),
+                         das=True, telemetry=tel)
+        sim.attach_das_clients(2000, seed=7)
+        sim.run_epochs(2)
+        tel.close()
+
+        serves = tel.bus.of_type("das_serve")
+        assert serves, "population was never served"
+        assert serves[-1]["failed"] == 0
+        assert serves[-1]["clients_all_ok"] == 2000
+        counts = tel.registry.counts()
+        accepted = sum(v for k, v in counts.items()
+                       if k.startswith("das_sidecars_accepted_total"))
+        assert accepted > 0
+        # faults dropped sidecars pre-GST; imports pulled them by req/resp
+        assert any(k.startswith("das_blob_backfills_total")
+                   for k in counts), "lossy run should exercise backfill"
+        # finality parity with a blob-free twin: DAS must not slow the chain
+        sim_plain = Simulation(
+            32, schedule=faulty_schedule(32, lossy_plan(
+                seed=13, drop_p=0.15,
+                gst=c.slots_per_epoch * c.seconds_per_slot)))
+        sim_plain.run_epochs(2)
+        assert sim.finalized_epoch() == sim_plain.finalized_epoch()
+        assert sim.justified_epoch() == sim_plain.justified_epoch()
+
+        # offline report: DAS serving section present in md and json
+        import os
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out_json = tmp_path / "report.json"
+        out_md = tmp_path / "report.md"
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts", "run_report.py"),
+             str(tmp_path / "events.jsonl"), "--json", str(out_json),
+             "--markdown", str(out_md)],
+            capture_output=True, text=True, timeout=120, cwd=repo)
+        assert r.returncode == 0, r.stderr
+        md = out_md.read_text()
+        assert "## DAS serving" in md
+        assert "p50" in md and "cache hit rate" in md
+        report = json.loads(out_json.read_text())
+        das = report["das_serving"]
+        assert das["clients"] == 2000
+        assert das["verify_failures"] == 0
+        assert das["p95_ms"] >= das["p50_ms"] >= 0
+
+    def test_checkpoint_resume_with_das(self):
+        from pos_evolution_tpu.config import cfg
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.specs import forkchoice as fc
+        c = cfg()
+        sim = Simulation(32, das=True)
+        sim.run_until_slot(c.slots_per_epoch + 2)
+        blob = sim.checkpoint()
+        # a mismatched engine must refuse loudly: its regenerated
+        # sidecars could never satisfy the checkpointed graffiti
+        # commitments, so the resumed chain would stall silently forever
+        from pos_evolution_tpu.das import BlobEngine
+        with pytest.raises(ValueError, match="does not match"):
+            Simulation.resume(blob, das=BlobEngine(seed=sim.das.seed + 1))
+        twin = Simulation.resume(blob, das=sim.das)
+        target = 2 * c.slots_per_epoch
+        sim.run_until_slot(target)
+        twin.run_until_slot(target)
+        assert fc.get_head(twin.store()) == fc.get_head(sim.store())
+        assert twin.finalized_epoch() == sim.finalized_epoch()
+        # availability state carried over: resumed gate still satisfied
+        head = fc.get_head(twin.store())
+        block = twin.store().blocks[head]
+        assert twin.groups[0].blob_store.is_available(head, block)
+
+
+# --- compile prewarm (ROADMAP item 2 remainder) -------------------------------
+
+class TestCompilePrewarm:
+    def test_prewarm_pins_block_sweep_recompiles(self):
+        """``Simulation(prewarm=True)`` compiles every padded
+        attestation-batch shape at init: the fused sweep's jit cache must
+        not grow during the run, and ``jax_backend_compiles_total`` must
+        stay flat after the first epoch (the epoch 2-3 compile-storm
+        symptom of ROADMAP item 2)."""
+        from pos_evolution_tpu.backend import set_backend
+        from pos_evolution_tpu.ops import transition
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.telemetry import jaxrt
+        from pos_evolution_tpu.telemetry.registry import MetricsRegistry
+
+        registry = MetricsRegistry()
+        set_backend("jax")
+        jaxrt.install(registry)
+        try:
+            transition.reset_session()
+            sim = Simulation(64, prewarm=True)
+            fn = transition._sweep_fn()
+            warmed = fn._cache_size()
+            assert warmed > 0, "prewarm compiled nothing"
+            sim.run_epochs(1)
+            mark = registry.counter("jax_backend_compiles_total").value()
+            sim.run_epochs(3)
+            assert fn._cache_size() == warmed, \
+                "a block-sweep shape escaped the prewarm lattice"
+            delta = registry.counter(
+                "jax_backend_compiles_total").value() - mark
+            assert delta == 0, \
+                f"{delta} mid-run recompiles after the warm-up epoch"
+        finally:
+            jaxrt.install(None)
+            set_backend("numpy")
+            transition.reset_session()
+
+    def test_compile_cache_knob_sets_jax_config(self, tmp_path):
+        import jax
+
+        from pos_evolution_tpu.sim import Simulation
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            Simulation(16, compile_cache=tmp_path / "xla-cache")
+            assert jax.config.jax_compilation_cache_dir == \
+                str(tmp_path / "xla-cache")
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)

@@ -465,6 +465,73 @@ class TestHistoryGateCLI:
         assert perf_gate.main(["--candidate", same, "--history", str(hist),
                                "--strict-timing"]) == 0
 
+    def test_outlier_quarantine_flags_and_excludes(self, tmp_path, capsys):
+        """--max-abs-ratio: a single contaminated history entry (the
+        18.7s-style run of CHANGES PR 6) must be flagged LOUDLY and
+        excluded from the band. Doctored negative: a candidate that the
+        contaminated MAD band would wave through (median dragged +
+        widened halfwidth) FAILS against the quarantined band."""
+        import perf_gate
+        hist = tmp_path / "hist.jsonl"
+        for n in (100, 106, 94, 112, 88, 1870):  # 1870 = contamination
+            history.append_entry(hist, self._emission(n), kind="bench")
+        cand = self._write(tmp_path, "cand.json", self._emission(150))
+        # absorbed silently without the flag: 150 < 103 + 4*1.4826*MAD(9)
+        assert perf_gate.main(["--candidate", cand, "--history", str(hist),
+                               "--count-only"]) == 0
+        assert "QUARANTINE" not in capsys.readouterr().out
+        # with quarantine: loud flag, clean band, regression caught
+        assert perf_gate.main(["--candidate", cand, "--history", str(hist),
+                               "--count-only", "--max-abs-ratio", "4"]) == 1
+        out = capsys.readouterr().out
+        assert "[QUARANTINE]" in out and "1870" in out
+        assert "excluded from the band" in out
+        # an in-band candidate still passes with quarantine on
+        ok = self._write(tmp_path, "ok.json", self._emission(104))
+        assert perf_gate.main(["--candidate", ok, "--history", str(hist),
+                               "--count-only", "--max-abs-ratio", "4"]) == 0
+
+    def test_quarantine_needs_three_entries(self, tmp_path, capsys):
+        """Two wildly different entries are a level shift, not an
+        outlier — n < 3 series must pass through unquarantined."""
+        import perf_gate
+        hist = tmp_path / "hist.jsonl"
+        for n in (100, 1870):
+            history.append_entry(hist, self._emission(n), kind="bench")
+        cand = self._write(tmp_path, "cand.json", self._emission(101))
+        assert perf_gate.main(["--candidate", cand, "--history", str(hist),
+                               "--count-only", "--max-abs-ratio", "4"]) == 0
+        assert "QUARANTINE" not in capsys.readouterr().out
+
+    def test_quarantine_series_unit(self):
+        import perf_gate
+        import io
+        out = io.StringIO()
+        series = {"a": [10.0, 10.0, 10.0, 500.0], "b": [0.0, 0.0, 0.0],
+                  "short": [1.0, 99.0], "sparse": [0.0, 0.0, 0.0, 2.0]}
+        cleaned = perf_gate.quarantine_series(series, 8.0, out)
+        assert cleaned["a"] == [10.0, 10.0, 10.0]
+        assert cleaned["b"] == [0.0, 0.0, 0.0]      # all-zero: no flags
+        assert cleaned["short"] == [1.0, 99.0]      # n<3 untouched
+        # sparse counters toggling 0 <-> small are NOT contamination
+        assert cleaned["sparse"] == [0.0, 0.0, 0.0, 2.0]
+        assert "[QUARANTINE] a:" in out.getvalue()
+        assert "sparse" not in out.getvalue()
+
+    def test_quarantine_mutually_inconsistent_series_is_loud(self):
+        """When leave-one-out implicates EVERY entry there is no clean
+        core to band against — the raw series is kept but the operator
+        must be told loudly, not silently passed through."""
+        import io
+
+        import perf_gate
+        out = io.StringIO()
+        series = {"w": [1.0, 100.0, 10000.0]}
+        cleaned = perf_gate.quarantine_series(series, 8.0, out)
+        assert cleaned["w"] == [1.0, 100.0, 10000.0]
+        assert "[QUARANTINE] w: series is mutually inconsistent" \
+            in out.getvalue()
+
     def test_kindless_entry_refuses_not_crashes(self, tmp_path, capsys):
         """A hand-seeded entry with no 'kind' field must hit the
         deliberate mixed-kind exit 2, not a sorted() TypeError."""

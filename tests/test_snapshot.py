@@ -85,6 +85,90 @@ class TestFullStoreSnapshot:
         fc.on_block(back, sb)
 
 
+class TestResumeCacheCoherence:
+    """Checkpoint/resume x the PR-6 caches (ssz/incremental.py
+    ``ContainerTreeCache`` lineage caches + ``cached_root`` memos): a
+    resumed simulation must rebuild (or safely drop) both, and its
+    every subsequent root must stay bit-identical to a twin that never
+    went through serialization."""
+
+    def test_resumed_roots_bit_identical_to_unsnapshotted_twin(self):
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.specs import forkchoice as fc
+        from pos_evolution_tpu.ssz import incremental
+
+        sim = Simulation(32)
+        sim.run_epochs(2)  # plenty of incremental-cache traffic
+        head0 = fc.get_head(sim.store())
+        # the live run's states carry lineage caches by now
+        assert any("_htr_cache" in s.__dict__
+                   for s in sim.store().block_states.values()), \
+            "expected live states to carry incremental caches"
+        blob = sim.checkpoint()
+
+        twin = Simulation.resume(blob)
+        # caches are optimization handles, never serialized state: the
+        # resumed stores start clean and rebuild on first use
+        for s in twin.store().block_states.values():
+            assert "_htr_cache" not in s.__dict__
+            assert "_htr_memo" not in s.__dict__
+        # resumed head state's incremental root == full re-merkleization
+        # == the live twin's root, bit for bit
+        head = fc.get_head(twin.store())
+        assert head == head0
+        resumed_state = twin.store().block_states[head]
+        live_state = sim.store().block_states[head0]
+        incremental_root = hash_tree_root(resumed_state)
+        prev = incremental.set_enabled(False)
+        try:
+            full_root = hash_tree_root(resumed_state)
+        finally:
+            incremental.set_enabled(prev)
+        assert incremental_root == full_root
+        assert incremental_root == hash_tree_root(live_state)
+
+        # continue BOTH runs: every later block/state root must agree
+        sim.run_epochs(3)
+        twin.run_epochs(3)
+        assert fc.get_head(twin.store()) == fc.get_head(sim.store())
+        assert twin.metrics == sim.metrics
+        h = fc.get_head(sim.store())
+        assert hash_tree_root(twin.store().block_states[h]) == \
+            hash_tree_root(sim.store().block_states[h])
+
+    def test_resumed_queue_payload_memos_rebuild(self):
+        """``cached_root`` memos on gossip payloads are per-object; the
+        deserialized copies must recompute identical roots (a stale or
+        missing memo either way would split dedup/span identity)."""
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.ssz import cached_root
+
+        sim = Simulation(32)
+        sim.run_epochs(1)
+        blob = sim.checkpoint()
+        twin = Simulation.resume(blob)
+        for root, sb in sim.block_archive.items():
+            copy = twin.block_archive[root]
+            assert "_htr_memo" not in copy.message.__dict__
+            # archive keys are MESSAGE roots (the gossip identity)
+            assert cached_root(copy.message) == \
+                cached_root(sb.message) == root
+
+    def test_anchor_snapshot_of_cached_state_roundtrips(self):
+        """``save_anchor`` hashes through the incremental cache when one
+        is attached; the serialized bytes must deserialize to the same
+        root with NO cache (the cache must never leak into — or be
+        needed by — the snapshot)."""
+        from pos_evolution_tpu.sim import Simulation
+
+        sim = Simulation(32)
+        sim.run_epochs(2)
+        snap = snapshot_head(sim.store())
+        state, block = load_anchor(snap)
+        assert "_htr_cache" not in state.__dict__
+        assert hash_tree_root(state) == bytes(block.state_root)
+
+
 class TestDenseCheckpoints:
     def test_npz_roundtrip(self, tmp_path):
         jax = pytest.importorskip("jax")
