@@ -57,6 +57,22 @@ def das_reconstruct(cells: np.ndarray, present: np.ndarray):
     return reconstruct_check_device(cells, present)
 
 
+def variant_tally(block_idx, vote_slot, weight, active, lo_slot, hi_slot,
+                  n_blocks):
+    """Expiry-windowed vote tally as one jitted masked segment_sum
+    (bit-identical to numpy_backend.variant_tally)."""
+    from pos_evolution_tpu.ops.variant_tally import windowed_vote_tally_device
+    return windowed_vote_tally_device(block_idx, vote_slot, weight, active,
+                                      lo_slot, hi_slot, n_blocks)
+
+
+def link_tally(link_idx, weight, active, n_links):
+    """SSF supermajority-link / acknowledgment tally on device
+    (bit-identical to numpy_backend.link_tally)."""
+    from pos_evolution_tpu.ops.variant_tally import link_tally_device
+    return link_tally_device(link_idx, weight, active, n_links)
+
+
 def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
     """Same contract as numpy_backend.subtree_weights (parent[i] < i)."""
     w = node_weight.astype(np.int64).copy()

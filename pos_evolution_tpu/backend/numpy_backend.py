@@ -75,6 +75,22 @@ def das_reconstruct(cells: np.ndarray, present: np.ndarray):
     return reconstruct_check_host(cells, present)
 
 
+def variant_tally(block_idx, vote_slot, weight, active, lo_slot, hi_slot,
+                  n_blocks):
+    """Expiry-windowed, equivocation-discounted vote tally
+    (ops/variant_tally.py contract; variants/ hot loop)."""
+    from pos_evolution_tpu.ops.variant_tally import windowed_vote_tally_host
+    return windowed_vote_tally_host(block_idx, vote_slot, weight, active,
+                                    lo_slot, hi_slot, n_blocks)
+
+
+def link_tally(link_idx, weight, active, n_links):
+    """SSF supermajority-link / acknowledgment tally
+    (ops/variant_tally.py contract)."""
+    from pos_evolution_tpu.ops.variant_tally import link_tally_host
+    return link_tally_host(link_idx, weight, active, n_links)
+
+
 def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
     """Accumulate each node's weight into all ancestors.
 

@@ -1,0 +1,161 @@
+"""Vectorized hot loops of the protocol-variant seam (variants/, L7).
+
+The successor protocols of the reference's second half — Goldfish,
+RLMD-GHOST, single-slot finality (pos-evolution.md:1528-1650) — share
+three batch-friendly reductions that dominate their per-slot work:
+
+- **expiry-windowed vote tally** (pos-evolution.md:1585, 1596): per-block
+  summed weight of the latest head votes whose slot lies inside
+  ``[lo_slot, hi_slot]``, with equivocators and inactive validators
+  discounted (:1411, 1438). ``eta = 1`` recovers Goldfish's GHOST-Eph
+  (:1549); an unbounded window recovers LMD.
+- **supermajority link tally** (pos-evolution.md:1626): per-link summed
+  weight of one slot's FFG votes, the justification/finalization input of
+  the per-slot FFG gadget; the acknowledgment tally (:1646) is the same
+  reduction over ack ids.
+- **subtree weight accumulation**: already a backend primitive
+  (``subtree_weights``) shared with the dense Gasper fork choice.
+
+Both reductions are a masked ``segment_sum`` — regular, shape-padded,
+identical on NumPy and under ``jax.jit`` (the vectorization-first framing
+the ISSUE cites from the Elliptic-Net pairing revisit): the host twins
+are the bit-exact oracles for the jitted device twins, pinned in
+tests/test_variant_seam.py. Variants reach them through
+``ExecutionBackend`` (``backend.variant_tally`` / ``backend.link_tally``),
+never per-message Python.
+
+Shape discipline: vote/link batches pad to the next power of two with
+``active=False`` rows and segment counts pad likewise, so the jitted
+kernels see a small lattice of shapes instead of one per (votes, blocks)
+pair (the compile-storm lesson of ROADMAP item 2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+
+def _next_pow2(x: int) -> int:
+    return max(int(2 ** np.ceil(np.log2(max(int(x), 2)))), 2)
+
+
+# --- host twins (the bit-exact oracles) ---------------------------------------
+
+
+def windowed_vote_tally_host(block_idx: np.ndarray, vote_slot: np.ndarray,
+                             weight: np.ndarray, active: np.ndarray,
+                             lo_slot: int, hi_slot: int,
+                             n_blocks: int) -> np.ndarray:
+    """Per-block summed weight of votes inside the expiry window.
+
+    ``block_idx[K]`` int (−1 = no vote), ``vote_slot[K]``, ``weight[K]``
+    (Gwei), ``active[K]`` bool (False = equivocating / slashed / exited).
+    Returns int64[n_blocks]."""
+    block_idx = np.asarray(block_idx, np.int64)
+    vote_slot = np.asarray(vote_slot, np.int64)
+    weight = np.asarray(weight, np.int64)
+    active = np.asarray(active, bool)
+    ok = (active & (block_idx >= 0) & (block_idx < n_blocks)
+          & (vote_slot >= int(lo_slot)) & (vote_slot <= int(hi_slot)))
+    out = np.zeros(n_blocks, np.int64)
+    np.add.at(out, block_idx[ok], weight[ok])
+    return out
+
+
+def link_tally_host(link_idx: np.ndarray, weight: np.ndarray,
+                    active: np.ndarray, n_links: int) -> np.ndarray:
+    """Per-link summed weight (supermajority-link / acknowledgment tally,
+    pos-evolution.md:1626, 1646). ``link_idx[K]`` int (−1 = none).
+    Returns int64[n_links]."""
+    link_idx = np.asarray(link_idx, np.int64)
+    weight = np.asarray(weight, np.int64)
+    active = np.asarray(active, bool)
+    ok = active & (link_idx >= 0) & (link_idx < n_links)
+    out = np.zeros(n_links, np.int64)
+    np.add.at(out, link_idx[ok], weight[ok])
+    return out
+
+
+# --- device twins -------------------------------------------------------------
+#
+# jax imports stay lazy (module-load must not pull jax on the numpy
+# backend — the ops/transition.py convention).
+
+
+def _jit_windowed():
+    import jax
+    jax.config.update("jax_enable_x64", True)  # Gwei sums need int64
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnames=("nb",))
+    def kern(block_idx, vote_slot, weight, active, lo, hi, nb: int):
+        ok = (active & (block_idx >= 0) & (block_idx < nb)
+              & (vote_slot >= lo) & (vote_slot <= hi))
+        seg = jnp.where(ok, block_idx, nb)
+        return jax.ops.segment_sum(
+            jnp.where(ok, weight, 0), seg, num_segments=nb + 1)[:nb]
+
+    return kern
+
+
+_windowed_kern = None
+_link_kern = None
+
+
+def windowed_vote_tally_device(block_idx, vote_slot, weight, active,
+                               lo_slot: int, hi_slot: int,
+                               n_blocks: int) -> np.ndarray:
+    """Jitted twin of ``windowed_vote_tally_host``: pad the vote batch and
+    the block axis to powers of two, one masked segment_sum on device,
+    trim. Bit-identical (int64 adds commute)."""
+    global _windowed_kern
+    import jax.numpy as jnp
+    if _windowed_kern is None:
+        _windowed_kern = _jit_windowed()
+    k = len(np.asarray(block_idx))
+    kp = _next_pow2(max(k, 1))
+    nb = _next_pow2(n_blocks)
+
+    def pad(a, fill, dtype):
+        a = np.asarray(a, dtype)
+        out = np.full(kp, fill, dtype)
+        out[:k] = a
+        return jnp.asarray(out)
+
+    res = _windowed_kern(pad(block_idx, -1, np.int64),
+                         pad(vote_slot, 0, np.int64),
+                         pad(weight, 0, np.int64),
+                         pad(active, False, bool),
+                         jnp.int64(lo_slot), jnp.int64(hi_slot), nb)
+    return np.asarray(res)[:n_blocks]
+
+
+def link_tally_device(link_idx, weight, active, n_links: int) -> np.ndarray:
+    """Jitted twin of ``link_tally_host`` (same padding discipline)."""
+    global _link_kern
+    import jax
+    jax.config.update("jax_enable_x64", True)  # Gwei sums need int64
+    import jax.numpy as jnp
+    if _link_kern is None:
+        @partial(jax.jit, static_argnames=("nl",))
+        def kern(link_idx, weight, active, nl: int):
+            ok = active & (link_idx >= 0) & (link_idx < nl)
+            seg = jnp.where(ok, link_idx, nl)
+            return jax.ops.segment_sum(
+                jnp.where(ok, weight, 0), seg, num_segments=nl + 1)[:nl]
+        _link_kern = kern
+    k = len(np.asarray(link_idx))
+    kp = _next_pow2(max(k, 1))
+    nl = _next_pow2(n_links)
+
+    def pad(a, fill, dtype):
+        a = np.asarray(a, dtype)
+        out = np.full(kp, fill, dtype)
+        out[:k] = a
+        return jnp.asarray(out)
+
+    res = _link_kern(pad(link_idx, -1, np.int64), pad(weight, 0, np.int64),
+                     pad(active, False, bool), nl)
+    return np.asarray(res)[:n_links]

@@ -22,6 +22,7 @@ from pos_evolution_tpu.sim.monitors import (
     FinalityLivenessMonitor,
     ForkChoiceParityMonitor,
     Monitor,
+    VariantSafetyMonitor,
     default_monitors,
 )
 from pos_evolution_tpu.sim.schedule import (

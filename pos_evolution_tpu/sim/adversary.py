@@ -109,7 +109,14 @@ class AdversaryContext:
         return self.sim.groups[group].store
 
     def head(self, group: int = 0) -> bytes:
-        return fc.get_head(self.sim.groups[group].store)
+        """The head as the run's protocol variant computes it (the
+        adversary forks off what honest validators actually follow).
+        Under the Gasper default this stays the spec walk, byte-identical
+        to the pre-seam context."""
+        sim = self.sim
+        if sim.variant.needs_view:
+            return sim.variant.head(sim, sim.groups[group])
+        return fc.get_head(sim.groups[group].store)
 
     def n_groups(self) -> int:
         return len(self.sim.groups)

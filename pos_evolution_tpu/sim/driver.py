@@ -114,6 +114,10 @@ class ViewGroup:
         # blob workload; also attached to ``store.blob_store`` so on_block
         # gates imports on verified sidecars (DESIGN.md §15).
         self.blob_store = None
+        # Protocol-variant overlay (variants/base.VariantVoteLog) when a
+        # successor variant drives the run; mirrored on
+        # ``store.variant_view`` so the handlers feed it (DESIGN.md §16).
+        self.variant_view = None
 
     def enqueue(self, time: float, kind: str, payload,
                 span: str | None = None) -> None:
@@ -270,7 +274,8 @@ class Simulation:
     def __init__(self, n_validators: int, schedule: Schedule | None = None,
                  genesis_time: int = 0, accelerated_forkchoice: bool = False,
                  telemetry=None, profile=None, adversaries=(), monitors=(),
-                 das=None, prewarm: bool = False, compile_cache=None):
+                 das=None, prewarm: bool = False, compile_cache=None,
+                 variant=None):
         self.cfg = cfg()
         self.schedule = schedule or honest_schedule(n_validators)
         self.n_validators = n_validators
@@ -326,6 +331,18 @@ class Simulation:
         state, anchor = make_genesis(n_validators, genesis_time)
         self.genesis_state = state
         self.anchor_root = hash_tree_root(anchor)
+        # Protocol variant (variants/, ROADMAP item 5, DESIGN.md §16):
+        # the fork-choice + finality rules the driver dispatches through.
+        # Default GasperVariant is behavior-identical to the pre-seam
+        # driver (pinned in tests/test_variant_seam.py); Goldfish /
+        # RLMD-GHOST / SSF attach per-view vote overlays to every store.
+        # Like the schedule, the variant is passed again to ``resume``
+        # (or rebuilt from the checkpoint's describe() fingerprint).
+        if variant is None:
+            from pos_evolution_tpu.variants import GasperVariant
+            variant = GasperVariant()
+        self.variant = variant
+        self.variant.bind(self)
         if prewarm:
             from pos_evolution_tpu.backend import get_backend
             if getattr(get_backend(), "name", "") == "jax":
@@ -364,6 +381,10 @@ class Simulation:
                 resident = ResidentForkChoice(store)
             group = ViewGroup(g, store, self.schedule.members(g), resident,
                               telemetry=telemetry)
+            if self.variant.needs_view:
+                view = self.variant.make_view(g)
+                group.variant_view = view
+                store.variant_view = view
             if self.das is not None:
                 from pos_evolution_tpu.das import BlobStore
                 group.blob_store = BlobStore(
@@ -419,10 +440,10 @@ class Simulation:
     def _get_head(self, group: ViewGroup) -> bytes:
         t0 = _time.perf_counter()
         with self.timer.track("get_head"):
-            if group.resident is not None:
-                head = group.resident.head(group.store)
-            else:
-                head = fc.get_head(group.store)
+            # variant seam (DESIGN.md §16): GasperVariant answers from the
+            # resident mirror / spec walk exactly as the pre-seam driver;
+            # successor variants run their expiry-windowed rules
+            head = self.variant.head(self, group)
         if self.telemetry is not None:
             self.telemetry.bus.emit(
                 "handler", handler="get_head", group=group.id,
@@ -656,6 +677,11 @@ class Simulation:
         if group.resident is not None:
             from pos_evolution_tpu.ops.resident import ResidentForkChoice
             group.resident = ResidentForkChoice(store)
+        if self.variant.needs_view:
+            # the process died and its variant overlay with it; the synced
+            # store gets a fresh one and re-earns its vote tables from
+            # backfilled blocks exactly like the carrier's LMD table
+            self.variant.reset_view(group)
 
     # -- duties --
     def _head_state(self, group: ViewGroup, slot: int):
@@ -853,6 +879,11 @@ class Simulation:
         t0 = self.slot_start(slot)
         self._apply_fault_transitions(slot)
         self._tick_all(t0)
+        # Variant merge phase (DESIGN.md §16): the previous slot's votes
+        # just crossed the boundary tick — fold view buffers and process
+        # the completed vote round (fast confirmation, per-slot FFG)
+        # before any of this slot's head queries.
+        self.variant.on_slot_start(self, slot)
         if slot > 0:
             self._adversary_phase("before_propose", slot, t0)
             self._propose(slot)
@@ -862,6 +893,9 @@ class Simulation:
             self._attest(slot)
             self._tick_all(t0 + 2 * self.delta)
             self._adversary_phase("after_attest", slot, t0 + 2 * self.delta)
+        variant_record = self.variant.on_slot_end(self, slot)
+        if variant_record is not None and self.telemetry is not None:
+            self.telemetry.bus.emit("variant", **variant_record)
         self._record_metrics(slot)
         self._run_monitors(slot)
         self._serve_light_clients(slot)
@@ -1173,7 +1207,7 @@ class Simulation:
     @classmethod
     def resume(cls, data: bytes, schedule: Schedule | None = None,
                telemetry=None, adversaries=(), monitors=(),
-               das=None) -> "Simulation":
+               das=None, variant=None) -> "Simulation":
         """Rebuild a checkpointed simulation mid-run. ``schedule`` must be
         the same delivery/fault policy the original run used (schedules
         hold callables, which do not serialize); None resumes an honest
@@ -1188,11 +1222,16 @@ class Simulation:
         episode-START checkpoint — the repro-bundle contract of
         ``scripts/chaos_fuzz.py``. ``das`` re-attaches a BlobEngine: blob
         payloads regenerate from the seed and each view's verified-sidecar
-        set replays, so availability gating resumes where it stopped."""
+        set replays, so availability gating resumes where it stopped.
+        ``variant`` re-attaches a ProtocolVariant; None rebuilds one from
+        the checkpoint's describe() fingerprint (variant state — vote
+        overlays, confirmations, per-slot FFG — is serialized, so a chaos
+        repro bundle replays under the variant that produced it); a
+        mismatched explicit variant raises."""
         from pos_evolution_tpu.utils.snapshot import load_simulation
         return load_simulation(data, schedule=schedule, telemetry=telemetry,
                                adversaries=adversaries, monitors=monitors,
-                               das=das)
+                               das=das, variant=variant)
 
     # -- accessors --
     def store(self, group: int = 0) -> fc.Store:

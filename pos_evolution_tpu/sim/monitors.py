@@ -416,8 +416,175 @@ class ForkChoiceParityMonitor(Monitor):
         return out
 
 
+class VariantSafetyMonitor(Monitor):
+    """Safety auditor for the protocol-variant layer (variants/,
+    DESIGN.md §16) — the accountable-safety theorem at the successor
+    protocols' granularity:
+
+    - **conflicting variant-finalized checkpoints** across live views
+      (SSF per-slot FFG, pos-evolution.md:1626, 1646): two finalized
+      (block, slot) pairs, same slot with different blocks or
+      non-ancestral chains, require two 2/3 quorums — the variant's
+      cross-view evidence log (double per-slot FFG votes,
+      surround-the-ack) must implicate >= 1/3 of stake, else the break
+      is a genuine ``protocol_violation``;
+    - **conflicting same-slot fast confirmations** (:1562-1569): two
+      > 3/4 quorums for different blocks of one slot overlap in >= 1/2 of
+      the eligible voters, all of whom double-voted — same accountable /
+      protocol_violation split.
+
+    Reporting contract: at most one report per (view pair, checkpoint
+    label, verdict kind) — SSF finalizes every slot, so per-checkpoint
+    reporting would flood the audit with one conflict repeated per slot;
+    an ``accountable_fault`` never suppresses a later
+    ``protocol_violation`` (a forged or genuinely unexplained break must
+    surface even after an explained one), and a ``protocol_violation``
+    re-reports once as ``accountable_fault`` when committee rotation
+    accumulates the evidence past the bound (committee-subsampled SSF
+    implicates the adversary round by round).
+
+    Under the Gasper default (no overlay) the monitor is inert; the FFG
+    layer stays ``AccountableSafetyMonitor``'s job."""
+
+    name = "variant_safety"
+
+    def bind(self, sim) -> None:
+        super().bind(sim)
+        self._reported: set = set()      # (label, gi, gj, kind)
+        self._scan_idx: dict = {}        # (label, gi, gj) -> (len_a, len_b)
+        self._first_violation: dict = {} # key -> (ca, cb) awaiting upgrade
+
+    def _archive_descends(self, root: bytes, ancestor: bytes) -> bool:
+        """Chain walk over the global block archive; no slot cutoff — an
+        SSF checkpoint's BLOCK can be older than its checkpoint slot
+        (e.g. the anchor finalized at slot 1), so cutting the walk at
+        the checkpoint slot would declare ancestral same-chain
+        checkpoints conflicting. The walk dead-ends at the anchor
+        (never broadcast, so never archived)."""
+        cur = root
+        while True:
+            if cur == ancestor:
+                return True
+            sb = self.sim.block_archive.get(cur)
+            if sb is None:
+                return False
+            cur = bytes(sb.message.parent_root)
+
+    def _conflicting(self, a: tuple[bytes, int], b: tuple[bytes, int]) -> bool:
+        (ra, sa), (rb, sb) = a, b
+        if ra == rb:
+            return False
+        if sa == sb:
+            return True
+        hi_r = ra if sa > sb else rb
+        lo_r = rb if sa > sb else ra
+        return not self._archive_descends(hi_r, lo_r)
+
+    def _stake_of(self, indices) -> int:
+        reg = self.sim.genesis_state.validators
+        return sum(int(reg.effective_balance[i]) for i in indices
+                   if i < len(reg))
+
+    def _classify(self, stake: int, total: int,
+                  ca: tuple[bytes, int], cb: tuple[bytes, int]) -> tuple:
+        """(kind, scale): a SAME-SLOT conflict was finalized/confirmed by
+        two quorums of that slot's committee — the theorem's bound is
+        1/3 of one slot's committee weight W (the carrier subsamples the
+        paper's full participation; W = total / slots_per_epoch, the
+        same W as proposer boost). Cross-slot conflicts have disjoint
+        committees and keep the full-stake bound."""
+        scale = total
+        if ca[1] == cb[1]:
+            scale = total // cfg().slots_per_epoch
+        kind = ("accountable_fault" if 3 * stake >= scale
+                else "protocol_violation")
+        return kind, scale
+
+    def on_slot_end(self, sim, slot: int) -> list[dict]:
+        variant = getattr(sim, "variant", None)
+        if variant is None or not variant.needs_view:
+            return []
+        out = []
+        live = _live_groups(sim)
+        evidence = variant.slashable()
+        stake = self._stake_of(evidence)
+        total = int(get_total_active_balance(sim.genesis_state))
+        for i in range(len(live)):
+            for j in range(i + 1, len(live)):
+                gi, gj = live[i], live[j]
+                pairs = [("finalized",
+                          variant.finalized_checkpoints(gi.id),
+                          variant.finalized_checkpoints(gj.id)),
+                         ("fast_confirmed",
+                          variant.fast_confirmations(gi.id),
+                          variant.fast_confirmations(gj.id))]
+                for label, cps_a, cps_b in pairs:
+                    key = (label, min(gi.id, gj.id), max(gi.id, gj.id))
+                    # Incremental scan for the append-only finalized
+                    # chains: pairs of already-examined entries never
+                    # re-walk the archive (SSF finalizes per slot — a
+                    # full rescan would be O(slots^2) walks per run).
+                    # fast_confirmed REPLACES its single entry per view,
+                    # so it is always rescanned (length <= 1).
+                    na0 = nb0 = 0
+                    if label == "finalized":
+                        na0, nb0 = self._scan_idx.get(key, (0, 0))
+                        self._scan_idx[key] = (len(cps_a), len(cps_b))
+                    conflicts = []
+                    for ia, ca in enumerate(cps_a):
+                        for jb, cb in enumerate(cps_b):
+                            if ia < na0 and jb < nb0:
+                                continue
+                            if label == "fast_confirmed" \
+                                    and ca[1] != cb[1]:
+                                # fast confirmations of different slots
+                                # on different chains are the normal
+                                # life of competing forks, not a quorum
+                                # overlap
+                                continue
+                            if self._conflicting(ca, cb):
+                                conflicts.append((ca, cb))
+                    # re-classify the first still-unaccountable conflict
+                    # so evidence growth upgrades the verdict once
+                    if key in self._first_violation:
+                        conflicts.append(self._first_violation[key])
+                    for ca, cb in conflicts:
+                        kind, scale = self._classify(stake, total, ca, cb)
+                        if (key + (kind,)) in self._reported:
+                            continue
+                        self._reported.add(key + (kind,))
+                        if kind == "protocol_violation":
+                            self._first_violation.setdefault(key, (ca, cb))
+                        else:
+                            self._first_violation.pop(key, None)
+                        accountable = kind == "accountable_fault"
+                        out.append({
+                            "monitor": self.name,
+                            "kind": kind,
+                            "variant": variant.name,
+                            "checkpoint": label,
+                            "groups": [gi.id, gj.id],
+                            "slots": [ca[1], cb[1]],
+                            "roots": [ca[0].hex()[:16], cb[0].hex()[:16]],
+                            "evidence_size": len(evidence),
+                            "slashable_stake": stake,
+                            "total_stake": total,
+                            "accountability_scale": scale,
+                            "detail": (
+                                f"conflicting {label} variant checkpoints "
+                                f"({variant.name}) between groups "
+                                f"{gi.id}/{gj.id}; variant evidence covers "
+                                f"{stake}/{scale} accountable-scale stake"
+                                + ("" if accountable else
+                                   " — BELOW the 1/3 accountable-safety"
+                                   " bound")),
+                        })
+        return out
+
+
 def default_monitors(accountable_broadcast: bool = True) -> list[Monitor]:
     """The full audit stack (chaos fuzzing default)."""
     return [AccountableSafetyMonitor(broadcast_evidence=accountable_broadcast),
             FinalityLivenessMonitor(),
-            ForkChoiceParityMonitor()]
+            ForkChoiceParityMonitor(),
+            VariantSafetyMonitor()]

@@ -68,6 +68,13 @@ class Store:
     # it is a live per-view object, never serialized (the driver reattaches
     # it on resume).
     blob_store: object = None
+    # Protocol-variant overlay (variants/base.VariantVoteLog): when a
+    # successor variant (Goldfish/RLMD-GHOST/SSF, DESIGN.md §16) drives the
+    # simulation, the handlers notify it of every applied vote POST-commit
+    # so the variant's slot-granular tables stay exactly in sync with this
+    # view — gossip, block-carried and backfilled attestations alike. None
+    # (the Gasper default) keeps the handlers byte-identical to the spec.
+    variant_view: object = None
 
 
 def get_forkchoice_store(anchor_state: BeaconState, anchor_block: BeaconBlock,
@@ -348,6 +355,14 @@ def on_attestation(store: Store, attestation: Attestation,
     if commit_checkpoint_state is not None:
         store.checkpoint_states[target_key] = commit_checkpoint_state
     update_latest_messages(store, indexed_attestation.attesting_indices, attestation)
+    if store.variant_view is not None:
+        # variant overlay (DESIGN.md §16): slot-granular vote record for
+        # the expiry-windowed successor protocols — post-commit, so a
+        # rejected attestation never reaches the overlay
+        store.variant_view.note_vote(
+            indexed_attestation.attesting_indices,
+            int(attestation.data.slot),
+            bytes(attestation.data.beacon_block_root))
     return indexed_attestation.attesting_indices
 
 
@@ -566,4 +581,8 @@ def on_attester_slashing(store: Store, attester_slashing: AttesterSlashing):
         & set(int(i) for i in np.asarray(a2.attesting_indices))
     for index in indices:
         store.equivocating_indices.add(index)
+    if store.variant_view is not None:
+        # variant overlays discount slasher-evidenced equivocators too
+        # (pos-evolution.md:1438)
+        store.variant_view.note_equivocators(indices)
     return indices

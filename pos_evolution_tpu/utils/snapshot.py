@@ -192,6 +192,14 @@ def save_simulation(sim) -> bytes:
         # match any block's graffiti: the chain stalls silently forever).
         "das": (sim.das.describe()
                 if getattr(sim, "das", None) is not None else None),
+        # Protocol variant (variants/, DESIGN.md §16): the describe()
+        # fingerprint plus the full variant state (per-view vote
+        # overlays, fast/kappa confirmations, per-slot FFG checkpoints
+        # and evidence logs), so a resumed run — including a chaos repro
+        # bundle — replays under the variant that produced it. Absent on
+        # pre-seam checkpoints, which resume as Gasper.
+        "variant": sim.variant.describe(),
+        "variant_state": sim.variant.state_blob(sim),
         "groups": [{
             "id": g.id,
             "seq": g._seq,
@@ -228,7 +236,7 @@ def save_simulation(sim) -> bytes:
 
 
 def load_simulation(data: bytes, schedule=None, telemetry=None,
-                    adversaries=(), monitors=(), das=None):
+                    adversaries=(), monitors=(), das=None, variant=None):
     """Rebuild a ``save_simulation`` checkpoint into a live Simulation.
     ``schedule`` must be the run's original Schedule (with its FaultPlan)
     for faithful replay; crash flags re-derive from the plan + slot.
@@ -290,6 +298,26 @@ def load_simulation(data: bytes, schedule=None, telemetry=None,
             g.resident.incidents = (list(rm.get("incidents", []))
                                     + g.resident.incidents)
             g.resident._head_queries = rm.get("head_queries", 0)
+    # Protocol variant: rebuild from the checkpoint's fingerprint when the
+    # caller passes none (describe() round-trips via variant_from_config);
+    # an explicit variant must match — a silently different rule would
+    # replay a different protocol under the same evidence.
+    from pos_evolution_tpu.variants import variant_from_config
+    meta_variant = meta.get("variant")
+    if variant is None:
+        variant = variant_from_config(meta_variant)
+    elif meta_variant is not None and variant.describe() != meta_variant:
+        raise ValueError(
+            f"resumed variant {variant.describe()} does not match the "
+            f"checkpointed variant {meta_variant}")
+    sim.variant = variant
+    variant.bind(sim)
+    if variant.needs_view:
+        for g in sim.groups:
+            view = variant.make_view(g.id)
+            g.variant_view = view
+            g.store.variant_view = view
+        variant.restore_blob(sim, meta.get("variant_state", {}))
     if telemetry is not None:
         # attach to the fully restored run: groups get the bus, the debug
         # checker anchors on the RESTORED stores, the fault sink is

@@ -59,8 +59,12 @@ _D_FAULTS, _D_CRASH, _D_STRAT, _D_PARAM = 10, 11, 12, 13
 # -- episode composition (pure function of seed + episode index) ---------------
 
 def episode_config(seed: int, episode: int, n_validators: int = 64,
-                   n_slots: int = 24, doctor: bool = False) -> dict:
-    """Derive one episode's full composition from (seed, episode) alone."""
+                   n_slots: int = 24, doctor: bool = False,
+                   variant: str = "gasper") -> dict:
+    """Derive one episode's full composition from (seed, episode) alone
+    (the protocol variant is part of the composition: every episode
+    replays under the variant that produced it)."""
+    from pos_evolution_tpu.variants import VARIANTS
     u = lambda dom, k: stateless_unit(seed, dom, episode, k)  # noqa: E731
     cfg = {
         "schema": SCHEMA,
@@ -69,6 +73,7 @@ def episode_config(seed: int, episode: int, n_validators: int = 64,
         "n_validators": int(n_validators),
         "n_slots": int(n_slots),
         "n_groups": 2,
+        "variant": VARIANTS[variant]().describe(),
         "monitors": {"accountable_broadcast": True,
                      # a <1/3-Byzantine faulted run legitimately trails
                      # 2-3 epochs post-GST (see DESIGN.md §13); the bound
@@ -143,8 +148,11 @@ def episode_config(seed: int, episode: int, n_validators: int = 64,
             cursor += k
     cfg["adversaries"] = adversaries
     if doctor:
-        cfg["doctor"] = {"slot": min(n_slots - 2, max(4, n_slots // 2)),
-                         "epoch": 1}
+        # strictly after every crash window's rejoin (rejoin <= n_slots-3
+        # by construction above): a rejoin checkpoint-syncs a fresh store
+        # and variant view, which would silently ERASE an earlier forgery
+        # and turn the negative into a false pass
+        cfg["doctor"] = {"slot": n_slots - 2, "epoch": 1}
     return cfg
 
 
@@ -206,13 +214,15 @@ def build_monitors(cfg: dict) -> list:
         AccountableSafetyMonitor,
         FinalityLivenessMonitor,
         ForkChoiceParityMonitor,
+        VariantSafetyMonitor,
     )
     m = cfg.get("monitors", {})
     return [AccountableSafetyMonitor(
                 broadcast_evidence=m.get("accountable_broadcast", True)),
             FinalityLivenessMonitor(
                 bound_epochs=m.get("liveness_bound_epochs", 6)),
-            ForkChoiceParityMonitor()]
+            ForkChoiceParityMonitor(),
+            VariantSafetyMonitor()]
 
 
 def _doctor_stores(sim, epoch: int) -> None:
@@ -235,29 +245,37 @@ def run_episode(cfg: dict, events_path: str | None = None,
     constructing fresh — the replay contract."""
     from pos_evolution_tpu.sim.driver import Simulation
     from pos_evolution_tpu.telemetry import Telemetry
+    from pos_evolution_tpu.variants import variant_from_config
 
     telemetry = (Telemetry.to_file(events_path)
                  if events_path is not None else None)
     adversaries = build_adversaries(cfg)
     monitors = build_monitors(cfg)
     schedule = build_schedule(cfg)
+    variant = variant_from_config(cfg.get("variant"))
     try:
         if resume_from is not None:
             sim = Simulation.resume(resume_from, schedule=schedule,
                                     telemetry=telemetry,
                                     adversaries=adversaries,
-                                    monitors=monitors)
+                                    monitors=monitors, variant=variant)
             checkpoint = resume_from
         else:
             sim = Simulation(cfg["n_validators"], schedule=schedule,
                              telemetry=telemetry, adversaries=adversaries,
-                             monitors=monitors)
+                             monitors=monitors, variant=variant)
             checkpoint = sim.checkpoint()
         doctor = cfg.get("doctor")
         while sim.slot <= cfg["n_slots"]:
             sim.run_slot()
             if doctor is not None and sim.slot - 1 == doctor["slot"]:
-                _doctor_stores(sim, doctor["epoch"])
+                # variant-level forgery first (conflicting variant
+                # finality / fast confirmations — the per-variant
+                # negative); variants with no forgeable surface (Gasper,
+                # RLMD) fall back to the FFG store doctor, which the
+                # AccountableSafetyMonitor must catch under EVERY variant
+                if not sim.variant.doctor(sim, doctor["slot"]):
+                    _doctor_stores(sim, doctor["epoch"])
     finally:
         # a crashed episode must not leak the JSONL handle (the partial
         # log itself is the caller's to keep or remove)
@@ -372,17 +390,19 @@ def replay_bundle(bundle: str) -> dict:
 
 def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
          out_dir: str, doctor: bool = False, do_shrink: bool = True,
-         step_timeout: float | None = None, episode_indices=None) -> dict:
+         step_timeout: float | None = None, episode_indices=None,
+         variant: str = "gasper") -> dict:
     from pos_evolution_tpu.utils.watchdog import Watchdog
     os.makedirs(out_dir, exist_ok=True)
     wd = Watchdog(path=os.path.join(out_dir, "chaos_partial.json"),
                   tag="chaos_fuzz", timeout_s=step_timeout)
     summary = {"episodes": 0, "violating": 0, "bundles": [],
-               "incidents": 0}
+               "incidents": 0, "variant": variant, "accountable": 0}
     indices = (range(episodes) if episode_indices is None
                else episode_indices)
     for ep in indices:
-        cfg = episode_config(seed, ep, n_validators, n_slots, doctor=doctor)
+        cfg = episode_config(seed, ep, n_validators, n_slots, doctor=doctor,
+                             variant=variant)
         events_path = os.path.join(out_dir, f"ep{ep}.events.jsonl")
         result = wd.step(f"episode_{ep}", run_episode, cfg,
                          events_path=events_path)
@@ -392,13 +412,26 @@ def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
             if os.path.exists(events_path):
                 os.remove(events_path)  # partial log of a dead episode
             continue
+        # An accountable_fault is the protocol SURVIVING as designed —
+        # the adversary bought a break by burning >= 1/3 of the relevant
+        # quorum's stake into slashing evidence (committee-subsampled
+        # SSF can be double-finalized per slot at exactly that price).
+        # It is explained, bundled for audit, and does NOT fail the
+        # sweep; anything else is an unexplained violation and does.
+        unexplained = [v for v in result["violations"]
+                       if v.get("kind") != "accountable_fault"]
         if result["violations"]:
-            summary["violating"] += 1
             bundle = write_bundle(out_dir, cfg, result, events_path,
-                                  do_shrink=do_shrink)
+                                  do_shrink=do_shrink and bool(unexplained))
             summary["bundles"].append(bundle)
-            print(f"episode {ep}: {len(result['violations'])} violation(s) "
-                  f"-> {bundle}")
+        if unexplained:
+            summary["violating"] += 1
+            print(f"episode {ep}: {len(unexplained)} unexplained "
+                  f"violation(s) -> {bundle}")
+        elif result["violations"]:
+            summary["accountable"] += 1
+            print(f"episode {ep}: {len(result['violations'])} accountable "
+                  f"fault(s), evidence bundled -> {bundle}")
         else:
             if os.path.exists(events_path):
                 os.remove(events_path)
@@ -422,6 +455,11 @@ def main(argv=None) -> int:
     ap.add_argument("--no-shrink", action="store_true")
     ap.add_argument("--step-timeout", type=float, default=None,
                     help="watchdog per-episode timeout (seconds)")
+    ap.add_argument("--variant", default="gasper",
+                    choices=("gasper", "goldfish", "rlmd", "ssf", "all"),
+                    help="protocol variant to fuzz under (DESIGN.md §16); "
+                         "'all' sweeps every variant into per-variant "
+                         "subdirectories")
     ap.add_argument("--replay", metavar="BUNDLE",
                     help="replay a repro bundle and verify the violation")
     args = ap.parse_args(argv)
@@ -432,18 +470,28 @@ def main(argv=None) -> int:
             print(json.dumps({"match": out["match"],
                               "replayed": out["replayed"]}, indent=1))
             return 0 if out["match"] else 1
-        summary = fuzz(args.episodes, args.seed, args.validators, args.slots,
-                       args.out, doctor=args.doctor,
-                       do_shrink=not args.no_shrink,
-                       step_timeout=args.step_timeout)
-        print(json.dumps({k: summary[k] for k in
-                          ("episodes", "violating", "incidents")}, indent=1))
-        if args.doctor:
-            # the doctored run MUST trip the safety monitor
-            return 0 if summary["violating"] > 0 else 1
-        # an episode that hung or crashed verified nothing — a clean
-        # verdict requires every episode to have actually run
-        return 1 if (summary["violating"] or summary["incidents"]) else 0
+        variants = (("gasper", "goldfish", "rlmd", "ssf")
+                    if args.variant == "all" else (args.variant,))
+        rc = 0
+        for name in variants:
+            out_dir = (args.out if len(variants) == 1
+                       else os.path.join(args.out, name))
+            summary = fuzz(args.episodes, args.seed, args.validators,
+                           args.slots, out_dir, doctor=args.doctor,
+                           do_shrink=not args.no_shrink,
+                           step_timeout=args.step_timeout, variant=name)
+            print(json.dumps({k: summary[k] for k in
+                              ("variant", "episodes", "violating",
+                               "accountable", "incidents")}, indent=1))
+            if args.doctor:
+                # the doctored run MUST trip a safety monitor, per variant
+                rc |= 0 if summary["violating"] > 0 else 1
+            else:
+                # an episode that hung or crashed verified nothing — a
+                # clean verdict requires every episode to have actually run
+                rc |= 1 if (summary["violating"]
+                            or summary["incidents"]) else 0
+        return rc
 
 
 if __name__ == "__main__":

@@ -233,6 +233,38 @@ def build_report(events: list[dict], top_ops: dict | None = None,
             "scheme": (attach.get("engine") or {}).get("scheme"),
         }
 
+    # -- variant audit (variants/ per-slot records + variant_safety) ----------
+    variant_events = by_type.get("variant", [])
+    variant_audit = None
+    if variant_events:
+        last = variant_events[-1]
+        groups = {}
+        for gid, row in (last.get("groups") or {}).items():
+            groups[gid] = {k: row.get(k) for k in
+                           ("head_slot", "confirmed_slot",
+                            "fast_confirmed_slot", "justified_slot",
+                            "finalized_slot", "n_finalized",
+                            "equivocators") if row.get(k) is not None}
+        fast_confirms = sum(
+            1 for e in variant_events
+            for row in (e.get("groups") or {}).values()
+            if row.get("fast_confirmed_slot") == e.get("slot") - 1)
+        variant_audit = {
+            "variant": last.get("variant"),
+            "slots_recorded": len(variant_events),
+            "final": groups,
+            "fast_confirmations": fast_confirms,
+            "slashable_evidence": last.get("slashable_evidence", 0),
+            "violations": [
+                {k: e.get(k) for k in ("slot", "kind", "checkpoint",
+                                       "groups", "slots", "roots",
+                                       "evidence_size", "slashable_stake",
+                                       "accountability_scale", "detail")
+                 if e.get(k) is not None}
+                for e in by_type.get("monitor", [])
+                if e.get("monitor") == "variant_safety"],
+        }
+
     # -- property audit (sim/monitors.py verdicts + invariant checker) --------
     attach = (by_type.get("monitor_attach") or [{}])[0]
     violations = [
@@ -287,6 +319,8 @@ def build_report(events: list[dict], top_ops: dict | None = None,
         report["merkleization"] = merkleization
     if das_serving:
         report["das_serving"] = das_serving
+    if variant_audit:
+        report["variant_audit"] = variant_audit
     if top_ops:
         report["top_device_ops"] = top_ops
     if cost:
@@ -388,6 +422,37 @@ def to_markdown(report: dict) -> str:
             md.append(f"  - {iv}")
     if audit.get("repro_bundle"):
         md.append(f"- repro bundle: `{audit['repro_bundle']}`")
+
+    if report.get("variant_audit"):
+        va = report["variant_audit"]
+        md += ["", "## Variant audit", ""]
+        md.append(f"- protocol variant: **{va.get('variant')}** "
+                  f"({va.get('slots_recorded')} slots recorded)")
+        md.append(f"- fast confirmations: {va.get('fast_confirmations', 0)}")
+        if va.get("slashable_evidence"):
+            md.append(f"- variant slashing evidence: "
+                      f"{va['slashable_evidence']} validator(s)")
+        if va.get("final"):
+            md += ["", *_md_table(
+                ["group", "head slot", "confirmed", "fast-confirmed",
+                 "justified", "finalized"],
+                [[gid, row.get("head_slot", ""),
+                  row.get("confirmed_slot", ""),
+                  row.get("fast_confirmed_slot", ""),
+                  row.get("justified_slot", ""),
+                  row.get("finalized_slot", "")]
+                 for gid, row in sorted(va["final"].items())])]
+        if va.get("violations"):
+            md += ["", *_md_table(
+                ["slot", "kind", "checkpoint", "evidence",
+                 "slashable/scale stake"],
+                [[v.get("slot"), v.get("kind"), v.get("checkpoint"),
+                  v.get("evidence_size", ""),
+                  (f"{v['slashable_stake']}/{v['accountability_scale']}"
+                   if "slashable_stake" in v else "")]
+                 for v in va["violations"]])]
+        else:
+            md.append("- no variant-safety violations")
 
     if report.get("merkleization"):
         merk = report["merkleization"]

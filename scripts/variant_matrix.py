@@ -1,0 +1,482 @@
+"""Variant x attack verdict matrix: the per-variant audit demo
+(ROADMAP item 5, DESIGN.md §16).
+
+Runs the PR-5 attack strategies through the production driver under every
+protocol variant (variants/) and records which attacks the paper says
+each successor defeats actually failing — and the ones it does not still
+succeeding against Gasper:
+
+- **balancer** (swayer vote balancing, pos-evolution.md:1321-1348):
+  must hold the two views split for all of epoch 0 against pre-boost
+  Gasper; Goldfish's eta = 1 expiry (:1549) and RLMD's view-merge
+  buffers (:1540) must break the tie.
+- **exante** (multi-slot withholding ex-ante reorg, :1503-1526): the
+  banked private votes reorg the honest slot-3 block under pre-boost
+  Gasper; Goldfish expiry, RLMD view-merge and SSF fast confirmation
+  (:1562-1569) must keep it canonical.
+- **splitvoter** (the accountable-safety worst case, :233-238): under a
+  total partition with exactly 1/3 double-voting stake, finality — FFG
+  (epochs) or SSF's per-slot gadget (:1626, :1646) — must die
+  *accountably*: >= 1/3 of stake implicated by slashing evidence.
+  Goldfish/RLMD have no finality gadget; their kappa-deep confirmations
+  diverge unaccountably, the motivation the paper gives for SSF.
+- **equivocator** (evidence generator, :233-238, 1154-1156): must be
+  neutralized by discounting under EVERY variant (no safety violation,
+  evidence captured).
+
+Every violating cell writes a replayable repro bundle (config +
+episode-start checkpoint + violations + events) and ``--replay`` must
+reproduce the verdict — the chaos-fuzz contract, per variant.
+
+Usage:
+    python scripts/variant_matrix.py --out variant_out/ \
+        --json VARIANT_MATRIX_r08.json --history bench_history.jsonl
+    python scripts/variant_matrix.py --replay variant_out/bundle_splitvoter_ssf/
+    python scripts/variant_matrix.py --scenarios balancer --variants gasper,goldfish
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pos_evolution_tpu.config import minimal_config, use_config  # noqa: E402
+
+SCHEMA = 1
+SCENARIOS = ("balancer", "exante", "splitvoter", "equivocator")
+VARIANT_NAMES = ("gasper", "goldfish", "rlmd", "ssf")
+
+# Paper-pinned expectations: True = the attack must succeed, False = the
+# variant must defeat it, None = report the measured verdict only.
+EXPECTED = {
+    ("balancer", "gasper"): True,      # pre-boost Gasper falls (:1330)
+    ("balancer", "goldfish"): False,   # eta=1 expiry kills the banks (:1549)
+    ("balancer", "rlmd"): False,       # view-merge kills the sway (:1540)
+    ("balancer", "ssf"): False,
+    ("exante", "gasper"): True,        # no boost, banked votes win (:1503)
+    ("exante", "goldfish"): False,
+    ("exante", "rlmd"): False,
+    ("exante", "ssf"): False,          # fast confirmation anchors B3 (:1568)
+    # splitvoter: safety under partition + 1/3 is impossible for every
+    # protocol; the CLAIM is accountability (>= 1/3 implicated) where a
+    # finality gadget exists.
+    ("splitvoter", "gasper"): True,
+    ("splitvoter", "ssf"): True,
+    ("splitvoter", "goldfish"): None,
+    ("splitvoter", "rlmd"): None,
+    ("equivocator", "gasper"): False,
+    ("equivocator", "goldfish"): False,
+    ("equivocator", "rlmd"): False,
+    ("equivocator", "ssf"): False,
+}
+
+# balancer / exante target pre-boost Gasper (the mainline W/4 boost is
+# the Gasper-side fix, exercised in sim/attacks.py); the other cells run
+# the stock minimal preset.
+_BOOST0 = ("balancer", "exante")
+
+
+def _active_config(scenario):
+    c = minimal_config()
+    return c.replace(proposer_score_boost_percent=0) \
+        if scenario in _BOOST0 else c
+
+
+def _chain_contains(store, head: bytes, root: bytes) -> bool:
+    cur = head
+    while cur in store.blocks:
+        if cur == root:
+            return True
+        nxt = bytes(store.blocks[cur].parent_root)
+        if nxt == cur:
+            return False
+        cur = nxt
+    return False
+
+
+def _variant_head(sim, group_idx: int) -> bytes:
+    from pos_evolution_tpu.specs import forkchoice as fc
+    v = sim.variant
+    if v.needs_view:
+        return v.head(sim, sim.groups[group_idx])
+    return fc.get_head(sim.store(group_idx))
+
+
+# -- scenario builders (pure functions of the active config) -------------------
+
+
+def _inputs_balancer():
+    from pos_evolution_tpu.config import cfg
+    from pos_evolution_tpu.sim import Balancer
+    from pos_evolution_tpu.sim.attacks import (
+        committee_balanced_split_schedule,
+    )
+    from pos_evolution_tpu.specs.genesis import make_genesis
+    from pos_evolution_tpu.specs.helpers import get_beacon_proposer_index
+    from pos_evolution_tpu.specs.validator import advance_state_to_slot
+    n = 64
+    state, _ = make_genesis(n)
+    corrupted = set(range(int(n * 0.3)))
+    corrupted.add(int(get_beacon_proposer_index(
+        advance_state_to_slot(state, 1))))
+    return {"n": n,
+            "schedule": committee_balanced_split_schedule(n, corrupted),
+            "adversaries": [Balancer(corrupted)],
+            "n_slots": cfg().slots_per_epoch,
+            "early_exit": None}
+
+
+def _inputs_exante():
+    from pos_evolution_tpu.sim import Withholder
+    from pos_evolution_tpu.sim.adversary import slot_committee
+    from pos_evolution_tpu.specs.genesis import make_genesis
+    from pos_evolution_tpu.specs.helpers import get_beacon_proposer_index
+    from pos_evolution_tpu.specs.validator import advance_state_to_slot
+    n = 64
+    state, _ = make_genesis(n)
+    honest_proposers = {
+        int(get_beacon_proposer_index(advance_state_to_slot(state, s)))
+        for s in (1, 3, 4)}
+    proposer2 = int(get_beacon_proposer_index(
+        advance_state_to_slot(state, 2)))
+    c2 = [int(v) for v in slot_committee(advance_state_to_slot(state, 2), 2)
+          if int(v) not in honest_proposers][:7]
+    c3 = [int(v) for v in slot_committee(advance_state_to_slot(state, 3), 3)
+          if int(v) not in honest_proposers][:1]
+    controlled = set(c2) | set(c3) | {proposer2}
+    assert not (controlled & honest_proposers), \
+        "scenario needs honest proposers at slots 1/3/4"
+    return {"n": n, "schedule": None,
+            "adversaries": [Withholder(
+                controlled=controlled, fork_slot=2, release_slot=4,
+                release_phase="before_attest", vote_slots=(2, 3),
+                private_attesters={2: c2, 3: c3})],
+            "n_slots": 5, "early_exit": None}
+
+
+def _inputs_splitvoter():
+    from pos_evolution_tpu.config import cfg
+    from pos_evolution_tpu.sim import SplitVoter
+    from pos_evolution_tpu.sim.attacks import split_brain_schedule
+    n = 48
+    controlled = set(range(n // 3))
+    return {"n": n, "schedule": split_brain_schedule(n, controlled),
+            "adversaries": [SplitVoter(controlled)],
+            "n_slots": 6 * cfg().slots_per_epoch,
+            "early_exit": "accountable_finalized"}
+
+
+def _inputs_equivocator():
+    from pos_evolution_tpu.config import cfg
+    from pos_evolution_tpu.sim import Equivocator
+    from pos_evolution_tpu.sim.adversary import slot_committee
+    from pos_evolution_tpu.specs.genesis import make_genesis
+    from pos_evolution_tpu.specs.helpers import get_beacon_proposer_index
+    from pos_evolution_tpu.specs.validator import advance_state_to_slot
+    n = 64
+    state, _ = make_genesis(n)
+    proposer2 = int(get_beacon_proposer_index(
+        advance_state_to_slot(state, 2)))
+    c2 = [int(v) for v in
+          slot_committee(advance_state_to_slot(state, 2), 2)[:3]]
+    return {"n": n, "schedule": None,
+            "adversaries": [Equivocator(set(c2) | {proposer2})],
+            "n_slots": 2 * cfg().slots_per_epoch, "early_exit": None}
+
+
+_INPUTS = {"balancer": _inputs_balancer, "exante": _inputs_exante,
+           "splitvoter": _inputs_splitvoter,
+           "equivocator": _inputs_equivocator}
+
+
+def _finalized_conflicts(sim):
+    return [v for v in sim.monitor_violations
+            if v.get("checkpoint") == "finalized"]
+
+
+def _evidence_stake(sim) -> tuple[int, int]:
+    """(slashable stake, total stake) from the union of the variant's
+    cross-view evidence log and the FFG slasher's implicated set."""
+    from pos_evolution_tpu.specs.helpers import get_total_active_balance
+    ev = set(sim.variant.slashable())
+    for m in sim.monitors:
+        ev |= getattr(m, "implicated", set())
+    reg = sim.genesis_state.validators
+    stake = sum(int(reg.effective_balance[i]) for i in ev if i < len(reg))
+    return stake, int(get_total_active_balance(sim.genesis_state))
+
+
+def _verdict(scenario: str, sim, inputs: dict) -> dict:
+    v = sim.variant
+    out: dict = {}
+    if scenario == "balancer":
+        h0, h1 = _variant_head(sim, 0), _variant_head(sim, 1)
+        out["views_split_at_end"] = h0 != h1
+        out["attack_succeeded"] = out["views_split_at_end"]
+        if v.name == "ssf" and _finalized_conflicts(sim):
+            # known subsampling artifact, reported honestly: the paper's
+            # SSF assumes FULL per-slot participation; the carrier's
+            # rotating committees let the balancer's targeted-delivery
+            # asynchrony build one-sided committee quorums, so per-slot
+            # finality can conflict with sub-1/3 evidence even while the
+            # fork-choice tie is broken. The violation + repro bundle
+            # document exactly this gap (DESIGN.md §16).
+            out["note"] = ("committee-subsampled finality conflict under "
+                           "targeted-delivery asynchrony (evidence below "
+                           "1/3) — the cost of subsampling full-"
+                           "participation SSF; see the repro bundle")
+    elif scenario == "exante":
+        store = sim.store(0)
+        strat = inputs["adversaries"][0]
+        head = _variant_head(sim, 0)
+        (r3,) = [r for r, b in store.blocks.items() if int(b.slot) == 3]
+        out["b3_reorged"] = not _chain_contains(store, head, r3)
+        out["b2_canonical"] = (bool(strat.chain.blocks)
+                               and _chain_contains(store, head,
+                                                   strat.chain.tip))
+        out["attack_succeeded"] = out["b3_reorged"]
+    elif scenario == "splitvoter":
+        fin = _finalized_conflicts(sim)
+        stake, total = _evidence_stake(sim)
+        out["finalized_conflict"] = bool(fin)
+        out["max_evidence_stake_ratio"] = round(stake / total, 4)
+        # the theorem's promise: the break is attributable to >= 1/3 of
+        # TOTAL stake (committee rotation accumulates the SSF evidence)
+        out["accountable"] = (bool(fin)
+                              and any(x["kind"] == "accountable_fault"
+                                      for x in fin)
+                              and 3 * stake >= total)
+        conf = {g.id: v.confirmed.get(g.id) for g in sim.groups} \
+            if v.needs_view else {}
+        out["confirmation_diverged"] = (
+            len({c[0] for c in conf.values() if c}) > 1)
+        out["attack_succeeded"] = (out["finalized_conflict"]
+                                   or out["confirmation_diverged"])
+    elif scenario == "equivocator":
+        safety = [x for x in sim.monitor_violations
+                  if x["kind"] in ("accountable_fault",
+                                   "protocol_violation")]
+        out["safety_violations"] = len(safety)
+        mon = next(m for m in sim.monitors
+                   if getattr(m, "name", "") == "accountable_safety")
+        out["slasher_implicated"] = len(mon.implicated)
+        out["attack_succeeded"] = bool(safety)
+    out["violations"] = len(sim.monitor_violations)
+    out["finalized_epochs"] = [sim.finalized_epoch(g)
+                               for g in range(len(sim.groups))]
+    return out
+
+
+def run_cell(scenario: str, variant_name: str, events_path: str | None = None,
+             resume_from: bytes | None = None) -> dict:
+    """One (scenario, variant) cell through the production driver.
+    Deterministic: the same cell always produces the same verdict, and
+    ``resume_from`` replays it from a bundle's checkpoint."""
+    from pos_evolution_tpu.sim import (
+        AccountableSafetyMonitor,
+        Simulation,
+        VariantSafetyMonitor,
+    )
+    from pos_evolution_tpu.telemetry import Telemetry
+    from pos_evolution_tpu.variants import VARIANTS
+    with use_config(_active_config(scenario)):
+        inputs = _INPUTS[scenario]()
+        variant = VARIANTS[variant_name]()
+        monitors = [AccountableSafetyMonitor(), VariantSafetyMonitor()]
+        telemetry = (Telemetry.to_file(events_path)
+                     if events_path is not None else None)
+        t0 = time.perf_counter()
+        try:
+            if resume_from is not None:
+                sim = Simulation.resume(
+                    resume_from, schedule=inputs["schedule"],
+                    telemetry=telemetry, adversaries=inputs["adversaries"],
+                    monitors=monitors, variant=variant)
+                checkpoint = resume_from
+            else:
+                sim = Simulation(inputs["n"], schedule=inputs["schedule"],
+                                 adversaries=inputs["adversaries"],
+                                 monitors=monitors, variant=variant,
+                                 telemetry=telemetry)
+                checkpoint = sim.checkpoint()
+            while sim.slot <= inputs["n_slots"]:
+                sim.run_slot()
+                if inputs["early_exit"] == "accountable_finalized" \
+                        and _finalized_conflicts(sim):
+                    stake, total = _evidence_stake(sim)
+                    if 3 * stake >= total:
+                        break
+            verdict = _verdict(scenario, sim, inputs)
+        finally:
+            if telemetry is not None:
+                telemetry.close()
+        wall = time.perf_counter() - t0
+        summary = sim.trace_summary().get("get_head", {})
+        verdict.update({
+            "scenario": scenario, "variant": variant_name,
+            "expected_attack_success": EXPECTED.get((scenario,
+                                                     variant_name)),
+            "wall_s": round(wall, 3),
+            "get_head_p50_ms": summary.get("p50_ms"),
+            "get_head_p95_ms": summary.get("p95_ms"),
+            "slots_run": sim.slot,
+        })
+        exp = verdict["expected_attack_success"]
+        verdict["matches_expectation"] = (
+            None if exp is None else verdict["attack_succeeded"] == exp)
+        return {"verdict": verdict, "checkpoint": checkpoint,
+                "violations": sim.monitor_violations,
+                "variant_config": variant.describe()}
+
+
+# -- bundles -------------------------------------------------------------------
+
+
+def write_bundle(out_dir: str, scenario: str, variant_name: str,
+                 result: dict, events_src: str | None) -> str:
+    import shutil
+    bundle = os.path.join(out_dir, f"bundle_{scenario}_{variant_name}")
+    os.makedirs(bundle, exist_ok=True)
+    with open(os.path.join(bundle, "config.json"), "w") as fh:
+        json.dump({"schema": SCHEMA, "scenario": scenario,
+                   "variant_name": variant_name,
+                   "variant": result["variant_config"],
+                   "verdict": result["verdict"]},
+                  fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    with open(os.path.join(bundle, "checkpoint.bin"), "wb") as fh:
+        fh.write(result["checkpoint"])
+    with open(os.path.join(bundle, "violations.json"), "w") as fh:
+        json.dump(result["violations"], fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    if events_src and os.path.exists(events_src):
+        shutil.move(events_src, os.path.join(bundle, "events.jsonl"))
+    return bundle
+
+
+def replay_bundle(bundle: str) -> dict:
+    """Re-run a cell from its bundle checkpoint via ``Simulation.resume``
+    (under the variant that produced it) and compare verdict +
+    violations against the recorded ones."""
+    with open(os.path.join(bundle, "config.json")) as fh:
+        cfg = json.load(fh)
+    with open(os.path.join(bundle, "checkpoint.bin"), "rb") as fh:
+        checkpoint = fh.read()
+    with open(os.path.join(bundle, "violations.json")) as fh:
+        recorded = json.load(fh)
+    result = run_cell(cfg["scenario"], cfg["variant_name"],
+                      resume_from=checkpoint)
+    key = lambda v: (v.get("slot"), v["monitor"], v["kind"])  # noqa: E731
+    match = (sorted(map(key, result["violations"]))
+             == sorted(map(key, recorded))
+             and result["verdict"]["attack_succeeded"]
+             == cfg["verdict"]["attack_succeeded"])
+    return {"match": match, "replayed": result["verdict"],
+            "recorded": cfg["verdict"]}
+
+
+# -- matrix driver -------------------------------------------------------------
+
+
+def run_matrix(scenarios, variants, out_dir: str,
+               events: bool = True) -> dict:
+    os.makedirs(out_dir, exist_ok=True)
+    rows = []
+    bundles = []
+    for scenario in scenarios:
+        for variant_name in variants:
+            events_path = (os.path.join(
+                out_dir, f"{scenario}_{variant_name}.events.jsonl")
+                if events else None)
+            result = run_cell(scenario, variant_name,
+                              events_path=events_path)
+            verdict = result["verdict"]
+            rows.append(verdict)
+            status = {True: "ATTACK SUCCEEDS", False: "defended"}[
+                verdict["attack_succeeded"]]
+            pin = verdict["matches_expectation"]
+            pin_str = {True: "as the paper says", False: "UNEXPECTED",
+                       None: "unpinned"}[pin]
+            print(f"{scenario:>12} x {variant_name:<8} {status:<15} "
+                  f"({pin_str}; {len(result['violations'])} violations, "
+                  f"{verdict['wall_s']}s)")
+            if result["violations"]:
+                bundle = write_bundle(out_dir, scenario, variant_name,
+                                      result, events_path)
+                bundles.append(bundle)
+            elif events_path and os.path.exists(events_path):
+                os.remove(events_path)
+    mismatches = [r for r in rows if r["matches_expectation"] is False]
+    return {"schema": SCHEMA, "rows": rows, "bundles": bundles,
+            "mismatches": len(mismatches)}
+
+
+def bench_emission(rows: list[dict]) -> dict:
+    """bench_variants history emission: per-variant wall + head-query
+    timings off the fixed-shape balancer cells (counts deterministic)."""
+    emission: dict = {"metric": "bench_variants", "counts": {}}
+    for row in rows:
+        if row["scenario"] != "balancer":
+            continue
+        v = row["variant"]
+        emission[v] = {
+            "wall_s": row["wall_s"],
+            "get_head_p50_ms": row.get("get_head_p50_ms"),
+            "get_head_p95_ms": row.get("get_head_p95_ms"),
+        }
+        emission["counts"][f"{v}.slots_run"] = row["slots_run"]
+        emission["counts"][f"{v}.attack_succeeded"] = int(
+            row["attack_succeeded"])
+    return emission
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="variant x attack verdict matrix under the full "
+                    "monitor stack")
+    ap.add_argument("--out", default="variant_out")
+    ap.add_argument("--json", default=None,
+                    help="write the matrix verdict table here")
+    ap.add_argument("--history", default=None,
+                    help="append a bench_variants emission to this "
+                         "bench-history JSONL")
+    ap.add_argument("--scenarios", default=",".join(SCENARIOS))
+    ap.add_argument("--variants", default=",".join(VARIANT_NAMES))
+    ap.add_argument("--no-events", action="store_true")
+    ap.add_argument("--replay", metavar="BUNDLE",
+                    help="replay a repro bundle and verify the verdict")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        out = replay_bundle(args.replay)
+        print(json.dumps(out, indent=1, default=str))
+        return 0 if out["match"] else 1
+
+    scenarios = [s.strip() for s in args.scenarios.split(",") if s.strip()]
+    variants = [v.strip() for v in args.variants.split(",") if v.strip()]
+    summary = run_matrix(scenarios, variants, args.out,
+                         events=not args.no_events)
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(summary, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"matrix   -> {args.json}")
+    if args.history:
+        from pos_evolution_tpu.profiling import history
+        history.append_entry(args.history, bench_emission(summary["rows"]),
+                             kind="bench_variants")
+        print(f"history  -> {args.history} (kind=bench_variants)")
+    if summary["mismatches"]:
+        print(f"{summary['mismatches']} cell(s) CONTRADICT the paper's "
+              f"claims", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

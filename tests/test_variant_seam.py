@@ -1,0 +1,385 @@
+"""Protocol-variant seam tests (variants/, DESIGN.md §16).
+
+- kernel host⇄device bit-identity (ops/variant_tally.py twins);
+- differential against the ``models/`` PVM oracles on shared
+  (block-tree, vote-set) scenarios: the expiry-windowed
+  equivocation-discounted kernel head must equal ``pvm.ghost_head``;
+- GasperVariant behavior-identity: the default seam is byte-for-byte the
+  pre-seam driver on a seeded faulted run;
+- Goldfish / RLMD-GHOST / SSF end-to-end through the driver, bit-identical
+  across ExecutionBackends;
+- checkpoint/resume per variant (uninterrupted-twin equality, fingerprint
+  rebuild, mismatch refusal);
+- the matrix acceptance pins: Balancer succeeds vs pre-boost Gasper and
+  fails vs Goldfish expiry; the ex-ante reorg succeeds vs pre-boost
+  Gasper and fails vs SSF fast confirmation; SplitVoter double finality
+  under SSF is accountable with >= 1/3 implicated stake; repro bundles
+  replay; the per-variant doctored negative trips.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from pos_evolution_tpu.backend import set_backend  # noqa: E402
+from pos_evolution_tpu.ops import variant_tally as vt  # noqa: E402
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+
+@pytest.fixture(autouse=True)
+def _numpy_backend():
+    set_backend("numpy")
+    yield
+    set_backend("numpy")
+
+
+class TestKernels:
+    def test_windowed_tally_host_device_bit_identity(self):
+        rng = np.random.default_rng(0)
+        for trial in range(12):
+            k = int(rng.integers(1, 150))
+            nb = int(rng.integers(1, 40))
+            block_idx = rng.integers(-1, nb, k)
+            vote_slot = rng.integers(0, 30, k)
+            weight = rng.integers(0, 32_000_000_000, k)
+            active = rng.random(k) < 0.8
+            lo, hi = sorted(rng.integers(0, 30, 2))
+            h = vt.windowed_vote_tally_host(block_idx, vote_slot, weight,
+                                            active, lo, hi, nb)
+            d = vt.windowed_vote_tally_device(block_idx, vote_slot, weight,
+                                              active, lo, hi, nb)
+            assert h.dtype == np.int64 and (h == d).all(), trial
+
+    def test_link_tally_host_device_bit_identity(self):
+        rng = np.random.default_rng(1)
+        for trial in range(12):
+            k = int(rng.integers(1, 150))
+            nl = int(rng.integers(1, 20))
+            link_idx = rng.integers(-1, nl, k)
+            weight = rng.integers(0, 32_000_000_000, k)
+            active = rng.random(k) < 0.8
+            h = vt.link_tally_host(link_idx, weight, active, nl)
+            d = vt.link_tally_device(link_idx, weight, active, nl)
+            assert (h == d).all(), trial
+
+    def test_window_and_discount_semantics(self):
+        # one vote inside the window, one expired, one discounted
+        out = vt.windowed_vote_tally_host(
+            np.array([0, 0, 0]), np.array([5, 2, 5]),
+            np.array([10, 10, 10]), np.array([True, True, False]),
+            lo_slot=4, hi_slot=6, n_blocks=1)
+        assert out.tolist() == [10]
+
+    def test_empty_votes(self):
+        out = vt.windowed_vote_tally_device(
+            np.zeros(0, np.int64), np.zeros(0, np.int64),
+            np.zeros(0, np.int64), np.zeros(0, bool), 0, 10, 4)
+        assert out.tolist() == [0, 0, 0, 0]
+
+
+class TestPVMDifferential:
+    """The retained models/ propose-vote-merge layer is the oracle for
+    the variant fork-choice rule: on a shared random (block-tree,
+    vote-set) scenario the kernel pipeline (windowed tally -> subtree
+    accumulation -> greedy descent) must pick ``pvm.ghost_head``'s
+    head, for LMD (eta = inf), RLMD windows and the Goldfish eta = 1
+    limit, with equivocation discounting."""
+
+    def _scenario(self, seed: int):
+        from pos_evolution_tpu.models.pvm import (
+            GENESIS_ROOT,
+            HeadVote,
+            PVMBlock,
+            View,
+        )
+        rng = np.random.default_rng(seed)
+        view = View()
+        roots = [GENESIS_ROOT]
+        for i in range(int(rng.integers(3, 14))):
+            parent = roots[int(rng.integers(0, len(roots)))]
+            parent_slot = (0 if parent == GENESIS_ROOT
+                           else view.blocks[parent].slot)
+            b = PVMBlock(slot=int(parent_slot) + 1 + int(rng.integers(0, 2)),
+                         parent=parent, proposer=i)
+            view.add_block(b)
+            roots.append(b.root)
+        slot = max(b.slot for b in view.blocks.values()) + 1
+        for v in range(12):
+            for _ in range(int(rng.integers(0, 3))):
+                view.add_vote(HeadVote(
+                    slot=int(rng.integers(1, slot)),
+                    block_root=roots[int(rng.integers(0, len(roots)))],
+                    validator=v))
+        return view, slot
+
+    def _kernel_head(self, view, slot: int, eta):
+        from pos_evolution_tpu.backend import get_backend
+        from pos_evolution_tpu.models.pvm import GENESIS_ROOT
+        roots = list(view.blocks.keys())
+        index_of = {r: i for i, r in enumerate(roots)}
+        parent = np.array([index_of.get(view.blocks[r].parent, -1)
+                           if r != GENESIS_ROOT else -1 for r in roots],
+                          np.int32)
+        # latest vote per validator (the pvm latest_votes contract);
+        # equivocators carry no weight
+        latest: dict[int, tuple[int, bytes]] = {}
+        for (v, s), root in view.votes.items():
+            cur = latest.get(v)
+            if cur is None or s > cur[0]:
+                latest[v] = (s, root)
+        items = sorted(latest.items())
+        block_idx = np.array([index_of.get(r, -1) for _, (_, r) in items],
+                             np.int64)
+        vote_slot = np.array([s for _, (s, _) in items], np.int64)
+        weight = np.ones(len(items), np.int64)
+        active = np.array([v not in view.equivocators for v, _ in items],
+                          bool)
+        lo = 0 if eta is None else max(slot - eta, 0)
+        backend = get_backend()
+        tally = backend.variant_tally(block_idx, vote_slot, weight, active,
+                                      lo, slot - 1, len(roots))
+        subtree = backend.subtree_weights(parent, tally)
+        children: dict[int, list[int]] = {}
+        for i, p in enumerate(parent):
+            if p >= 0:
+                children.setdefault(int(p), []).append(i)
+        head = 0
+        while True:
+            kids = children.get(head, [])
+            if not kids:
+                return roots[head]
+            head = max(kids, key=lambda i: (int(subtree[i]), roots[i]))
+
+    @pytest.mark.parametrize("eta", [None, 1, 2, 4])
+    def test_kernel_head_matches_pvm_ghost_head(self, eta):
+        from pos_evolution_tpu.models.pvm import ghost_head
+        for seed in range(8):
+            view, slot = self._scenario(seed)
+            assert self._kernel_head(view, slot, eta) \
+                == ghost_head(view, slot, eta), (seed, eta)
+
+    def test_equivocator_discounted_like_pvm(self):
+        from pos_evolution_tpu.models.pvm import (
+            GENESIS_ROOT,
+            HeadVote,
+            PVMBlock,
+            View,
+            ghost_head,
+        )
+        view = View()
+        b1 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=0)
+        b2 = PVMBlock(slot=1, parent=GENESIS_ROOT, proposer=1)
+        view.add_block(b1)
+        view.add_block(b2)
+        view.add_vote(HeadVote(slot=2, block_root=b1.root, validator=5))
+        view.add_vote(HeadVote(slot=2, block_root=b2.root, validator=5))
+        view.add_vote(HeadVote(slot=2, block_root=b2.root, validator=6))
+        for eta in (None, 2):
+            assert self._kernel_head(view, 3, eta) \
+                == ghost_head(view, 3, eta) == b2.root
+
+
+def _faulted_schedule(n):
+    from pos_evolution_tpu.sim.faults import FaultPlan
+    from pos_evolution_tpu.sim.schedule import faulty_schedule
+    plan = FaultPlan(seed=11, drop_p=0.08, duplicate_p=0.05,
+                     reorder_p=0.1, reorder_max_delay=3.0, gst=48)
+    return faulty_schedule(n, plan, n_groups=2)
+
+
+class TestGasperBehaviorIdentity:
+    def test_default_variant_is_gasper_with_no_overlay(self):
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.variants import GasperVariant
+        sim = Simulation(16)
+        assert isinstance(sim.variant, GasperVariant)
+        assert sim.groups[0].store.variant_view is None
+        assert sim.groups[0].variant_view is None
+
+    def test_seeded_faulted_run_identical_to_explicit_gasper(self):
+        """The behavior-identity pin: Simulation() and
+        Simulation(variant=GasperVariant()) produce the same heads,
+        justification and finality slot by slot on a seeded faulted run,
+        and the seam head equals the spec walk throughout."""
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.specs import forkchoice as fc
+        from pos_evolution_tpu.variants import GasperVariant
+
+        n = 32
+        runs = []
+        for variant in (None, GasperVariant()):
+            sim = Simulation(n, schedule=_faulted_schedule(n),
+                             variant=variant)
+            heads = []
+            for _ in range(12):
+                sim.run_slot()
+                heads.append(fc.get_head(sim.store(0)))
+                assert sim.variant.head(sim, sim.groups[0]) == heads[-1]
+            runs.append((sim.metrics, heads,
+                         sim.justified_epoch(), sim.finalized_epoch()))
+        assert runs[0] == runs[1]
+
+
+class TestVariantRunsBothBackends:
+    @pytest.mark.parametrize("variant_name", ["goldfish", "rlmd", "ssf"])
+    def test_driver_run_bit_identical_across_backends(self, variant_name):
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.variants import VARIANTS
+        runs = {}
+        for backend in ("numpy", "jax"):
+            set_backend(backend)
+            sim = Simulation(32, variant=VARIANTS[variant_name]())
+            sim.run_until_slot(10)
+            runs[backend] = (sim.metrics,
+                             sim.variant.state_blob(sim))
+        assert runs["numpy"] == runs["jax"]
+
+    def test_honest_runs_converge_to_spec_head(self):
+        """With synchrony and honesty every variant's head equals the
+        carrier's LMD head (all latest votes are fresh)."""
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.specs import forkchoice as fc
+        from pos_evolution_tpu.variants import VARIANTS
+        for name in ("goldfish", "rlmd", "ssf"):
+            sim = Simulation(32, variant=VARIANTS[name]())
+            sim.run_until_slot(10)
+            assert sim.variant.head(sim, sim.groups[0]) \
+                == fc.get_head(sim.store(0)), name
+
+    def test_ssf_single_slot_finality_honest_run(self):
+        """Honest synchronous run: SSF justifies and finalizes each
+        round within its own processing boundary (pos-evolution.md:1646),
+        tracking head_slot - 1."""
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.variants import SsfVariant
+        v = SsfVariant()
+        sim = Simulation(32, variant=v)
+        sim.run_until_slot(10)
+        fin = max(s for _, s in v.finalized[0])
+        assert fin >= 8
+        assert v.lj[0][1] == fin
+        assert v.slashable() == set()
+
+    def test_goldfish_fast_confirms_honest_run(self):
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.variants import GoldfishVariant
+        v = GoldfishVariant()
+        sim = Simulation(32, variant=v)
+        sim.run_until_slot(10)
+        root, slot = v.fast_confirmed[0]
+        assert slot >= 8
+        assert root in sim.store(0).blocks
+
+
+class TestCheckpointResume:
+    @pytest.mark.parametrize("variant_name", ["goldfish", "rlmd", "ssf"])
+    def test_resume_matches_uninterrupted_twin(self, variant_name):
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.variants import VARIANTS
+        sim = Simulation(32, variant=VARIANTS[variant_name]())
+        sim.run_until_slot(8)
+        blob = sim.checkpoint()
+        twin = Simulation(32, variant=VARIANTS[variant_name]())
+        twin.run_until_slot(16)
+        resumed = Simulation.resume(blob)  # variant rebuilt from fingerprint
+        assert resumed.variant.describe() == twin.variant.describe()
+        resumed.run_until_slot(16)
+        assert resumed.metrics == twin.metrics
+        assert resumed.variant.state_blob(resumed) \
+            == twin.variant.state_blob(twin)
+
+    def test_mismatched_variant_refused(self):
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.variants import GoldfishVariant, SsfVariant
+        sim = Simulation(16, variant=GoldfishVariant())
+        sim.run_until_slot(3)
+        blob = sim.checkpoint()
+        with pytest.raises(ValueError, match="does not match"):
+            Simulation.resume(blob, variant=SsfVariant())
+
+    def test_describe_round_trips(self):
+        from pos_evolution_tpu.variants import (
+            VARIANTS,
+            variant_from_config,
+        )
+        for name, cls in VARIANTS.items():
+            v = cls()
+            assert variant_from_config(v.describe()).describe() \
+                == v.describe(), name
+        # None (pre-seam checkpoint) resumes as Gasper
+        assert variant_from_config(None).describe() \
+            == {"kind": "GasperVariant"}
+
+
+class TestVariantMatrixPins:
+    """The acceptance pins of ISSUE 8, through scripts/variant_matrix.py
+    run_cell (the same entry the demo uses)."""
+
+    def test_balancer_succeeds_vs_gasper_fails_vs_goldfish(self):
+        import variant_matrix
+        gasper = variant_matrix.run_cell("balancer", "gasper")
+        goldfish = variant_matrix.run_cell("balancer", "goldfish")
+        assert gasper["verdict"]["attack_succeeded"] is True
+        assert goldfish["verdict"]["attack_succeeded"] is False
+
+    def test_exante_succeeds_vs_gasper_fails_vs_ssf(self):
+        import variant_matrix
+        gasper = variant_matrix.run_cell("exante", "gasper")
+        ssf = variant_matrix.run_cell("exante", "ssf")
+        assert gasper["verdict"]["b3_reorged"] is True
+        assert ssf["verdict"]["b3_reorged"] is False
+
+    def test_splitvoter_double_finality_accountable_under_ssf(self,
+                                                              tmp_path):
+        import variant_matrix
+        result = variant_matrix.run_cell("splitvoter", "ssf")
+        verdict = result["verdict"]
+        assert verdict["finalized_conflict"] is True
+        assert verdict["accountable"] is True
+        assert verdict["max_evidence_stake_ratio"] >= 0.333  # >= 1/3, rounded
+        # repro bundle round-trip: the bundle replays to the same verdict
+        bundle = variant_matrix.write_bundle(str(tmp_path), "splitvoter",
+                                             "ssf", result, None)
+        replay = variant_matrix.replay_bundle(bundle)
+        assert replay["match"], replay
+
+    def test_equivocator_defended_under_every_variant(self):
+        import variant_matrix
+        for name in ("gasper", "ssf"):
+            result = variant_matrix.run_cell("equivocator", name)
+            assert result["verdict"]["attack_succeeded"] is False
+            assert result["verdict"]["slasher_implicated"] > 0
+
+
+class TestVariantDoctor:
+    @pytest.mark.parametrize("variant_name", ["goldfish", "ssf"])
+    def test_forged_variant_conflict_trips_monitor(self, variant_name):
+        """The per-variant CI negative: a forged conflicting
+        confirmation/finality with no evidence behind it must surface as
+        an (unexplained) protocol_violation."""
+        import chaos_fuzz
+        cfg = chaos_fuzz.episode_config(3, 0, 32, 10, doctor=True,
+                                        variant=variant_name)
+        result = chaos_fuzz.run_episode(cfg)
+        hits = [x for x in result["violations"]
+                if x["monitor"] == "variant_safety"
+                and x["kind"] == "protocol_violation"]
+        assert hits, result["violations"]
+
+    def test_store_doctor_still_trips_under_rlmd(self):
+        """Variants with no forgeable variant surface fall back to the
+        FFG store doctor, caught by the AccountableSafetyMonitor."""
+        import chaos_fuzz
+        cfg = chaos_fuzz.episode_config(3, 0, 32, 10, doctor=True,
+                                        variant="rlmd")
+        result = chaos_fuzz.run_episode(cfg)
+        hits = [x for x in result["violations"]
+                if x["monitor"] == "accountable_safety"
+                and x["kind"] == "protocol_violation"]
+        assert hits, result["violations"]
