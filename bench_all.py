@@ -366,6 +366,36 @@ def main():
         results["config5_ssf_tally_1m"] = wd.step(
             "config5", _config5, default=_failed("config5"))
 
+        def _config6():
+            # Sharded END-TO-END loop (ISSUE 9): a small DenseSimulation
+            # over the same mesh — per-slot sharded vote pass + committee
+            # shuffle + aggregation verify + fused epoch sweeps — timed as
+            # whole-run wall clock (it is a driver, not a kernel; the
+            # fused-measure recipe applies to kernels).
+            import time as _t
+
+            from pos_evolution_tpu.config import mainnet_config
+            from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+            dcfg = mainnet_config().replace(slots_per_epoch=8,
+                                            max_committees_per_slot=8)
+            sim = DenseSimulation(8192, cfg=dcfg, mesh=mesh, seed=1,
+                                  shuffle_rounds=10, check_walk_every=8)
+            t0 = _t.time()
+            sim.run_epochs(4)
+            wall = _t.time() - t0
+            s = sim.summary()
+            assert s["finality_reached"] and \
+                s["resident_head_equals_spec_walk"], s
+            return {"n_validators": 8192, "slots": s["slots"],
+                    "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
+                    "wall_s": round(wall, 2),
+                    "ms_per_slot": round(wall / s["slots"] * 1e3, 2),
+                    "finalized_epoch": s["finalized_epoch"],
+                    "aggregates_verified": s["aggregates_verified"]}
+
+        results["config6_sharded_e2e"] = wd.step(
+            "config6", _config6, default=_failed("config6"))
+
     if wd.incidents:
         results["watchdog_incidents"] = wd.incidents
     results["telemetry"] = {"counts": registry.counts()}
@@ -385,6 +415,15 @@ def main():
             from pos_evolution_tpu.profiling import history as _history
             _history.append_entry(os.path.join(here, "bench_history.jsonl"),
                                   results, kind="bench_all")
+            # the sharded end-to-end run also lands in its own namespace
+            # so `perf_gate.py --kind bench_shard` bands it together with
+            # scale_demo --sharded emissions (ISSUE 9 satellite)
+            shard = results.get("config6_sharded_e2e")
+            if shard and not shard.get("failed"):
+                _history.append_entry(
+                    os.path.join(here, "bench_history.jsonl"),
+                    {"metric": "sharded_e2e_small", **shard},
+                    kind="bench_shard")
         except Exception as e:
             print(f"# bench history append failed: {e!r:.120}",
                   file=sys.stderr)

@@ -14,6 +14,56 @@ import numpy as np
 name = "jax"
 accelerated_epoch = True
 
+# --- sharded mode (ISSUE 9 tentpole) ------------------------------------------
+#
+# A process-global (pods x shard) device mesh. When set, the validator-axis
+# sweeps this backend serves — the epoch sweep, the variant vote/link
+# tallies, and (via ops/resident.py reading ``sharded_mesh()``) the
+# fork-choice vote pass and the fused-transition session columns — run as
+# ``shard_map`` kernels over it, with registry columns placed sharded per
+# the partition rules in ``parallel/partition.py`` and allreduces ICI-first
+# / DCN-second (``parallel/collectives.py`` axis roles). Everything stays
+# bit-identical to the single-device kernels (int64 psum reassociates
+# exactly); tests/test_sharded_e2e.py pins it across mesh shapes.
+
+_SHARDED = {"mesh": None, "shard_transition": True}
+
+
+def enable_sharded(n_devices: int | None = None, n_pods: int | None = None,
+                   mesh=None, shard_transition: bool = True):
+    """Activate sharded dispatch on this backend. ``mesh`` or a
+    ``(n_devices, n_pods)`` shape; returns the mesh. ``shard_transition``
+    also places the fused block-sweep session columns sharded (see
+    ``ops/transition.py`` for when that pays)."""
+    if mesh is None:
+        from pos_evolution_tpu.parallel.sharded import make_mesh
+        mesh = make_mesh(n_devices, n_pods)
+    _SHARDED["mesh"] = mesh
+    _SHARDED["shard_transition"] = bool(shard_transition)
+    from pos_evolution_tpu.ops.transition import reset_session
+    reset_session()  # carries placed under the previous layout are stale
+    return mesh
+
+
+def disable_sharded() -> None:
+    _SHARDED["mesh"] = None
+    from pos_evolution_tpu.ops.transition import reset_session
+    reset_session()
+
+
+def sharded_mesh():
+    """The active mesh, or None (single-device dispatch)."""
+    return _SHARDED["mesh"]
+
+
+def shard_transition_enabled() -> bool:
+    return _SHARDED["mesh"] is not None and _SHARDED["shard_transition"]
+
+
+def _next_pow2(x: int) -> int:
+    from pos_evolution_tpu.ops.variant_tally import next_pow2
+    return next_pow2(x)
+
 
 def shuffle_permutation(seed: bytes, n: int, rounds: int) -> np.ndarray:
     from pos_evolution_tpu.ops.shuffle import shuffle_permutation_jax
@@ -60,17 +110,54 @@ def das_reconstruct(cells: np.ndarray, present: np.ndarray):
 def variant_tally(block_idx, vote_slot, weight, active, lo_slot, hi_slot,
                   n_blocks):
     """Expiry-windowed vote tally as one jitted masked segment_sum
-    (bit-identical to numpy_backend.variant_tally)."""
-    from pos_evolution_tpu.ops.variant_tally import windowed_vote_tally_device
-    return windowed_vote_tally_device(block_idx, vote_slot, weight, active,
-                                      lo_slot, hi_slot, n_blocks)
+    (bit-identical to numpy_backend.variant_tally). Under the sharded
+    mode the vote batch shards over the validator mesh axes and the
+    per-block partials allreduce ICI-first / DCN-second."""
+    mesh = sharded_mesh()
+    if mesh is None:
+        from pos_evolution_tpu.ops.variant_tally import (
+            windowed_vote_tally_device,
+        )
+        return windowed_vote_tally_device(block_idx, vote_slot, weight,
+                                          active, lo_slot, hi_slot, n_blocks)
+    import jax.numpy as jnp
+
+    from pos_evolution_tpu.parallel.sharded import (
+        pad_batch_to_mesh,
+        windowed_tally_for,
+    )
+    nb = _next_pow2(n_blocks)
+    (bi, vs, w, ac), _k = pad_batch_to_mesh(
+        mesh,
+        (np.asarray(block_idx, np.int64), np.asarray(vote_slot, np.int64),
+         np.asarray(weight, np.int64), np.asarray(active, bool)),
+        fills=(-1, 0, 0, False))
+    res = windowed_tally_for(mesh, nb)(
+        bi, vs, w, ac, jnp.int64(lo_slot), jnp.int64(hi_slot))
+    return np.asarray(res)[:n_blocks]
 
 
 def link_tally(link_idx, weight, active, n_links):
     """SSF supermajority-link / acknowledgment tally on device
-    (bit-identical to numpy_backend.link_tally)."""
-    from pos_evolution_tpu.ops.variant_tally import link_tally_device
-    return link_tally_device(link_idx, weight, active, n_links)
+    (bit-identical to numpy_backend.link_tally). Under the sharded mode
+    this is the live ``SsfVariant`` fold of the multichip dry run: the
+    vote batch shards over (pods, shard) and the per-link stake partials
+    reduce over ICI then DCN (north-star config #5)."""
+    mesh = sharded_mesh()
+    if mesh is None:
+        from pos_evolution_tpu.ops.variant_tally import link_tally_device
+        return link_tally_device(link_idx, weight, active, n_links)
+    from pos_evolution_tpu.parallel.sharded import (
+        link_tally_for,
+        pad_batch_to_mesh,
+    )
+    nl = _next_pow2(n_links)
+    (li, w, ac), _k = pad_batch_to_mesh(
+        mesh,
+        (np.asarray(link_idx, np.int64), np.asarray(weight, np.int64),
+         np.asarray(active, bool)),
+        fills=(-1, 0, False))
+    return np.asarray(link_tally_for(mesh, nl)(li, w, ac))[:n_links]
 
 
 def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
@@ -96,6 +183,9 @@ def epoch_sweep(state, cfg, dense=None):
     from pos_evolution_tpu.ops.epoch import densify, process_epoch_dense
     from pos_evolution_tpu.specs.helpers import get_current_epoch
 
+    mesh = sharded_mesh()
+    if mesh is not None:
+        return _epoch_sweep_sharded(state, cfg, mesh)
     if dense is None:
         dense = densify(state)
     return process_epoch_dense(
@@ -108,6 +198,44 @@ def epoch_sweep(state, cfg, dense=None):
         int(state.slashings.sum()),
         cfg,
     )
+
+
+def _epoch_sweep_sharded(state, cfg, mesh):
+    """Sharded epoch boundary (north-star config #4 live): registry
+    columns are placed sharded over (pods, shard) via per-shard slice
+    callbacks — padded with inert rows to mesh divisibility — and the
+    fused sweep runs as one ``shard_map`` with every registry-wide tally
+    allreduced ICI-first / DCN-second. Output registry columns are
+    sliced back to the real row count, so the caller's host write-back
+    (specs/epoch.py) is unchanged. The churn kernel keeps its own
+    single-device staging (an O(N log N) sort, once per epoch), so the
+    caller's ``dense`` is deliberately not reused here: re-extracting the
+    host columns (``densify_np``) costs one host pass, while gathering
+    the staged device copy back would cost a full d2h transfer — and the
+    churn contract needs the *unpadded* single-device staging anyway."""
+    import jax
+    import jax.numpy as jnp
+
+    from pos_evolution_tpu.ops.epoch import DenseRegistry, densify_sharded
+    from pos_evolution_tpu.parallel.sharded import epoch_step_for
+    from pos_evolution_tpu.specs.helpers import get_current_epoch
+
+    reg_s, n = densify_sharded(state, mesh)
+    step = epoch_step_for(mesh, cfg,
+                          donate=jax.default_backend() != "cpu")
+    out = step(
+        reg_s,
+        jnp.int64(get_current_epoch(state)),
+        jnp.int64(int(state.finalized_checkpoint.epoch)),
+        jnp.asarray(np.asarray(state.justification_bits, dtype=bool)),
+        jnp.int64(int(state.previous_justified_checkpoint.epoch)),
+        jnp.int64(int(state.current_justified_checkpoint.epoch)),
+        jnp.int64(int(state.slashings.sum())),
+    )
+    if int(out.registry.balance.shape[0]) != n:
+        out = out._replace(registry=DenseRegistry(
+            *(a[:n] for a in out.registry)))
+    return out
 
 
 

@@ -70,24 +70,16 @@ def _msg_block2(msg_words):
     return blk
 
 
-@jax.jit
-def aggregate_verify_batch(pk_states, committees, bits, msg_words, signatures):
-    """Verify A committee aggregates at once.
-
-    pk_states  (N, 8) uint32 — per-validator signature midstates
-               (``precompute_pk_states``, refreshed only on registry change)
-    committees (A, C) int32  — validator index per committee lane
-    bits       (A, C) bool   — aggregation bitlists
-    msg_words  (A, 8) uint32 — signing roots per attestation (u32 words)
-    signatures (A, 24) uint32 — provided aggregate signature words
-    Returns bool[A].
+def _committee_aggregates(pk_states, committees, bits, msg_words):
+    """Shared pipeline of the verify and sign kernels: per-signer
+    signature words, masked by the bitlists and XOR-reduced per
+    committee -> (A, 24) aggregate words.
 
     Per signer: one schedule-shared compression (the message block is per
     attestation, so its schedule is computed once per committee and
     broadcast over lanes) + two chain hashes — the fake-scheme analogue of
     the per-signer pairing work a real BLS kernel does.
     """
-    a, c = committees.shape
     states = pk_states[committees]                    # (A, C, 8)
     # (A, 1, 16): the lane axis stays size-1 so the message schedule is
     # genuinely computed once per committee and broadcast inside the round
@@ -99,9 +91,36 @@ def aggregate_verify_batch(pk_states, committees, bits, msg_words, signatures):
     h3 = _chain_hash(h2)
     sigs = jnp.concatenate([h1, h2, h3], axis=-1)     # (A, C, 24)
     masked = jnp.where(bits[..., None], sigs, 0)
-    agg = jax.lax.reduce(masked, np.uint32(0),
-                         jax.lax.bitwise_xor, dimensions=(1,))
+    return jax.lax.reduce(masked, np.uint32(0),
+                          jax.lax.bitwise_xor, dimensions=(1,))
+
+
+@jax.jit
+def aggregate_verify_batch(pk_states, committees, bits, msg_words, signatures):
+    """Verify A committee aggregates at once.
+
+    pk_states  (N, 8) uint32 — per-validator signature midstates
+               (``precompute_pk_states``, refreshed only on registry change)
+    committees (A, C) int32  — validator index per committee lane
+    bits       (A, C) bool   — aggregation bitlists
+    msg_words  (A, 8) uint32 — signing roots per attestation (u32 words)
+    signatures (A, 24) uint32 — provided aggregate signature words
+    Returns bool[A].
+    """
+    agg = _committee_aggregates(pk_states, committees, bits, msg_words)
     return (agg == signatures).all(axis=-1) & bits.any(axis=-1)
+
+
+@jax.jit
+def aggregate_signatures_batch(pk_states, committees, bits, msg_words):
+    """The signer side of ``aggregate_verify_batch``: the honest
+    committee aggregates from the SAME ``_committee_aggregates``
+    pipeline the verifier recomputes — ``aggregate_verify_batch`` over
+    the result is True exactly on the committees whose bitlists are
+    non-empty (the dense end-to-end driver uses this as each slot's
+    aggregation duty, then runs the sharded verification sweep over the
+    batch axis)."""
+    return _committee_aggregates(pk_states, committees, bits, msg_words)
 
 
 def messages_to_words(messages_u8: np.ndarray) -> np.ndarray:

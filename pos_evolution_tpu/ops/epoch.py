@@ -68,11 +68,18 @@ class EpochResult(NamedTuple):
     finalize_epoch: jax.Array            # int64 scalar (-1 = no finalization)
 
 
-def _epochs_to_i64(a: np.ndarray) -> jax.Array:
-    """uint64 epoch column -> int64 with FAR_FUTURE mapped to the sentinel."""
+def _epochs_to_i64_np(a: np.ndarray) -> np.ndarray:
+    """uint64 epoch column -> int64 with FAR_FUTURE mapped to the
+    sentinel, host-side (the sharded densify path places these slices
+    directly, never through a single-device buffer)."""
     a = a.astype(np.uint64)
     out = np.where(a == np.uint64(2**64 - 1), np.uint64(FAR_FUTURE_I64), a)
-    return jnp.asarray(out.astype(np.int64))
+    return out.astype(np.int64)
+
+
+def _epochs_to_i64(a: np.ndarray) -> jax.Array:
+    """uint64 epoch column -> int64 with FAR_FUTURE mapped to the sentinel."""
+    return jnp.asarray(_epochs_to_i64_np(a))
 
 
 def i64_to_epochs(col) -> np.ndarray:
@@ -83,20 +90,64 @@ def i64_to_epochs(col) -> np.ndarray:
 
 def densify(state) -> DenseRegistry:
     """Extract the dense arrays from a spec-level BeaconState (host)."""
-    reg = state.validators
-    epochs = _epochs_to_i64
+    return DenseRegistry(*(jnp.asarray(a) for a in densify_np(state)))
 
+
+def pad_registry(reg: DenseRegistry, n_to: int) -> DenseRegistry:
+    """Pad registry columns to ``n_to`` rows with **inert validators**:
+    never active (activation epoch at the FAR_FUTURE sentinel), zero
+    balances, unslashed, zero flags — every mask in ``epoch_core`` and
+    ``registry_churn_dense`` evaluates False on them and every reduction
+    they touch contributes zero, so a padded sweep is bit-identical to
+    the unpadded one on the first ``n`` rows. This is the divisibility
+    shim for the sharded epoch pass (validator axis must divide by the
+    mesh device count); callers slice outputs back with
+    ``tree_map(lambda a: a[:n], ...)``."""
+    fills = {
+        "effective_balance": 0, "balance": 0,
+        "activation_epoch": FAR_FUTURE_I64, "exit_epoch": FAR_FUTURE_I64,
+        "withdrawable_epoch": FAR_FUTURE_I64, "slashed": False,
+        "prev_flags": 0, "cur_flags": 0, "inactivity_scores": 0,
+    }
+    cols = {}
+    for f in DenseRegistry._fields:
+        a = np.asarray(getattr(reg, f))
+        if a.shape[0] < n_to:
+            pad = np.full((n_to - a.shape[0],) + a.shape[1:], fills[f],
+                          a.dtype)
+            a = np.concatenate([a, pad])
+        cols[f] = a
+    return DenseRegistry(**cols)
+
+
+def densify_np(state) -> DenseRegistry:
+    """Host-numpy twin of ``densify`` (no device buffers): the staging
+    form the sharded placement path slices from."""
+    reg = state.validators
     return DenseRegistry(
-        effective_balance=jnp.asarray(reg.effective_balance.astype(np.int64)),
-        balance=jnp.asarray(state.balances.astype(np.int64)),
-        activation_epoch=epochs(reg.activation_epoch),
-        exit_epoch=epochs(reg.exit_epoch),
-        withdrawable_epoch=epochs(reg.withdrawable_epoch),
-        slashed=jnp.asarray(reg.slashed),
-        prev_flags=jnp.asarray(state.previous_epoch_participation),
-        cur_flags=jnp.asarray(state.current_epoch_participation),
-        inactivity_scores=jnp.asarray(state.inactivity_scores.astype(np.int64)),
+        effective_balance=reg.effective_balance.astype(np.int64),
+        balance=state.balances.astype(np.int64),
+        activation_epoch=_epochs_to_i64_np(reg.activation_epoch),
+        exit_epoch=_epochs_to_i64_np(reg.exit_epoch),
+        withdrawable_epoch=_epochs_to_i64_np(reg.withdrawable_epoch),
+        slashed=np.asarray(reg.slashed),
+        prev_flags=np.asarray(state.previous_epoch_participation),
+        cur_flags=np.asarray(state.current_epoch_participation),
+        inactivity_scores=state.inactivity_scores.astype(np.int64),
     )
+
+
+def densify_sharded(state, mesh) -> tuple[DenseRegistry, int]:
+    """Densify directly onto the mesh: columns are padded to a multiple
+    of the device count and placed sharded over the validator axes via
+    per-shard slice callbacks (``parallel/partition.shard_leaf``) — no
+    full-size single-device buffer exists at any point. Returns
+    (sharded registry, real row count)."""
+    from pos_evolution_tpu.parallel.sharded import shard_registry
+    reg = densify_np(state)
+    n = reg.balance.shape[0]
+    npad = ((n + mesh.size - 1) // mesh.size) * mesh.size
+    return shard_registry(mesh, pad_registry(reg, npad)), n
 
 
 def isqrt_i64(x):

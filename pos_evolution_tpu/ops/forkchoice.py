@@ -407,11 +407,11 @@ def next_pow2(x: int) -> int:
     return max(int(2 ** np.ceil(np.log2(max(x, 2)))), 2)
 
 
-def build_dense_store(store, capacity: int | None = None):
-    """Build a DenseStore from a spec-level Store (host side).
-
-    Returns (dense, roots) where roots[i] is the block root at index i.
-    """
+def build_dense_arrays(store, capacity: int | None = None):
+    """Host-numpy image of a spec-level Store — the staging form both
+    ``build_dense_store`` (device placement) and ``get_head_host`` (the
+    vectorized host walk) slice from. Returns (dict of numpy columns,
+    roots, capacity)."""
     from pos_evolution_tpu.specs.forkchoice import (
         _leaf_is_viable, get_current_slot, get_proposer_boost,
     )
@@ -468,20 +468,96 @@ def build_dense_store(store, capacity: int | None = None):
         if store.proposer_boost_root != b"\x00" * 32 else -1
     boost_amount = get_proposer_boost(store) if boost_idx >= 0 else 0
 
+    cols = dict(
+        parent=parent, slot=slot, rank=rank_arr, real=real,
+        leaf_viable=leaf_viable,
+        justified_idx=np.int32(index_of[bytes(jc.root)]),
+        msg_block=msg_block, msg_epoch=msg_epoch, weight=weight,
+        boost_idx=np.int32(boost_idx),
+        boost_amount=np.int64(boost_amount),
+    )
+    return cols, roots, capacity
+
+
+def build_dense_store(store, capacity: int | None = None):
+    """Build a DenseStore from a spec-level Store (host side).
+
+    Returns (dense, roots) where roots[i] is the block root at index i.
+    """
+    cols, roots, capacity = build_dense_arrays(store, capacity)
     dense = DenseStore(
-        parent=jnp.asarray(parent),
-        slot=jnp.asarray(slot),
-        rank=jnp.asarray(rank_arr),
-        real=jnp.asarray(real),
-        leaf_viable=jnp.asarray(leaf_viable),
-        justified_idx=jnp.int32(index_of[bytes(jc.root)]),
-        msg_block=jnp.asarray(msg_block),
-        msg_epoch=jnp.asarray(msg_epoch),
-        weight=jnp.asarray(weight),
-        boost_idx=jnp.int32(boost_idx),
-        boost_amount=jnp.int64(boost_amount),
+        parent=jnp.asarray(cols["parent"]),
+        slot=jnp.asarray(cols["slot"]),
+        rank=jnp.asarray(cols["rank"]),
+        real=jnp.asarray(cols["real"]),
+        leaf_viable=jnp.asarray(cols["leaf_viable"]),
+        justified_idx=jnp.int32(cols["justified_idx"]),
+        msg_block=jnp.asarray(cols["msg_block"]),
+        msg_epoch=jnp.asarray(cols["msg_epoch"]),
+        weight=jnp.asarray(cols["weight"]),
+        boost_idx=jnp.int32(cols["boost_idx"]),
+        boost_amount=jnp.int64(cols["boost_amount"]),
     )
     return dense, roots, capacity
+
+
+def head_host(parent, real, rank, leaf_viable, justified_idx, vote_weight,
+              boost_idx, boost_amount):
+    """Host-numpy twin of ``_head_from_buckets``: reverse-topological
+    subtree accumulation (parent index < child index always holds) plus
+    the greedy (weight, lexicographic-rank) descent — no device queue,
+    no jit. The cheap independent oracle behind the resident store's
+    periodic self-check and the dense driver's spec-walk pin; itself
+    pinned bit-identical to ``specs.forkchoice.get_head`` in
+    tests/test_sharded_e2e.py."""
+    b = parent.shape[0]
+    subtree = vote_weight.astype(np.int64).copy()
+    boost_col = np.zeros(b, np.int64)
+    if boost_idx >= 0:
+        boost_col[boost_idx] = 1
+    is_parent = np.zeros(b, bool)
+    valid_parent = (parent >= 0) & real
+    is_parent[parent[valid_parent]] = True
+    leaf_ok = ((real & ~is_parent) & leaf_viable).astype(np.int64)
+    for i in range(b - 1, 0, -1):
+        p = parent[i]
+        if p >= 0 and real[i]:
+            subtree[p] += subtree[i]
+            boost_col[p] += boost_col[i]
+            leaf_ok[p] += leaf_ok[i]
+    subtree = subtree + boost_col * np.int64(boost_amount)
+    keep = leaf_ok > 0
+
+    head = int(justified_idx)
+    while True:
+        children = np.nonzero((parent == head) & keep & real)[0]
+        if children.size == 0:
+            return head
+        w = subtree[children]
+        best_w = w.max()
+        tied = children[w == best_w]
+        head = int(tied[np.argmax(rank[tied])])
+
+
+def get_head_host(store) -> bytes:
+    """Vectorized host get_head: one O(N) numpy pass over the
+    latest-message table + the O(B) host subtree/descent walk —
+    bit-identical to the spec walk (``specs.forkchoice.get_head``
+    recomputes an O(N)-Python-loop balance per candidate child, which
+    costs tens of seconds per call at 64K+ validators; this is the same
+    math vectorized). Used by the resident store's periodic self-check
+    and anywhere a spec-walk pin is needed at registry scale."""
+    cols, roots, capacity = build_dense_arrays(store)
+    msg_block = cols["msg_block"]
+    valid = msg_block >= 0
+    vw = np.zeros(capacity + 1, np.int64)
+    np.add.at(vw, np.where(valid, msg_block, capacity),
+              np.where(valid, cols["weight"], 0))
+    head = head_host(cols["parent"], cols["real"], cols["rank"],
+                     cols["leaf_viable"], cols["justified_idx"],
+                     vw[:capacity], int(cols["boost_idx"]),
+                     int(cols["boost_amount"]))
+    return roots[head]
 
 
 def get_head_dense(store) -> bytes:

@@ -65,6 +65,14 @@ class ResidentForkChoice:
     mid-simulation/bench. ``selfcheck_every=0`` disables the periodic
     audit (the differential tests pin equality on every query anyway)."""
 
+    # every DEEPCHECK_FACTOR-th periodic audit uses the pure-Python spec
+    # walk instead of the vectorized host walk: get_head_host shares its
+    # densification staging with the resident rebuild path, so on its own
+    # it cannot catch a staging regression that corrupts both sides the
+    # same way — the rare deep audit keeps a fully independent oracle in
+    # the loop at bounded cost (one spec walk per ~1K fresh queries).
+    DEEPCHECK_FACTOR = 16
+
     def __init__(self, store, capacity: int = 64, selfcheck_every: int = 64):
         self._min_capacity = capacity
         self.selfcheck_every = selfcheck_every
@@ -72,6 +80,16 @@ class ResidentForkChoice:
         self.incidents: list[str] = []
         self._head_queries = 0
         self._pending = []          # rebuild re-creates; safe if it dies
+        # Head-query memo: the driver asks for the head several times per
+        # slot (propose per group, attest, the per-slot record, light-
+        # client and DAS serving) between which nothing head-relevant
+        # moved. ``_rev`` bumps on every mutation of the dense image
+        # (block row, landed vote batch, slashing, rebuild); the memo key
+        # adds the store-side inputs the device query reads (fingerprint,
+        # boost root, block count), so a cached answer is exactly what a
+        # fresh ``_device_head`` would return.
+        self._rev = 0
+        self._head_memo: tuple | None = None
         try:
             self.rebuild(store)
         except Exception as e:
@@ -133,12 +151,49 @@ class ResidentForkChoice:
             if v < ok.shape[0]:
                 ok[v] = False
                 weight[v] = 0
-        self.ok = jnp.asarray(ok)
-        self.weight = jnp.asarray(weight)
-        self.buckets = rebuild_buckets(self.msg_block, self.weight,
-                                       self.capacity)
+        # Sharded mode (ISSUE 9): when the jax backend carries an active
+        # mesh, the [N] message-table columns are placed sharded over the
+        # validator axes (padded with inert rows: no vote, zero weight,
+        # never-landing) and the bucket rebuild runs the shard_map vote
+        # pass with its two-axis psum — the fork-choice half of the
+        # validator-axis sweeps. Incremental scatters (flush / slashing)
+        # go through the same jitted kernels, partitioned by GSPMD.
+        self._mesh = self._active_mesh()
+        if self._mesh is not None:
+            from pos_evolution_tpu.parallel.partition import (
+                pad_rows,
+                shard_leaf,
+                spec_for,
+            )
+            from pos_evolution_tpu.parallel.sharded import vote_weights_for
+            n = ok.shape[0]
+            npad = ((n + self._mesh.size - 1)
+                    // self._mesh.size) * self._mesh.size
+            place = lambda name, a, fill: shard_leaf(  # noqa: E731
+                self._mesh, spec_for(f"messages/{name}"),
+                pad_rows(np.asarray(a), npad, fill))
+            self.msg_block = place("msg_block", self.msg_block, -1)
+            self.msg_epoch = place("msg_epoch", self.msg_epoch, 0)
+            self.ok = place("ok", ok, False)
+            self.weight = place("weight", weight, 0)
+            self.buckets = vote_weights_for(self._mesh, self.capacity)(
+                self.msg_block, self.weight)
+        else:
+            self.ok = jnp.asarray(ok)
+            self.weight = jnp.asarray(weight)
+            self.buckets = rebuild_buckets(self.msg_block, self.weight,
+                                           self.capacity)
         self._pending: list[tuple[np.ndarray, int, int]] = []
         self._fingerprint = self._store_fingerprint(store)
+        self._rev += 1
+
+    @staticmethod
+    def _active_mesh():
+        from pos_evolution_tpu.backend import get_backend
+        backend = get_backend()
+        if getattr(backend, "name", "") != "jax":
+            return None
+        return getattr(backend, "sharded_mesh", lambda: None)()
 
     def _store_fingerprint(self, store):
         """Events that void the incremental contracts: justified /
@@ -200,6 +255,7 @@ class ResidentForkChoice:
         rank = np.zeros(self.capacity, np.int32)
         rank[: len(self.roots)] = order
         self.rank = jnp.asarray(rank)
+        self._rev += 1
         self.sync(store)
 
     def note_attestation(self, attesting_indices, target_epoch: int,
@@ -255,6 +311,7 @@ class ResidentForkChoice:
         self.msg_block, self.msg_epoch, self.buckets = apply_latest_messages(
             self.msg_block, self.msg_epoch, self.buckets, val_idx, blocks,
             epochs, self.weight[val_idx], self.ok[val_idx])
+        self._rev += 1
 
     def note_slashing(self, indices) -> None:
         """Mirror ``on_attester_slashing``: discount landed votes and bar
@@ -277,27 +334,55 @@ class ResidentForkChoice:
             self.msg_block, self.msg_epoch, self.buckets, vi, self.weight[vi])
         self.ok = self.ok.at[vi].set(False)
         self.weight = self.weight.at[vi].set(0)
+        self._rev += 1
 
     # -- queries ---------------------------------------------------------------
+
+    def _memo_key(self, store) -> tuple:
+        """Everything a fresh ``_device_head`` reads beyond the resident
+        arrays themselves (covered by ``_rev``): the rebuild fingerprint
+        (justified/finalized checkpoints + epoch — boost *amount* and
+        leaf viability are functions of these), the boost root, and the
+        block count (``sync`` rebuild trigger)."""
+        return (self._rev, self._store_fingerprint(store),
+                bytes(store.proposer_boost_root), len(store.blocks))
 
     def head(self, store) -> bytes:
         """The fast-path head query: flush pending votes, read boost
         scalars from the spec store (they are per-slot host state,
-        pos-evolution.md:942-944), descend on device. Once degraded —
-        device error here or in a handler, or a self-check divergence —
-        every query answers from the spec walk instead."""
+        pos-evolution.md:942-944), descend on device. Repeated queries
+        with no intervening mutation answer from the memo — zero device
+        work (the driver asks several times per slot). The periodic
+        self-check audits fresh computations against the vectorized host
+        walk (``ops.forkchoice.get_head_host`` — an independent numpy
+        implementation, itself pinned bit-identical to the spec walk;
+        the pure-Python ``specs.forkchoice.get_head`` costs tens of
+        seconds per call at 64K+ validators and was most of
+        SCALE_DEMO_r06's get_head total). Once degraded — device error
+        here or in a handler, or a self-check divergence — every query
+        answers from the spec walk instead."""
         from pos_evolution_tpu.specs.forkchoice import get_head
         if self.degraded:
             return get_head(store)
         try:
+            if not self._pending and self._head_memo is not None:
+                key, root = self._head_memo
+                if key == self._memo_key(store):
+                    return root
             root = self._device_head(store)
+            self._head_memo = (self._memo_key(store), root)
         except Exception as e:
             self._degrade(f"device head query failed: {e!r}")
             return get_head(store)
         self._head_queries += 1
         if (self.selfcheck_every
                 and self._head_queries % self.selfcheck_every == 0):
-            spec_root = get_head(store)
+            deep_period = self.selfcheck_every * self.DEEPCHECK_FACTOR
+            if self._head_queries % deep_period == 0:
+                spec_root = get_head(store)   # fully independent oracle
+            else:
+                from pos_evolution_tpu.ops.forkchoice import get_head_host
+                spec_root = get_head_host(store)
             if spec_root != root:
                 self._degrade(
                     f"divergence self-check at query {self._head_queries}: "

@@ -377,8 +377,36 @@ def _session_arrays(state, eff_units):
     jaxrt.record_transfer(bal.nbytes + prev.nbytes + cur.nbytes
                           + eff_units.nbytes,
                           direction="h2d", site="fused_block_upload")
-    return (jnp.asarray(bal.astype(np.int64)), jnp.asarray(prev),
-            jnp.asarray(cur), jnp.asarray(eff_units))
+    place = _session_placer(bal.shape[0])
+    return (place("balances", bal.astype(np.int64)),
+            place("prev_flags", np.asarray(prev)),
+            place("cur_flags", np.asarray(cur)),
+            place("eff_units", np.asarray(eff_units)))
+
+
+def _session_placer(n: int):
+    """How session columns land on device: single-device ``jnp.asarray``
+    normally; per-shard slice placement over the validator mesh axes when
+    the jax backend's sharded mode is active with ``shard_transition``
+    (the session-column entry in ``parallel/partition.PARTITION_RULES``).
+    Registries that do not divide by the device count stay single-device
+    — the sweep's scatter targets would otherwise need padded-row
+    bookkeeping for no measurable win."""
+    jnp = _device()["jnp"]
+    try:
+        from pos_evolution_tpu.backend import jax_backend
+        if jax_backend.shard_transition_enabled():
+            mesh = jax_backend.sharded_mesh()
+            if n % mesh.size == 0:
+                from pos_evolution_tpu.parallel.partition import (
+                    shard_leaf,
+                    spec_for,
+                )
+                return lambda name, a: shard_leaf(
+                    mesh, spec_for(f"session/{name}"), a)
+    except Exception:
+        pass  # sharded placement is an optimization, never a requirement
+    return lambda name, a: jnp.asarray(a)
 
 
 def apply_attestation_rows_device(state, rows) -> None:

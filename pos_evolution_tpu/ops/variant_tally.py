@@ -37,8 +37,16 @@ from functools import partial
 import numpy as np
 
 
-def _next_pow2(x: int) -> int:
+def next_pow2(x: int) -> int:
+    """Shape-padding floor (>= 2) — the jax-free shared helper (this
+    module imports only numpy, so the spec/numpy paths and the backend
+    module can use it without initializing a jax runtime;
+    ``ops.forkchoice.next_pow2`` is the same function in the jax-only
+    half of the codebase)."""
     return max(int(2 ** np.ceil(np.log2(max(int(x), 2)))), 2)
+
+
+_next_pow2 = next_pow2  # internal call sites / backward compatibility
 
 
 # --- host twins (the bit-exact oracles) ---------------------------------------

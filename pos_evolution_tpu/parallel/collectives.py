@@ -38,6 +38,16 @@ class JaxCollectives:
         return jax.lax.psum(x, axis)
 
     @staticmethod
+    def psum_two_level(x, ici_axis=SHARD_AXIS, dcn_axis=POD_AXIS):
+        """Hierarchical allreduce: ICI within the pod first, DCN across
+        pods second — the reduction ordering every ISSUE-9 sharded
+        kernel uses (numerically identical to a fused two-axis psum for
+        the integer Gwei sums; the ordering matters for the network, not
+        the value)."""
+        import jax
+        return jax.lax.psum(jax.lax.psum(x, ici_axis), dcn_axis)
+
+    @staticmethod
     def pmax(x, axis):
         import jax
         return jax.lax.pmax(x, axis)
@@ -75,6 +85,10 @@ class NumpyCollectives:
 
     @staticmethod
     def psum(x, axis):
+        return x
+
+    @staticmethod
+    def psum_two_level(x, ici_axis=SHARD_AXIS, dcn_axis=POD_AXIS):
         return x
 
     @staticmethod

@@ -1,0 +1,192 @@
+"""Named-pytree partition rules (ISSUE 9 tentpole, layer 1).
+
+The resident hot-loop state — ``DenseRegistry`` epoch columns, the
+``ResidentForkChoice`` latest-message table, ``ops/transition.py``'s
+session columns — becomes *registered pytrees with explicit partition
+rules*: every leaf gets a ``/``-joined name, a regex rule table maps
+names to ``PartitionSpec``s (the fmengine/pjit idiom of SNIPPETS.md
+[1]/[3]), and shard/gather functions place leaves on the ``(pods,
+shard)`` mesh of ``parallel/sharded.py``.
+
+The long-context analogue (SURVEY.md §5) is literal here: the validator
+axis is the sequence-parallel axis, so every ``[N]`` registry column
+shards over ``(pods, shard)`` like a long sequence, while the O(B)
+block-tree columns and scalars replicate — reductions instead of ring
+attention.
+
+Shard-resident construction: ``build_sharded`` fills each shard's slice
+through a callback, so a mainnet-scale (1M-validator) column is *never
+materialized as one unsharded device buffer* — each device holds only
+its ``N / mesh.size`` slice from the start. ``shard_leaf`` places an
+existing host array the same way (per-shard slices, no full-array
+device_put); ``gather_tree`` is the inverse host offload used by
+checkpoint/resume (``utils/snapshot.py``), which re-shards on the
+*current* mesh — resume across mesh shapes is a gather + re-place, not
+a layout contract.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pos_evolution_tpu.parallel.collectives import POD_AXIS, SHARD_AXIS
+
+__all__ = [
+    "VALIDATOR_SPEC",
+    "REPLICATED",
+    "PARTITION_RULES",
+    "named_tree_map",
+    "match_partition_rules",
+    "shard_leaf",
+    "build_sharded",
+    "shard_tree",
+    "gather_tree",
+    "pad_rows",
+]
+
+# the validator (sequence-parallel) axis spans both mesh axes
+VALIDATOR_SPEC = P((POD_AXIS, SHARD_AXIS))
+REPLICATED = P()
+
+# Default rule table for this repo's resident pytrees. First match wins;
+# scalars always replicate regardless of rules (nothing to shard).
+PARTITION_RULES: tuple[tuple[str, P], ...] = (
+    # DenseRegistry / epoch-sweep columns: int64/uint8/bool [N]
+    (r"registry/.*", VALIDATOR_SPEC),
+    # resident fork-choice latest-message table + the dense driver's
+    # committee-assignment column: [N] over validators
+    (r"messages/(msg_block|msg_epoch|weight|ok|assigned)", VALIDATOR_SPEC),
+    # fused-transition session columns: [N] over validators
+    (r"session/(balances|prev_flags|cur_flags|eff_units)", VALIDATOR_SPEC),
+    # block-tree columns are O(B), replicated for the descent pass
+    (r"(store|tree)/.*", REPLICATED),
+    (r".*", REPLICATED),
+)
+
+
+def named_tree_map(fn, tree, sep: str = "/", _prefix: str = ""):
+    """Map ``fn(name, leaf)`` over a pytree of dicts / NamedTuples /
+    lists / tuples, where ``name`` is the ``sep``-joined path. NamedTuple
+    fields contribute their field names (the reason this walker exists:
+    ``jax.tree_util`` key paths name NamedTuple leaves by index)."""
+    if isinstance(tree, dict):
+        return {k: named_tree_map(fn, v, sep, f"{_prefix}{k}{sep}")
+                for k, v in tree.items()}
+    if hasattr(tree, "_fields"):  # NamedTuple
+        return type(tree)(*(
+            named_tree_map(fn, getattr(tree, f), sep, f"{_prefix}{f}{sep}")
+            for f in tree._fields))
+    if isinstance(tree, (list, tuple)):
+        mapped = [named_tree_map(fn, v, sep, f"{_prefix}{i}{sep}")
+                  for i, v in enumerate(tree)]
+        return type(tree)(mapped) if isinstance(tree, list) else tuple(mapped)
+    return fn(_prefix[: -len(sep)] if _prefix else _prefix, tree)
+
+
+def match_partition_rules(rules, tree):
+    """Pytree of ``PartitionSpec`` for ``tree`` by regex-matching leaf
+    names against ``rules`` (first ``re.search`` hit wins). Scalar /
+    single-element leaves never partition."""
+    def get_spec(name, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return REPLICATED
+        return _match(rules, name)
+    return named_tree_map(get_spec, tree)
+
+
+def _match(rules, name: str) -> P:
+    for rule, spec in rules:
+        if re.search(rule, name) is not None:
+            return spec
+    raise ValueError(f"no partition rule matched leaf {name!r}")
+
+
+def spec_for(name: str) -> P:
+    """Rule-table lookup for one named leaf — the entry point every live
+    placement site uses (`registry/*` in ``parallel/sharded.py``,
+    `messages/*` in ``ops/resident.py``, `session/*` in
+    ``ops/transition.py``, plus the dense driver), so editing
+    ``PARTITION_RULES`` actually changes runtime placement."""
+    return _match(PARTITION_RULES, name)
+
+
+def _shard_slices(mesh: Mesh, spec: P, shape) -> int:
+    """Number of distinct row-slices ``spec`` induces on axis 0."""
+    if not spec or spec[0] is None:
+        return 1
+    axes = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return n
+
+
+def shard_leaf(mesh: Mesh, spec: P, x):
+    """Place one host array on the mesh under ``spec`` without creating
+    a full-size single-device buffer: each addressable device receives
+    only its slice via ``make_array_from_callback``."""
+    x = np.asarray(x)
+    sharding = NamedSharding(mesh, spec)
+    if x.ndim == 0:
+        return jax.device_put(x, sharding)
+    n_slices = _shard_slices(mesh, spec, x.shape)
+    if x.shape[0] % n_slices != 0:
+        raise ValueError(
+            f"axis 0 ({x.shape[0]}) must divide by the {n_slices}-way "
+            f"shard count; pad with pad_rows first")
+    return jax.make_array_from_callback(
+        x.shape, sharding, lambda idx: np.ascontiguousarray(x[idx]))
+
+
+def build_sharded(mesh: Mesh, spec: P, shape, dtype, fill):
+    """Build a sharded array whose slices come straight from
+    ``fill(start, stop) -> np.ndarray`` — the shard-resident-from-the-
+    start constructor: nothing of global ``shape`` ever exists, on host
+    or device (used by the dense 1M-validator driver's genesis)."""
+    sharding = NamedSharding(mesh, spec)
+    n_slices = _shard_slices(mesh, spec, shape)
+    if shape[0] % n_slices != 0:
+        raise ValueError(f"shape[0]={shape[0]} must divide by {n_slices}")
+
+    def cb(idx):
+        s = idx[0]
+        start = 0 if s.start is None else s.start
+        stop = shape[0] if s.stop is None else s.stop
+        out = np.asarray(fill(int(start), int(stop)), dtype=dtype)
+        assert out.shape[0] == stop - start, "fill returned a wrong slice"
+        return np.ascontiguousarray(out)
+
+    return jax.make_array_from_callback(tuple(shape), sharding, cb)
+
+
+def shard_tree(mesh: Mesh, tree, rules=PARTITION_RULES):
+    """Shard every leaf of a named pytree per the rule table."""
+    specs = match_partition_rules(rules, tree)
+    flat_specs = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda s: isinstance(s, P))
+    flat = jax.tree_util.tree_leaves(tree)
+    placed = [shard_leaf(mesh, s, x) for s, x in zip(flat_specs, flat)]
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(tree), placed)
+
+
+def gather_tree(tree):
+    """Host-offload every leaf (gathers sharded arrays) — the
+    checkpoint side of resume-across-mesh-shapes."""
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def pad_rows(x: np.ndarray, n_to: int, fill) -> np.ndarray:
+    """Pad axis 0 to ``n_to`` rows with ``fill`` (inert-row values are
+    the caller's contract — see ``ops/epoch.pad_registry``)."""
+    x = np.asarray(x)
+    if x.shape[0] == n_to:
+        return x
+    pad = np.full((n_to - x.shape[0],) + x.shape[1:], fill, x.dtype)
+    return np.concatenate([x, pad])

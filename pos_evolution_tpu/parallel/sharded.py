@@ -46,7 +46,11 @@ from pos_evolution_tpu.ops.epoch import (  # noqa: E402
     EpochResult,
     epoch_core,
 )
-from pos_evolution_tpu.parallel.collectives import POD_AXIS, SHARD_AXIS  # noqa: E402
+from pos_evolution_tpu.parallel.collectives import (  # noqa: E402
+    POD_AXIS,
+    SHARD_AXIS,
+    JaxCollectives,
+)
 
 
 def make_mesh(n_devices: int | None = None, n_pods: int | None = None) -> Mesh:
@@ -70,9 +74,13 @@ def _replicated(mesh):
 
 
 def shard_registry(mesh: Mesh, reg: DenseRegistry) -> DenseRegistry:
-    """Place registry columns sharded over both validator mesh axes."""
-    sharding = NamedSharding(mesh, P((POD_AXIS, SHARD_AXIS)))
-    return DenseRegistry(*(jax.device_put(a, sharding) for a in reg))
+    """Place registry columns per the partition rules (``registry/*`` ->
+    validator axes; per-shard slice placement — no full-size
+    single-device buffer)."""
+    from pos_evolution_tpu.parallel.partition import shard_leaf, spec_for
+    return DenseRegistry(*(
+        shard_leaf(mesh, spec_for(f"registry/{f}"), np.asarray(a))
+        for f, a in zip(DenseRegistry._fields, reg)))
 
 
 def sharded_epoch_step(mesh: Mesh, cfg: Config):
@@ -108,6 +116,171 @@ def sharded_epoch_step(mesh: Mesh, cfg: Config):
                           reduce_fn=psum_both)
 
     return step
+
+
+# --- cached live-path kernels (ISSUE 9: the sharded backend mode) -------------
+#
+# The dry-run builders above construct a fresh jitted shard_map per call;
+# the live dispatch path (backend/jax_backend.py's ``sharded`` mode) goes
+# through these memoized builders instead, so per-slot hot loops reuse
+# one compiled executable per (mesh, static-shape) pair.
+
+_KERNEL_CACHE: dict = {}
+
+
+def _cached(key, build):
+    kern = _KERNEL_CACHE.get(key)
+    if kern is None:
+        kern = _KERNEL_CACHE[key] = build()
+    return kern
+
+
+def clear_kernel_cache() -> None:
+    """Drop memoized sharded kernels (tests; mesh teardown)."""
+    _KERNEL_CACHE.clear()
+
+
+def epoch_step_for(mesh: Mesh, cfg: Config, donate: bool = False):
+    """Memoized ``sharded_epoch_step`` with optional registry-buffer
+    donation (off-CPU only — XLA:CPU does not implement donation and
+    would warn per epoch; the epoch result rewrites the registry in
+    place on real devices, so HBM never holds two copies)."""
+    def build():
+        step = _sharded_epoch_core(mesh, cfg, donate)
+        return step
+    return _cached(("epoch", mesh, cfg, donate), build)
+
+
+def _sharded_epoch_core(mesh: Mesh, cfg: Config, donate: bool):
+    both = (POD_AXIS, SHARD_AXIS)
+    vspec = P(both)
+    scalar = P()
+
+    def psum_ici_dcn(x):
+        # ICI allreduce within the pod first, DCN across pods second —
+        # the collectives ordering of north-star config #4
+        return JaxCollectives.psum_two_level(x)
+
+    reg_specs = DenseRegistry(*([vspec] * len(DenseRegistry._fields)))
+    out_specs = EpochResult(
+        registry=reg_specs, total_active_balance=scalar,
+        prev_target_balance=scalar, cur_target_balance=scalar,
+        justify_prev=scalar, justify_cur=scalar,
+        new_justification_bits=scalar, finalize_epoch=scalar)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(reg_specs, scalar, scalar, scalar, scalar, scalar,
+                       scalar),
+             out_specs=out_specs)
+    def step(reg, current_epoch, finalized_epoch, justification_bits,
+             prev_justified_epoch, cur_justified_epoch, slashings_sum):
+        return epoch_core(reg, current_epoch, finalized_epoch,
+                          justification_bits, prev_justified_epoch,
+                          cur_justified_epoch, slashings_sum, cfg,
+                          reduce_fn=psum_ici_dcn)
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def vote_weights_for(mesh: Mesh, capacity: int):
+    """Memoized validator-sharded fork-choice vote pass (config #1):
+    identical collective shape to ``sharded_vote_weights`` but reused
+    across every head query of a run."""
+    return _cached(("votes", mesh, capacity),
+                   lambda: sharded_vote_weights(mesh, capacity))
+
+
+def link_tally_for(mesh: Mesh, n_links: int):
+    """Memoized sharded SSF supermajority-link / acknowledgment tally
+    (north-star config #5): the vote batch is sharded over the validator
+    mesh axes, each shard segment-sums its local slice, and the partial
+    per-link tallies allreduce ICI-first then DCN — the live-``SsfVariant``
+    fold of ``ssf_supermajority_tally``'s dry run. Bit-identical to
+    ``ops/variant_tally.link_tally_host`` (int64 adds reassociate
+    exactly). Batches must be padded to a multiple of ``mesh.size`` with
+    ``active=False`` rows (``backend/jax_backend.py`` does this)."""
+    both = (POD_AXIS, SHARD_AXIS)
+    vspec = P(both)
+
+    def build():
+        @jax.jit
+        @partial(shard_map, mesh=mesh, in_specs=(vspec, vspec, vspec),
+                 out_specs=P())
+        def tally(link_idx, weight, active):
+            ok = active & (link_idx >= 0) & (link_idx < n_links)
+            seg = jnp.where(ok, link_idx, n_links)
+            local = jax.ops.segment_sum(
+                jnp.where(ok, weight, 0), seg,
+                num_segments=n_links + 1)[:n_links]
+            return JaxCollectives.psum_two_level(local)  # ICI, then DCN
+        return tally
+    return _cached(("link", mesh, n_links), build)
+
+
+def windowed_tally_for(mesh: Mesh, n_blocks: int):
+    """Memoized sharded expiry-windowed vote tally (the Goldfish / RLMD /
+    SSF head-vote reduction of ``ops/variant_tally.py``), same ICI-first
+    DCN-second allreduce as ``link_tally_for``."""
+    both = (POD_AXIS, SHARD_AXIS)
+    vspec = P(both)
+
+    def build():
+        @jax.jit
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(vspec, vspec, vspec, vspec, P(), P()),
+                 out_specs=P())
+        def tally(block_idx, vote_slot, weight, active, lo, hi):
+            ok = (active & (block_idx >= 0) & (block_idx < n_blocks)
+                  & (vote_slot >= lo) & (vote_slot <= hi))
+            seg = jnp.where(ok, block_idx, n_blocks)
+            local = jax.ops.segment_sum(
+                jnp.where(ok, weight, 0), seg,
+                num_segments=n_blocks + 1)[:n_blocks]
+            return JaxCollectives.psum_two_level(local)  # ICI, then DCN
+        return tally
+    return _cached(("windowed", mesh, n_blocks), build)
+
+
+def shuffle_for(mesh: Mesh, n: int, rounds: int):
+    """Memoized ``sharded_shuffle`` (config #2) — the dense driver runs
+    one shuffle per epoch over an identical (mesh, n, rounds) signature;
+    without the cache each epoch would rebuild and recompile the
+    shard_map closure."""
+    return _cached(("shuffle", mesh, n, rounds),
+                   lambda: sharded_shuffle(mesh, n, rounds))
+
+
+def aggregation_verify_for(mesh: Mesh):
+    """Memoized ``sharded_aggregation_verify`` (config #3) for the live
+    per-slot sweep: the committee/batch axis shards over (pods, shard),
+    the pk-midstate table stays replicated, verdicts merge with one
+    tiled all_gather. The batch axis must be padded to a multiple of
+    ``mesh.size`` (callers pad with all-False bit rows and slice)."""
+    return _cached(("aggverify", mesh),
+                   lambda: sharded_aggregation_verify(mesh))
+
+
+def pad_batch_to_mesh(mesh: Mesh, arrays, fills, pow2: bool = True):
+    """Pad 1-D vote batches to a shard-able length: next power of two
+    (compile-storm discipline of ops/variant_tally.py) that divides by
+    ``mesh.size``, filled with inert rows; returns (padded jnp arrays
+    placed sharded, original length)."""
+    from pos_evolution_tpu.parallel.partition import (
+        VALIDATOR_SPEC,
+        pad_rows,
+        shard_leaf,
+    )
+    k = len(np.asarray(arrays[0]))
+    kp = max(k, 1)
+    if pow2:
+        kp = max(int(2 ** np.ceil(np.log2(max(kp, 2)))), 2)
+    if kp % mesh.size != 0:
+        kp = ((kp + mesh.size - 1) // mesh.size) * mesh.size
+    out = tuple(
+        shard_leaf(mesh, VALIDATOR_SPEC,
+                   pad_rows(np.asarray(a), kp, fill))
+        for a, fill in zip(arrays, fills))
+    return out, k
 
 
 def ssf_supermajority_tally(mesh: Mesh):

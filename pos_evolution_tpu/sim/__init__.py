@@ -9,6 +9,7 @@ from pos_evolution_tpu.sim.adversary import (
     SplitVoter,
     Withholder,
 )
+from pos_evolution_tpu.sim.dense_driver import DenseSimulation
 from pos_evolution_tpu.sim.driver import Simulation, ViewGroup
 from pos_evolution_tpu.sim.faults import (
     CrashWindow,

@@ -1,0 +1,581 @@
+"""Mainnet-scale end-to-end dense simulation on a device mesh (ISSUE 9).
+
+The spec-level ``sim/driver.py`` carries per-message Python objects —
+the right tool for adversarial/faulted protocol audits, and the wrong
+one for 10^6 validators (building one slot's attestations would cost
+minutes of host Python). This driver is the **array level of the whole
+simulation loop**: the registry, the latest-message table and the
+participation flags live as sharded device columns from genesis, and
+every per-slot protocol step is one of the three validator-axis sweeps
+run as ``shard_map`` kernels over the ``(pods, shard)`` mesh:
+
+- **fork choice** (north-star config #1): the head query rebuilds the
+  per-block vote buckets with the sharded segment-sum vote pass
+  (``parallel/sharded.vote_weights_for`` — psum ICI-first, DCN-second),
+  then descends on the replicated O(B) block tree
+  (``ops/forkchoice.head_from_buckets``);
+- **attestation flow**: committee assignment via the swap-or-not
+  shuffle (sharded per ``sharded_shuffle``'s index-parallel form), votes
+  land as masked elementwise updates on the sharded message/flag
+  columns — the dense image of one slot's gossip;
+- **aggregation verify** (config #3): each slot's committee aggregates
+  run through ``aggregate_verify_batch`` sharded over the committee
+  axis;
+- **epoch processing** (config #4): the fused ``epoch_core`` sweep as a
+  ``shard_map`` with two-axis psum; justification bits and the 4-case
+  finalization rule drive real finality.
+
+Everything is integer math, so the sharded run is **bit-identical** to
+the single-device one (``mesh=None``) on every mesh shape — pinned in
+tests/test_sharded_e2e.py together with the host-walk oracle
+(``resident_head_equals_spec_walk``: the device head must equal the
+vectorized NumPy walk ``ops/forkchoice.head_host`` over the gathered
+message table, subsampled every ``check_walk_every`` slots).
+
+Checkpoint/resume gathers the sharded columns to host (`.npz` + JSON
+meta) and re-shards on the mesh active at resume time — resuming on a
+*different* mesh shape (or a single device) is bit-identical by the
+same kernel contracts.
+
+``scripts/multichip_demo.py`` drives this at 1M validators for
+``MULTICHIP_r09.json``; ``bench_all.py`` times a small configuration as
+the ``bench_shard`` history emission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+
+import numpy as np
+
+from pos_evolution_tpu.config import Config, mainnet_config
+
+__all__ = ["DenseSimulation"]
+
+
+def _hash(*parts) -> bytes:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p if isinstance(p, bytes) else str(p).encode())
+    return h.digest()
+
+
+from pos_evolution_tpu.ops.variant_tally import (  # noqa: E402
+    next_pow2 as _next_pow2,
+)
+
+
+class DenseSimulation:
+    """Honest synchronous multi-epoch run, entirely at the array level.
+
+    ``mesh=None`` runs the identical loop on a single device (the
+    differential twin). ``n_validators`` must divide by ``mesh.size``
+    when a mesh is given (the shuffle shards the index axis evenly).
+    """
+
+    def __init__(self, n_validators: int, cfg: Config | None = None,
+                 mesh=None, seed: int = 0, shuffle_rounds: int = 10,
+                 verify_aggregates: bool = True, capacity: int = 256,
+                 check_walk_every: int = 16):
+        import jax.numpy as jnp
+        self.cfg = cfg or mainnet_config()
+        self.n = int(n_validators)
+        self.mesh = mesh
+        self.seed = int(seed)
+        self.shuffle_rounds = int(shuffle_rounds)
+        self.verify_aggregates = bool(verify_aggregates)
+        self.check_walk_every = int(check_walk_every)
+        self.S = int(self.cfg.slots_per_epoch)
+        if mesh is not None and self.n % mesh.size != 0:
+            raise ValueError(
+                f"n_validators={self.n} must divide by the mesh device "
+                f"count {mesh.size}")
+        self._npad = self.n  # registry rows incl. inert padding (== n here)
+
+        # --- registry: sharded-resident from genesis -----------------------
+        gwei = 10**9
+        far = np.int64(2**62)  # FAR_FUTURE_I64
+
+        def fill_const(v, dtype):
+            return lambda lo, hi: np.full(hi - lo, v, dtype)
+
+        col_fills = {
+            "effective_balance": (32 * gwei, np.int64),
+            "balance": (32 * gwei, np.int64),
+            "activation_epoch": (0, np.int64),
+            "exit_epoch": (far, np.int64),
+            "withdrawable_epoch": (far, np.int64),
+            "slashed": (False, bool),
+            "prev_flags": (0, np.uint8),
+            "cur_flags": (0, np.uint8),
+            "inactivity_scores": (0, np.int64),
+        }
+        from pos_evolution_tpu.ops.epoch import DenseRegistry
+        if mesh is not None:
+            # never materialized unsharded: each device fills its slice,
+            # placed per the partition rules (registry/* and messages/*)
+            from pos_evolution_tpu.parallel.partition import (
+                build_sharded,
+                spec_for,
+            )
+            self.registry = DenseRegistry(**{
+                f: build_sharded(mesh, spec_for(f"registry/{f}"), (self.n,),
+                                 dt, fill_const(v, dt))
+                for f, (v, dt) in col_fills.items()})
+            self.msg_block = build_sharded(
+                mesh, spec_for("messages/msg_block"), (self.n,),
+                np.int32, fill_const(-1, np.int32))
+            self.msg_epoch = build_sharded(
+                mesh, spec_for("messages/msg_epoch"), (self.n,),
+                np.int64, fill_const(0, np.int64))
+        else:
+            self.registry = DenseRegistry(**{
+                f: jnp.full(self.n, v, dtype=dt)
+                for f, (v, dt) in col_fills.items()})
+            self.msg_block = jnp.full(self.n, -1, dtype=jnp.int32)
+            self.msg_epoch = jnp.zeros(self.n, dtype=jnp.int64)
+
+        # --- replicated O(B) block tree ------------------------------------
+        self.capacity = _next_pow2(capacity)
+        self.roots: list[bytes] = []
+        self.parents: list[int] = []
+        self.block_slots: list[int] = []
+        self._parent_d = jnp.full(self.capacity, -1, dtype=jnp.int32)
+        self._slot_d = jnp.zeros(self.capacity, dtype=jnp.int32)
+        self._rank_d = jnp.zeros(self.capacity, dtype=jnp.int32)
+        self._real_d = jnp.zeros(self.capacity, dtype=bool)
+        self._viable_d = jnp.ones(self.capacity, dtype=bool)
+
+        # --- FFG scalars ----------------------------------------------------
+        self.slot = 0
+        self.bits = np.zeros(4, dtype=bool)
+        self.prev_just = (0, 0)   # (epoch, block index)
+        self.cur_just = (0, 0)
+        self.finalized = (0, 0)
+        self.epoch_start_idx: dict[int, int] = {0: 0}
+        self.metrics: list[dict] = []
+        self.aggregates_verified = 0
+        self.walk_checks: list[bool] = []
+        self._epoch_ready = -1
+        self._perm_host: np.ndarray | None = None
+
+        # synthetic per-validator pubkeys -> replicated signature midstates
+        # (the pk table is replicated by design, SURVEY's config #3 note)
+        from pos_evolution_tpu.ops.aggregation import precompute_pk_states
+        rng = np.random.default_rng(self.seed)
+        self.pk_states = precompute_pk_states(
+            rng.integers(0, 256, (self.n, 48)).astype(np.uint8))
+
+        self._append_block(_hash(b"genesis", self.seed), -1, 0)
+
+    # -- block tree ------------------------------------------------------------
+
+    def _append_block(self, root: bytes, parent: int, slot: int) -> int:
+        import jax.numpy as jnp
+        i = len(self.roots)
+        if i >= self.capacity:
+            self._grow(self.capacity * 2)
+        self.roots.append(root)
+        self.parents.append(parent)
+        self.block_slots.append(slot)
+        self._parent_d = self._parent_d.at[i].set(parent)
+        self._slot_d = self._slot_d.at[i].set(slot)
+        self._real_d = self._real_d.at[i].set(True)
+        order = np.argsort(np.argsort(np.array(self.roots, dtype=object)))
+        rank = np.zeros(self.capacity, np.int32)
+        rank[: len(self.roots)] = order
+        self._rank_d = jnp.asarray(rank)
+        return i
+
+    def _grow(self, new_capacity: int) -> None:
+        import jax.numpy as jnp
+        new_capacity = _next_pow2(new_capacity)
+        b = len(self.roots)
+        parent = np.full(new_capacity, -1, np.int32)
+        parent[:b] = self.parents
+        slot = np.zeros(new_capacity, np.int32)
+        slot[:b] = self.block_slots
+        real = np.zeros(new_capacity, bool)
+        real[:b] = True
+        self.capacity = new_capacity
+        self._parent_d = jnp.asarray(parent)
+        self._slot_d = jnp.asarray(slot)
+        self._rank_d = jnp.zeros(new_capacity, jnp.int32)
+        self._real_d = jnp.asarray(real)
+        self._viable_d = jnp.ones(new_capacity, bool)
+
+    # -- committees ------------------------------------------------------------
+
+    def _start_epoch(self, epoch: int) -> None:
+        """Shuffle the registry into this epoch's slot assignment
+        (config #2: the index axis is embarrassingly parallel)."""
+        import jax.numpy as jnp
+        seed = _hash(b"shuffle", self.seed, epoch)[:32]
+        if self.mesh is not None:
+            from pos_evolution_tpu.ops.shuffle import _seed_words, host_pivots
+            from pos_evolution_tpu.parallel.sharded import shuffle_for
+            shuf = shuffle_for(self.mesh, self.n, self.shuffle_rounds)
+            perm = shuf(jnp.asarray(_seed_words(seed)),
+                        jnp.asarray(host_pivots(seed, self.n,
+                                                self.shuffle_rounds)),
+                        jnp.arange(self.n, dtype=jnp.int32))
+        else:
+            from pos_evolution_tpu.ops.shuffle import shuffle_permutation_jax
+            perm = shuffle_permutation_jax(seed, self.n, self.shuffle_rounds)
+        perm_host = np.asarray(perm).astype(np.int64)
+        self._perm_host = perm_host
+        self._inv_perm = np.argsort(perm_host).astype(np.int64)
+        assigned = perm_host * self.S // self.n
+        self._assigned = self._place_validator_col(assigned.astype(np.int64))
+        self._epoch_ready = epoch
+
+    def _place_validator_col(self, a: np.ndarray,
+                             name: str = "messages/assigned"):
+        import jax.numpy as jnp
+        if self.mesh is None:
+            return jnp.asarray(a)
+        from pos_evolution_tpu.parallel.partition import shard_leaf, spec_for
+        return shard_leaf(self.mesh, spec_for(name), a)
+
+    def _slot_attesters(self, slot_in_epoch: int) -> np.ndarray:
+        t = int(slot_in_epoch)
+        lo = (t * self.n + self.S - 1) // self.S
+        hi = ((t + 1) * self.n + self.S - 1) // self.S
+        return self._inv_perm[lo:hi]
+
+    # -- fork choice -----------------------------------------------------------
+
+    def _head(self) -> int:
+        import jax.numpy as jnp
+
+        from pos_evolution_tpu.ops.forkchoice import (
+            head_from_buckets,
+            rebuild_buckets,
+        )
+        if self.mesh is not None:
+            from pos_evolution_tpu.parallel.sharded import vote_weights_for
+            buckets = vote_weights_for(self.mesh, self.capacity)(
+                self.msg_block, self.registry.effective_balance)
+        else:
+            buckets = rebuild_buckets(self.msg_block,
+                                      self.registry.effective_balance,
+                                      self.capacity)
+        head_idx, _ = head_from_buckets(
+            self._parent_d, self._real_d, self._rank_d, self._viable_d,
+            jnp.int32(self.cur_just[1]), buckets, jnp.int32(-1),
+            jnp.int64(0), self.capacity)
+        return int(head_idx)
+
+    def head_host_walk(self) -> bytes:
+        """The spec-walk oracle: gather the message table, accumulate
+        vote weights and subtree sums in NumPy, descend greedily — the
+        ``resident_head_equals_spec_walk`` pin of MULTICHIP_r09."""
+        from pos_evolution_tpu.ops.forkchoice import head_host
+        msg = np.asarray(self.msg_block)[: self.n]
+        eff = np.asarray(self.registry.effective_balance)[: self.n]
+        valid = msg >= 0
+        vw = np.zeros(self.capacity + 1, np.int64)
+        np.add.at(vw, np.where(valid, msg, self.capacity),
+                  np.where(valid, eff, 0))
+        b = len(self.roots)
+        parent = np.full(self.capacity, -1, np.int32)
+        parent[:b] = self.parents
+        real = np.zeros(self.capacity, bool)
+        real[:b] = True
+        rank = np.asarray(self._rank_d)
+        idx = head_host(parent, real, rank, np.ones(self.capacity, bool),
+                        self.cur_just[1], vw[: self.capacity], -1, 0)
+        return self.roots[idx]
+
+    # -- votes -----------------------------------------------------------------
+
+    def _cast_votes(self, slot_in_epoch: int, block_idx: int,
+                    epoch: int) -> None:
+        import jax.numpy as jnp
+        global _VOTE_KERNEL
+        if _VOTE_KERNEL is None:
+            import jax
+
+            def kern(msg_block, msg_epoch, cur_flags, assigned, t, idx, ep):
+                mask = assigned == t
+                return (jnp.where(mask, idx, msg_block),
+                        jnp.where(mask, ep, msg_epoch),
+                        jnp.where(mask, cur_flags | np.uint8(7), cur_flags))
+            _VOTE_KERNEL = jax.jit(kern)
+        self.msg_block, self.msg_epoch, cur = _VOTE_KERNEL(
+            self.msg_block, self.msg_epoch, self.registry.cur_flags,
+            self._assigned, jnp.int64(slot_in_epoch),
+            jnp.int32(block_idx), jnp.int64(epoch))
+        self.registry = self.registry._replace(cur_flags=cur)
+
+    # -- aggregation verify ----------------------------------------------------
+
+    def _verify_slot(self, slot_in_epoch: int, block_root: bytes) -> None:
+        import jax.numpy as jnp
+
+        from pos_evolution_tpu.ops.aggregation import messages_to_words
+        attesters = self._slot_attesters(slot_in_epoch)
+        if attesters.size == 0:
+            return
+        a_real = int(self.cfg.max_committees_per_slot)
+        lanes = _next_pow2(-(-attesters.size // a_real))
+        committees = np.zeros((a_real, lanes), np.int32)
+        bits = np.zeros((a_real, lanes), bool)
+        for c in range(a_real):
+            member = attesters[c::a_real]
+            committees[c, : member.size] = member
+            bits[c, : member.size] = True
+        msg = messages_to_words(
+            np.frombuffer(block_root, dtype=np.uint8)[None, :].repeat(
+                a_real, axis=0))
+        sigs = _make_aggregates(self.pk_states, jnp.asarray(committees),
+                                jnp.asarray(bits), jnp.asarray(msg))
+        if self.mesh is not None:
+            from pos_evolution_tpu.parallel.sharded import (
+                aggregation_verify_for,
+            )
+            a_pad = -(-a_real // self.mesh.size) * self.mesh.size
+            if a_pad != a_real:
+                committees = np.concatenate(
+                    [committees, np.zeros((a_pad - a_real, lanes), np.int32)])
+                bits_p = np.concatenate(
+                    [bits, np.zeros((a_pad - a_real, lanes), bool)])
+                msg = np.concatenate(
+                    [msg, np.zeros((a_pad - a_real, 8), np.uint32)])
+                sigs = jnp.concatenate(
+                    [sigs, jnp.zeros((a_pad - a_real, 24), jnp.uint32)])
+            else:
+                bits_p = bits
+            ok = aggregation_verify_for(self.mesh)(
+                self.pk_states, jnp.asarray(committees),
+                jnp.asarray(bits_p), jnp.asarray(msg), sigs)
+        else:
+            from pos_evolution_tpu.ops.aggregation import (
+                aggregate_verify_batch,
+            )
+            ok = aggregate_verify_batch(self.pk_states,
+                                        jnp.asarray(committees),
+                                        jnp.asarray(bits), jnp.asarray(msg),
+                                        sigs)
+        ok = np.asarray(ok)[:a_real]
+        nonempty = bits.any(axis=1)
+        if not ok[nonempty].all():
+            raise AssertionError(
+                f"aggregate verification failed at slot {self.slot + 1}")
+        self.aggregates_verified += int(nonempty.sum())
+
+    # -- epoch boundary --------------------------------------------------------
+
+    def _epoch_boundary(self, entering_epoch: int) -> None:
+        """Spec-mirrored epoch processing when entering ``entering_epoch``
+        (``current_epoch`` = the epoch just completed, exactly like
+        ``process_epoch`` running at slot E*S - 1)."""
+        import jax.numpy as jnp
+        cur_e = entering_epoch - 1
+        if self.mesh is not None:
+            from pos_evolution_tpu.parallel.sharded import epoch_step_for
+            import jax
+            step = epoch_step_for(self.mesh, self.cfg,
+                                  donate=jax.default_backend() != "cpu")
+        else:
+            from pos_evolution_tpu.ops.epoch import process_epoch_dense
+            step = lambda *a: process_epoch_dense(*a, self.cfg)  # noqa: E731
+        out = step(self.registry, jnp.int64(cur_e),
+                   jnp.int64(self.finalized[0]), jnp.asarray(self.bits),
+                   jnp.int64(self.prev_just[0]), jnp.int64(self.cur_just[0]),
+                   jnp.int64(0))
+        self.registry = out.registry
+        if cur_e > 1:
+            old_prev, old_cur = self.prev_just, self.cur_just
+            self.prev_just = self.cur_just
+            if bool(out.justify_prev):
+                self.cur_just = (cur_e - 1, self.epoch_start_idx[cur_e - 1])
+            if bool(out.justify_cur):
+                self.cur_just = (cur_e, self.epoch_start_idx[cur_e])
+            self.bits = np.asarray(out.new_justification_bits)
+            fin = int(out.finalize_epoch)
+            if fin >= 0:
+                # later finalization cases use the old CURRENT justified
+                # checkpoint and win in the spec — check it first
+                if fin == old_cur[0]:
+                    self.finalized = old_cur
+                elif fin == old_prev[0]:
+                    self.finalized = old_prev
+
+    # -- main loop -------------------------------------------------------------
+
+    def run_slot(self) -> None:
+        s = self.slot + 1
+        epoch = s // self.S
+        if s % self.S == 0 and s > 0:
+            self._epoch_boundary(epoch)
+        if self._epoch_ready < epoch:
+            self._start_epoch(epoch)
+        head = self._head()
+        root = _hash(b"block", self.seed, s, self.roots[head])
+        idx = self._append_block(root, head, s)
+        if s % self.S == 0:
+            self.epoch_start_idx[epoch] = idx
+        self._cast_votes(s % self.S, idx, epoch)
+        if self.verify_aggregates:
+            self._verify_slot(s % self.S, root)
+        self.slot = s
+        if self.check_walk_every and s % self.check_walk_every == 0:
+            self.walk_checks.append(self.head_host_walk() == root)
+        self.metrics.append({
+            "slot": s, "head_root": root.hex()[:16],
+            "justified_epoch": self.cur_just[0],
+            "finalized_epoch": self.finalized[0],
+            "n_blocks": len(self.roots),
+        })
+
+    def run_epochs(self, n_epochs: int) -> None:
+        """Run through the first slot of epoch ``n_epochs`` (inclusive),
+        so the boundary entering it — the one that can finalize epoch
+        ``n_epochs - 2`` — has been processed (the spec driver's
+        ``run_epochs`` shape)."""
+        while self.slot < n_epochs * self.S:
+            self.run_slot()
+
+    # -- results ---------------------------------------------------------------
+
+    def summary(self) -> dict:
+        self.walk_checks.append(self.head_host_walk() == self.roots[-1])
+        return {
+            "n_validators": self.n,
+            "mesh": (None if self.mesh is None else
+                     {a: int(s) for a, s in zip(self.mesh.axis_names,
+                                                self.mesh.devices.shape)}),
+            "slots": self.slot,
+            "epochs": self.slot // self.S,
+            "n_blocks": len(self.roots),
+            "justified_epoch": self.cur_just[0],
+            "finalized_epoch": self.finalized[0],
+            "finality_reached": self.finalized[0] > 0,
+            "aggregates_verified": self.aggregates_verified,
+            "resident_head_equals_spec_walk": all(self.walk_checks),
+            "walk_checks": len(self.walk_checks),
+            "head_root": self.roots[-1].hex()[:16],
+        }
+
+    # -- checkpoint / resume (gather -> host -> re-shard) ----------------------
+
+    def checkpoint(self) -> bytes:
+        """Gather every device column to host and serialize. The layout
+        (mesh shape, sharding) is deliberately NOT part of the format:
+        ``resume`` re-places columns on whatever mesh it is given —
+        checkpoint on 2x4, resume on 4x2/1x8/single-device, bit-identical
+        (tests/test_sharded_e2e.py pins the round trip)."""
+        out = io.BytesIO()
+        meta = {
+            "version": 1, "n": self.n, "seed": self.seed,
+            "shuffle_rounds": self.shuffle_rounds,
+            "verify_aggregates": self.verify_aggregates,
+            "capacity": self.capacity,
+            "check_walk_every": self.check_walk_every,
+            "cfg": {k: (["__bytes__", v.hex()] if isinstance(v, bytes) else v)
+                    for k, v in dataclasses.asdict(self.cfg).items()},
+            "slot": self.slot,
+            "bits": [bool(b) for b in self.bits],
+            "prev_just": list(self.prev_just),
+            "cur_just": list(self.cur_just),
+            "finalized": list(self.finalized),
+            "epoch_start_idx": {str(k): v
+                                for k, v in self.epoch_start_idx.items()},
+            "roots": [r.hex() for r in self.roots],
+            "parents": self.parents,
+            "block_slots": self.block_slots,
+            "aggregates_verified": self.aggregates_verified,
+            "walk_checks": [bool(b) for b in self.walk_checks],
+            "metrics": self.metrics,
+            "epoch_ready": self._epoch_ready,
+        }
+        head = json.dumps(meta).encode()
+        out.write(np.uint64(len(head)).tobytes())
+        out.write(head)
+        cols = {f: np.asarray(getattr(self.registry, f))[: self.n]
+                for f in self.registry._fields}
+        cols["msg_block"] = np.asarray(self.msg_block)[: self.n]
+        cols["msg_epoch"] = np.asarray(self.msg_epoch)[: self.n]
+        if self._perm_host is not None:
+            cols["perm"] = self._perm_host
+        np.savez_compressed(out, **cols)
+        return out.getvalue()
+
+    @classmethod
+    def resume(cls, data: bytes, mesh=None) -> "DenseSimulation":
+        buf = io.BytesIO(data)
+        (n_head,) = np.frombuffer(buf.read(8), dtype=np.uint64)
+        meta = json.loads(buf.read(int(n_head)).decode())
+        assert meta["version"] == 1
+        cfg = Config(**{
+            k: (bytes.fromhex(v[1])
+                if isinstance(v, list) and len(v) == 2 and v[0] == "__bytes__"
+                else v)
+            for k, v in meta["cfg"].items()})
+        sim = cls(meta["n"], cfg=cfg, mesh=mesh, seed=meta["seed"],
+                  shuffle_rounds=meta["shuffle_rounds"],
+                  verify_aggregates=meta["verify_aggregates"],
+                  capacity=meta["capacity"],
+                  check_walk_every=meta["check_walk_every"])
+        with np.load(buf) as z:
+            from pos_evolution_tpu.ops.epoch import DenseRegistry
+            sim.registry = DenseRegistry(**{
+                f: sim._place_validator_col(z[f], f"registry/{f}")
+                for f in DenseRegistry._fields})
+            sim.msg_block = sim._place_validator_col(z["msg_block"],
+                                                     "messages/msg_block")
+            sim.msg_epoch = sim._place_validator_col(z["msg_epoch"],
+                                                     "messages/msg_epoch")
+            perm = z["perm"] if "perm" in z.files else None
+        sim.roots = [bytes.fromhex(r) for r in meta["roots"]]
+        sim.parents = list(meta["parents"])
+        sim.block_slots = list(meta["block_slots"])
+        b = len(sim.roots)
+        import jax.numpy as jnp
+        parent = np.full(sim.capacity, -1, np.int32)
+        parent[:b] = sim.parents
+        slot = np.zeros(sim.capacity, np.int32)
+        slot[:b] = sim.block_slots
+        real = np.zeros(sim.capacity, bool)
+        real[:b] = True
+        order = np.argsort(np.argsort(np.array(sim.roots, dtype=object)))
+        rank = np.zeros(sim.capacity, np.int32)
+        rank[:b] = order
+        sim._parent_d = jnp.asarray(parent)
+        sim._slot_d = jnp.asarray(slot)
+        sim._rank_d = jnp.asarray(rank)
+        sim._real_d = jnp.asarray(real)
+        sim.slot = meta["slot"]
+        sim.bits = np.asarray(meta["bits"], dtype=bool)
+        sim.prev_just = tuple(meta["prev_just"])
+        sim.cur_just = tuple(meta["cur_just"])
+        sim.finalized = tuple(meta["finalized"])
+        sim.epoch_start_idx = {int(k): v
+                               for k, v in meta["epoch_start_idx"].items()}
+        sim.aggregates_verified = meta["aggregates_verified"]
+        sim.walk_checks = list(meta["walk_checks"])
+        sim.metrics = list(meta["metrics"])
+        sim._epoch_ready = meta["epoch_ready"]
+        if perm is not None and sim._epoch_ready >= 0:
+            sim._perm_host = perm.astype(np.int64)
+            sim._inv_perm = np.argsort(sim._perm_host).astype(np.int64)
+            assigned = sim._perm_host * sim.S // sim.n
+            sim._assigned = sim._place_validator_col(
+                assigned.astype(np.int64))
+        return sim
+
+
+_VOTE_KERNEL = None
+
+
+def _make_aggregates(pk_states, committees, bits, msg_words):
+    """Each slot's aggregation duty: the honest committee aggregates
+    from ``ops.aggregation.aggregate_signatures_batch`` (the signer side
+    of the verification sweep)."""
+    from pos_evolution_tpu.ops.aggregation import aggregate_signatures_batch
+    return aggregate_signatures_batch(pk_states, committees, bits,
+                                      msg_words)

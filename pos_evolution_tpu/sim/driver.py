@@ -275,11 +275,45 @@ class Simulation:
                  genesis_time: int = 0, accelerated_forkchoice: bool = False,
                  telemetry=None, profile=None, adversaries=(), monitors=(),
                  das=None, prewarm: bool = False, compile_cache=None,
-                 variant=None):
+                 variant=None, sharded=None):
         self.cfg = cfg()
         self.schedule = schedule or honest_schedule(n_validators)
         self.n_validators = n_validators
         self.genesis_time = genesis_time
+        # Sharded execution (ISSUE 9, DESIGN.md §17): ``sharded`` turns on
+        # the jax backend's device-mesh mode BEFORE any resident state is
+        # built, so registry columns, the resident fork-choice message
+        # table and (optionally) the fused-transition session land sharded
+        # over the (pods, shard) validator axes and the hot sweeps run as
+        # shard_map kernels. Accepted forms: True (auto mesh over all
+        # devices), a (pods, shard) tuple, or a prebuilt Mesh; False
+        # explicitly disables a previously enabled mode (the mode is
+        # process-global on the backend module); None leaves it untouched.
+        # Bit-identity with the single-device path is pinned in
+        # tests/test_sharded_e2e.py.
+        self.sharded = None
+        if sharded is not None:
+            from pos_evolution_tpu.backend import get_backend
+            backend = get_backend()
+            is_jax = getattr(backend, "name", "") == "jax"
+            if sharded is False:
+                if is_jax:
+                    backend.disable_sharded()
+            else:
+                if not is_jax:
+                    raise ValueError(
+                        "Simulation(sharded=...) requires the jax backend "
+                        "(set_backend('jax'))")
+                if sharded is True:
+                    mesh = backend.enable_sharded()
+                elif isinstance(sharded, tuple):
+                    pods, shard = sharded
+                    mesh = backend.enable_sharded(int(pods) * int(shard),
+                                                  int(pods))
+                else:
+                    mesh = backend.enable_sharded(mesh=sharded)
+                self.sharded = {a: int(s) for a, s in
+                                zip(mesh.axis_names, mesh.devices.shape)}
         # Telemetry (pos_evolution_tpu/telemetry.Telemetry): opt-in event
         # bus + metrics registry. NOT simulation state — checkpoint()
         # excludes it (like wall-clock timings); pass it again to resume()
@@ -434,7 +468,7 @@ class Simulation:
                 "run_start", n_validators=n_validators,
                 n_groups=self.schedule.n_groups, genesis_time=genesis_time,
                 accelerated_forkchoice=accelerated_forkchoice,
-                debug=telemetry.debug)
+                sharded=self.sharded, debug=telemetry.debug)
         self._bind_adversaries_and_monitors()
 
     def _get_head(self, group: ViewGroup) -> bytes:
@@ -1207,7 +1241,7 @@ class Simulation:
     @classmethod
     def resume(cls, data: bytes, schedule: Schedule | None = None,
                telemetry=None, adversaries=(), monitors=(),
-               das=None, variant=None) -> "Simulation":
+               das=None, variant=None, sharded=None) -> "Simulation":
         """Rebuild a checkpointed simulation mid-run. ``schedule`` must be
         the same delivery/fault policy the original run used (schedules
         hold callables, which do not serialize); None resumes an honest
@@ -1227,11 +1261,15 @@ class Simulation:
         the checkpoint's describe() fingerprint (variant state — vote
         overlays, confirmations, per-slot FFG — is serialized, so a chaos
         repro bundle replays under the variant that produced it); a
-        mismatched explicit variant raises."""
+        mismatched explicit variant raises. ``sharded`` overrides the
+        checkpointed mesh shape (None re-enables the recorded one;
+        resident columns rebuild sharded on the CURRENT mesh, so resuming
+        on a different mesh shape — or a different device count — is a
+        gather + re-shard, bit-identical by the kernel contracts)."""
         from pos_evolution_tpu.utils.snapshot import load_simulation
         return load_simulation(data, schedule=schedule, telemetry=telemetry,
                                adversaries=adversaries, monitors=monitors,
-                               das=das, variant=variant)
+                               das=das, variant=variant, sharded=sharded)
 
     # -- accessors --
     def store(self, group: int = 0) -> fc.Store:

@@ -181,6 +181,13 @@ def save_simulation(sim) -> bytes:
         "genesis_time": sim.genesis_time,
         "slot": sim.slot,
         "accelerated": sim.accelerated_forkchoice,
+        # Sharded mode (ISSUE 9): only the mesh SHAPE is simulation
+        # state. Resident device arrays are never serialized — they
+        # rebuild from the restored stores, placed per the partition
+        # rules on whatever mesh is active at resume time, so a
+        # checkpoint taken on a 2x4 mesh resumes bit-identically on 4x2,
+        # 1x8, or a single device (pinned in tests/test_sharded_e2e.py).
+        "sharded": getattr(sim, "sharded", None),
         "metrics": sim.metrics,
         "archive_roots": [r.hex() for r in sim.block_archive],
         # DAS (das/, DESIGN.md §15): sidecar CONTENT is a seeded pure
@@ -236,7 +243,8 @@ def save_simulation(sim) -> bytes:
 
 
 def load_simulation(data: bytes, schedule=None, telemetry=None,
-                    adversaries=(), monitors=(), das=None, variant=None):
+                    adversaries=(), monitors=(), das=None, variant=None,
+                    sharded=None):
     """Rebuild a ``save_simulation`` checkpoint into a live Simulation.
     ``schedule`` must be the run's original Schedule (with its FaultPlan)
     for faithful replay; crash flags re-derive from the plan + slot.
@@ -256,9 +264,17 @@ def load_simulation(data: bytes, schedule=None, telemetry=None,
     # Telemetry attaches AFTER the restore (below), not here: __init__
     # would emit a run_start describing the skeleton (accelerated=False,
     # slot 0) instead of the checkpointed run.
+    # Re-enable (or override) the sharded backend mode BEFORE residents
+    # rebuild, so the restored message columns land sharded on the
+    # current mesh (resume-across-mesh-shapes: the mesh shape is policy,
+    # not layout — a different shape or device count re-shards).
+    if sharded is None:
+        meta_sharded = meta.get("sharded")
+        sharded = (tuple(meta_sharded[a] for a in ("pods", "shard"))
+                   if meta_sharded else None)
     sim = Simulation(meta["n_validators"], schedule=schedule,
                      genesis_time=meta["genesis_time"],
-                     accelerated_forkchoice=False)
+                     accelerated_forkchoice=False, sharded=sharded)
     sim.accelerated_forkchoice = meta["accelerated"]
     assert len(sim.groups) == len(meta["groups"]), \
         "schedule shape does not match the checkpointed run"

@@ -48,6 +48,9 @@ def main(argv=None) -> int:
     ap.add_argument("--json", help="write the bench_das emission here")
     ap.add_argument("--history",
                     help="append the emission to this bench_history.jsonl")
+    ap.add_argument("--record", type=int, default=None,
+                    help="also write the emission to DAS_DEMO_r{N}.json "
+                         "at the repo root (the ROADMAP item 4 artifact)")
     args = ap.parse_args(argv)
 
     from pos_evolution_tpu.backend import set_backend
@@ -124,6 +127,14 @@ def main(argv=None) -> int:
                 json.dump(emission, fh, indent=1, sort_keys=True)
                 fh.write("\n")
             print(f"emission -> {args.json}")
+        if args.record is not None:
+            path = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                f"DAS_DEMO_r{args.record:02d}.json")
+            with open(path, "w") as fh:
+                json.dump(emission, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            print(f"record   -> {path}")
         if args.history:
             from pos_evolution_tpu.profiling import history
             history.append_entry(args.history, emission, kind="bench_das")

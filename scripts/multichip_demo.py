@@ -1,0 +1,136 @@
+"""Full multi-epoch END-TO-END simulation at mainnet scale on a device
+mesh (ISSUE 9 / ROADMAP item 1) — not per-kernel probes.
+
+Runs ``sim/dense_driver.DenseSimulation`` — the array-level simulation
+loop whose registry, latest-message table and participation flags are
+sharded-resident from genesis — at 1M validators for several mainnet
+epochs on a (pods, shard) mesh: per-slot sharded fork-choice vote pass
++ replicated descent, swap-or-not committee shuffles, committee
+aggregate verification sharded over the batch axis, and the fused epoch
+sweep with two-axis psum at every boundary. Asserts that finality
+advances and that the device head equals the vectorized host spec-walk
+on a subsampled pin, then records everything in MULTICHIP_r{N}.json.
+
+A small twin matrix (same seeded config on 2x4 / 1x8 / single-device)
+asserts bit-identity before the big run — the mesh is a layout, never a
+semantic.
+
+Usage: python scripts/multichip_demo.py [--validators 1048576]
+       [--epochs 4] [--record 9] [--mesh 2x4] [--twin-validators 4096]
+       [--shuffle-rounds 10] [--no-verify]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _reexec_with_devices(n_devices: int) -> None:
+    if os.environ.get("POS_MULTICHIP_CHILD") == "1":
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags
+                 + f" --xla_force_host_platform_device_count={n_devices}"
+                 ).strip()
+    env = dict(os.environ, POS_MULTICHIP_CHILD="1", JAX_PLATFORMS="cpu",
+               XLA_FLAGS=flags)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--validators", type=int, default=1_048_576)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--record", type=int, default=9)
+    ap.add_argument("--mesh", default="2x4")
+    ap.add_argument("--twin-validators", type=int, default=4096)
+    ap.add_argument("--shuffle-rounds", type=int, default=10)
+    ap.add_argument("--no-verify", action="store_true",
+                    help="skip the per-slot aggregation-verify sweep")
+    ap.add_argument("--seed", type=int, default=9)
+    args = ap.parse_args()
+    pods, shard = (int(x) for x in args.mesh.lower().split("x"))
+    _reexec_with_devices(pods * shard)
+
+    import jax
+
+    from pos_evolution_tpu.config import mainnet_config
+    from pos_evolution_tpu.parallel.sharded import make_mesh
+    from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+
+    mesh = make_mesh(pods * shard, pods)
+    cfg = mainnet_config()
+    verify = not args.no_verify
+
+    # --- twin matrix: same seeded config, every layout, bit-identical ---
+    twin = {"n_validators": args.twin_validators, "bit_identical": None}
+    summaries = []
+    for m in (mesh, make_mesh(pods * shard, 1), None):
+        sim = DenseSimulation(args.twin_validators, cfg=cfg, mesh=m,
+                              seed=args.seed,
+                              shuffle_rounds=args.shuffle_rounds,
+                              verify_aggregates=verify,
+                              check_walk_every=8)
+        sim.run_epochs(2)
+        s = sim.summary()
+        s.pop("mesh")
+        summaries.append((s, [mm["head_root"] for mm in sim.metrics]))
+    twin["bit_identical"] = (summaries[0] == summaries[1] == summaries[2])
+    assert twin["bit_identical"], "twin matrix diverged across layouts"
+    print(f"# twin matrix ({args.twin_validators} validators, 2 epochs): "
+          f"2x4 == 1x{pods * shard} == single-device", file=sys.stderr)
+
+    # --- the 1M end-to-end run ---
+    t0 = time.time()
+    sim = DenseSimulation(args.validators, cfg=cfg, mesh=mesh,
+                          seed=args.seed,
+                          shuffle_rounds=args.shuffle_rounds,
+                          verify_aggregates=verify,
+                          check_walk_every=16)
+    init_s = time.time() - t0
+    print(f"# init {args.validators} validators sharded-resident on "
+          f"{args.mesh}: {init_s:.1f}s", file=sys.stderr)
+
+    per_epoch = []
+    t_run = time.time()
+    for e in range(1, args.epochs + 1):
+        te = time.time()
+        sim.run_epochs(e)
+        per_epoch.append(round(time.time() - te, 1))
+        m = sim.metrics[-1]
+        print(f"# epoch {e}: {per_epoch[-1]}s justified="
+              f"{m['justified_epoch']} finalized={m['finalized_epoch']} "
+              f"blocks={m['n_blocks']}", file=sys.stderr)
+    run_s = time.time() - t_run
+
+    out = sim.summary()
+    out.update({
+        "backend": "jax/" + jax.default_backend(),
+        "devices": len(jax.devices()),
+        "init_s": round(init_s, 1),
+        "run_s": round(run_s, 1),
+        "per_epoch_s": per_epoch,
+        "slots_per_epoch": cfg.slots_per_epoch,
+        "shuffle_rounds": args.shuffle_rounds,
+        "verify_aggregates": verify,
+        "twin": twin,
+        "last_slots": sim.metrics[-3:],
+    })
+    assert out["finality_reached"], out
+    assert out["finalized_epoch"] >= args.epochs - 2, out
+    assert out["resident_head_equals_spec_walk"], out
+    path = os.path.join(_REPO, f"MULTICHIP_r{args.record:02d}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

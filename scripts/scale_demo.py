@@ -1,8 +1,9 @@
-"""End-to-end accelerated run at scale (VERDICT r2 task 5): the L6
-simulator — NOT a synthetic kernel harness — at >= 64K validators for
->= 4 mainnet epochs, with the jax ExecutionBackend (device epoch sweeps,
-specs/epoch.py dispatch) and the resident device fork-choice store
-(every head query via head_from_buckets; no per-query host rebuild).
+"""End-to-end accelerated run at scale (VERDICT r2 task 5; ISSUE 9
+sharded mode): the L6 simulator — NOT a synthetic kernel harness — at
+>= 64K validators for >= 4 mainnet epochs, with the jax
+ExecutionBackend (device epoch sweeps, specs/epoch.py dispatch) and the
+resident device fork-choice store (every head query via
+head_from_buckets; no per-query host rebuild).
 
 Success criteria, asserted and recorded in SCALE_DEMO_r{N}.json
 (N from --record, default 4):
@@ -13,8 +14,22 @@ Success criteria, asserted and recorded in SCALE_DEMO_r{N}.json
 - the resident-store head equals the spec get_head walk at the end;
 - per-handler p50/p95 from HandlerTimer (SURVEY.md §5).
 
+Sharded mode (ISSUE 9): ``--sharded PxS`` re-execs under
+``xla_force_host_platform_device_count`` (the virtual-host-device form
+of a real mesh) and runs the SAME simulation with
+``Simulation(sharded=(P, S))`` — epoch sweeps, the resident fork-choice
+vote pass and the fused-transition session columns placed/sharded over
+the (pods, shard) mesh. ``--compare`` first runs the single-device twin
+in the same process and asserts the two runs' per-slot records
+(head roots, justified/finalized checkpoints, participation) are
+bit-identical. The sharded run's handler timings append to
+``bench_history.jsonl`` as ``kind=bench_shard`` (gate with
+``scripts/perf_gate.py --kind bench_shard``); ``--no-history`` opts
+out.
+
 Usage: [JAX_PLATFORMS=cpu] python scripts/scale_demo.py [n_validators]
-       [--record N]
+       [--record N] [--sharded PxS] [--compare] [--epochs E]
+       [--history PATH | --no-history]
 """
 
 import json
@@ -24,19 +39,100 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _reexec_with_devices(n_devices: int) -> None:
+    """Re-exec with the virtual host-device count pinned BEFORE jax
+    initializes (the dryrun_multichip pattern: rebinding an initialized
+    backend in-process is unreliable)."""
+    if os.environ.get("POS_SCALE_CHILD") == "1":
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags
+                 + f" --xla_force_host_platform_device_count={n_devices}"
+                 ).strip()
+    env = dict(os.environ, POS_SCALE_CHILD="1", JAX_PLATFORMS="cpu",
+               XLA_FLAGS=flags)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _parse_args(args):
+    opts = {"record": 4, "sharded": None, "compare": False, "epochs": 4,
+            "history": os.path.join(_REPO, "bench_history.jsonl")}
+    out = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == "--record":
+            opts["record"] = int(args[i + 1]); i += 2
+        elif a == "--sharded":
+            p, s = args[i + 1].lower().split("x")
+            opts["sharded"] = (int(p), int(s)); i += 2
+        elif a == "--compare":
+            opts["compare"] = True; i += 1
+        elif a == "--epochs":
+            opts["epochs"] = int(args[i + 1]); i += 2
+        elif a == "--history":
+            opts["history"] = args[i + 1]; i += 2
+        elif a == "--no-history":
+            opts["history"] = None; i += 1
+        else:
+            out.append(a); i += 1
+    opts["n"] = int(out[0]) if out else 65_536
+    return opts
+
+
+def _run_sim(n, epochs, sharded, timer_reset_after_first=True):
+    from pos_evolution_tpu.sim import Simulation
+    from pos_evolution_tpu.specs import forkchoice as fc
+
+    t0 = time.time()
+    sim = Simulation(n, accelerated_forkchoice=True,
+                     sharded=sharded if sharded else False)
+    init_s = time.time() - t0
+    t0 = time.time()
+    per_epoch = []
+    for e in range(1, epochs + 1):
+        te = time.time()
+        sim.run_epochs(e)
+        per_epoch.append(round(time.time() - te, 1))
+        m = sim.metrics[-1]
+        print(f"# [{'sharded' if sharded else 'single'}] epoch {e}: "
+              f"{per_epoch[-1]}s  justified={m['justified_epoch']} "
+              f"finalized={m['finalized_epoch']} blocks={m['n_blocks']}",
+              file=sys.stderr)
+        if e == 1 and timer_reset_after_first:
+            # epoch 1 is the warm-up: its handler samples are dominated
+            # by jit compiles and resident-store rebuild capacity growth
+            sim.timer.reset()
+    run_s = time.time() - t0
+    group = sim.groups[0]
+    spec_head = fc.get_head(group.store)
+    resident_head = sim._get_head(group)
+    records = [(m["head_root"], m["justified_epoch"], m["finalized_epoch"],
+                m["participation"], m["n_blocks"]) for m in sim.metrics]
+    out = {
+        "init_s": round(init_s, 1),
+        "run_s": round(run_s, 1),
+        "per_epoch_s": per_epoch,
+        "justified_epoch": sim.justified_epoch(),
+        "finalized_epoch": sim.finalized_epoch(),
+        "resident_head_equals_spec_walk": resident_head == spec_head,
+        "handler_timers_post_warmup": sim.trace_summary(),
+        "last_slots": sim.metrics[-3:],
+    }
+    if sharded:
+        from pos_evolution_tpu.backend import get_backend
+        get_backend().disable_sharded()
+    return out, records
+
 
 def main():
-    args = sys.argv[1:]
-    record = 4
-    if "--record" in args:
-        i = args.index("--record")
-        try:
-            record = int(args[i + 1])
-        except (IndexError, ValueError):
-            sys.exit("Usage: python scripts/scale_demo.py [n] [--record N]")
-        del args[i:i + 2]
-    n = int(args[0]) if args else 65_536
-    epochs = 4
+    opts = _parse_args(sys.argv[1:])
+    if opts["sharded"]:
+        _reexec_with_devices(opts["sharded"][0] * opts["sharded"][1])
 
     import jax
 
@@ -45,57 +141,54 @@ def main():
 
     set_backend("jax")
     with use_config(mainnet_config()):
-        from pos_evolution_tpu.sim import Simulation
-        from pos_evolution_tpu.specs import forkchoice as fc
-
-        t0 = time.time()
-        sim = Simulation(n, accelerated_forkchoice=True)
-        init_s = time.time() - t0
-        print(f"# init {n} validators: {init_s:.1f}s", file=sys.stderr)
-
-        t0 = time.time()
-        per_epoch = []
-        for e in range(1, epochs + 1):
-            te = time.time()
-            sim.run_epochs(e)
-            per_epoch.append(round(time.time() - te, 1))
-            m = sim.metrics[-1]
-            print(f"# epoch {e}: {per_epoch[-1]}s  justified="
-                  f"{m['justified_epoch']} finalized={m['finalized_epoch']} "
-                  f"blocks={m['n_blocks']}", file=sys.stderr)
-            if e == 1:
-                # epoch 1 is the warm-up: its handler samples are
-                # dominated by jit compiles and resident-store rebuild
-                # capacity growth — drop them so the recorded p50/p95
-                # cover only the steady state
-                sim.timer.reset()
-        run_s = time.time() - t0
-
-        group = sim.groups[0]
-        spec_head = fc.get_head(group.store)
-        resident_head = sim._get_head(group)
         out = {
-            "n_validators": n,
-            "epochs": epochs,
+            "n_validators": opts["n"],
+            "epochs": opts["epochs"],
             "backend": "jax/" + jax.default_backend(),
             "accelerated_forkchoice": True,
-            "init_s": round(init_s, 1),
-            "run_s": round(run_s, 1),
-            "per_epoch_s": per_epoch,
-            "justified_epoch": sim.justified_epoch(),
-            "finalized_epoch": sim.finalized_epoch(),
-            "resident_head_equals_spec_walk": resident_head == spec_head,
-            "handler_timers_post_warmup": sim.trace_summary(),
-            "last_slots": sim.metrics[-3:],
+            "sharded": (None if not opts["sharded"] else
+                        {"pods": opts["sharded"][0],
+                         "shard": opts["sharded"][1]}),
         }
+        single_records = None
+        if opts["compare"] or not opts["sharded"]:
+            single, single_records = _run_sim(opts["n"], opts["epochs"],
+                                              None)
+            if opts["sharded"]:
+                out["single_device"] = single
+            else:
+                out.update(single)
+        if opts["sharded"]:
+            sharded, sharded_records = _run_sim(opts["n"], opts["epochs"],
+                                                opts["sharded"])
+            out.update(sharded)
+            if single_records is not None:
+                out["bit_identical_to_single_device"] = (
+                    sharded_records == single_records)
+                assert out["bit_identical_to_single_device"], \
+                    "sharded run diverged from the single-device twin"
+
         assert out["justified_epoch"] >= 3, out
         assert out["finalized_epoch"] >= 2, out
         assert out["resident_head_equals_spec_walk"], out
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), f"SCALE_DEMO_r{record:02d}.json")
+        path = os.path.join(_REPO, f"SCALE_DEMO_r{opts['record']:02d}.json")
         with open(path, "w") as f:
             json.dump(out, f, indent=1)
         print(json.dumps(out, indent=1))
+
+        if opts["sharded"] and opts["history"]:
+            from pos_evolution_tpu.profiling import history
+            emission = {
+                "metric": "scale_demo_sharded",
+                "n_validators": opts["n"],
+                "mesh": out["sharded"],
+                "run_s": out["run_s"],
+                "handlers": out["handler_timers_post_warmup"],
+            }
+            history.append_entry(opts["history"], emission,
+                                 kind="bench_shard")
+            print(f"# appended bench_shard emission to {opts['history']}",
+                  file=sys.stderr)
 
 
 if __name__ == "__main__":

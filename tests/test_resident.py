@@ -254,24 +254,38 @@ class TestGracefulDegradation:
         resident.note_slashing([1, 2])
         assert resident.head(store) == fc.get_head(store)
 
-    def test_selfcheck_period_counts_queries(self):
+    def test_selfcheck_period_counts_fresh_queries(self):
+        """The periodic audit runs every Nth FRESH computation against
+        the vectorized host walk (ISSUE 9: repeated identical queries
+        answer from the memo — no device work, nothing new to audit;
+        ``get_head_host`` replaced the pure-Python spec walk, which cost
+        tens of seconds per audit at 64K validators)."""
         store = self._store_with_chain()
         resident = ResidentForkChoice(store, selfcheck_every=4)
-        spec_calls = {"n": 0}
-        real = fc.get_head
+        walk_calls = {"n": 0}
+        import pos_evolution_tpu.ops.forkchoice as ofc
+        real = ofc.get_head_host
 
         def counting(store_arg):
-            spec_calls["n"] += 1
+            walk_calls["n"] += 1
             return real(store_arg)
 
-        import pos_evolution_tpu.specs.forkchoice as fcmod
-        fcmod.get_head, _saved = counting, fcmod.get_head
+        ofc.get_head_host, _saved = counting, ofc.get_head_host
         try:
+            # memoized repeats: one fresh computation, never audited
             for _ in range(8):
                 resident.head(store)
+            assert resident._head_queries == 1
+            assert walk_calls["n"] == 0
+            # fresh computations (a new landed vote batch each time)
+            tip = list(store.blocks.keys())[-1]
+            for i in range(7):
+                resident.note_attestation(np.array([i]), 1 + i, tip)
+                resident.head(store)
         finally:
-            fcmod.get_head = _saved
-        assert spec_calls["n"] == 2            # queries 4 and 8
+            ofc.get_head_host = _saved
+        assert resident._head_queries == 8
+        assert walk_calls["n"] == 2            # fresh queries 4 and 8
         assert not resident.degraded
 
     def test_healthy_sim_never_degrades(self):

@@ -1,0 +1,419 @@
+"""ISSUE 9: sharded end-to-end simulation — partition rules, the
+backend's sharded mode, bit-identity of the validator-axis sweeps across
+mesh shapes (1x8 / 2x4 / 4x2 / 8x1) against the single-device jax path
+and the NumPy oracles, the DenseSimulation mainnet-scale loop,
+checkpoint -> resume on a *different* mesh shape, the resident head
+memo, the vectorized host walk, and the bench_shard perf gate."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config, use_config
+
+jax = pytest.importorskip("jax")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+MESH_SHAPES = [(1, 8), (2, 4), (4, 2), (8, 1)]
+
+
+def _mesh(pods, shard):
+    from pos_evolution_tpu.parallel.sharded import make_mesh
+    return make_mesh(pods * shard, pods)
+
+
+@pytest.fixture
+def jax_backend_sharded_off():
+    """Always leave the process-global sharded mode off after a test."""
+    from pos_evolution_tpu.backend import set_backend
+    backend = set_backend("jax")
+    yield backend
+    backend.disable_sharded()
+
+
+# --- partition rules ----------------------------------------------------------
+
+
+class TestPartitionRules:
+    def test_named_tree_map_names_namedtuple_fields(self):
+        from pos_evolution_tpu.parallel.partition import named_tree_map
+        from pos_evolution_tpu.ops.epoch import DenseRegistry
+        reg = DenseRegistry(*(np.zeros(4) for _ in DenseRegistry._fields))
+        names = []
+        named_tree_map(lambda n, x: names.append(n), {"registry": reg})
+        assert "registry/effective_balance" in names
+        assert "registry/inactivity_scores" in names
+
+    def test_match_rules_validator_columns_vs_scalars(self):
+        from pos_evolution_tpu.parallel.partition import (
+            PARTITION_RULES,
+            REPLICATED,
+            VALIDATOR_SPEC,
+            match_partition_rules,
+        )
+        tree = {"registry": {"balance": np.zeros(8)},
+                "messages": {"msg_block": np.zeros(8),
+                             "total": np.int64(3)},      # scalar
+                "tree": {"parent": np.zeros(4)}}
+        specs = match_partition_rules(PARTITION_RULES, tree)
+        assert specs["registry"]["balance"] == VALIDATOR_SPEC
+        assert specs["messages"]["msg_block"] == VALIDATOR_SPEC
+        assert specs["messages"]["total"] == REPLICATED  # scalars replicate
+        assert specs["tree"]["parent"] == REPLICATED
+        # spec_for is the live placement entry point (resident / session /
+        # registry / dense-driver sites all consult the table through it)
+        from pos_evolution_tpu.parallel.partition import spec_for
+        assert spec_for("session/balances") == VALIDATOR_SPEC
+        assert spec_for("messages/assigned") == VALIDATOR_SPEC
+        assert spec_for("tree/rank") == REPLICATED
+
+    def test_unmatched_leaf_raises(self):
+        from pos_evolution_tpu.parallel.partition import (
+            match_partition_rules,
+        )
+        with pytest.raises(ValueError, match="no partition rule"):
+            match_partition_rules([(r"^only/this$", None)],
+                                  {"other": np.zeros(4)})
+
+    @pytest.mark.mesh8
+    def test_shard_leaf_and_build_sharded_round_trip(self):
+        from pos_evolution_tpu.parallel.partition import (
+            VALIDATOR_SPEC,
+            build_sharded,
+            shard_leaf,
+        )
+        mesh = _mesh(2, 4)
+        x = np.arange(64, dtype=np.int64)
+        placed = shard_leaf(mesh, VALIDATOR_SPEC, x)
+        assert np.array_equal(np.asarray(placed), x)
+        # every device holds only its slice
+        assert all(s.data.shape == (8,) for s in placed.addressable_shards)
+
+        built = build_sharded(mesh, VALIDATOR_SPEC, (64,), np.int64,
+                              lambda lo, hi: np.arange(lo, hi))
+        assert np.array_equal(np.asarray(built), x)
+
+    @pytest.mark.mesh8
+    def test_shard_leaf_rejects_indivisible(self):
+        from pos_evolution_tpu.parallel.partition import (
+            VALIDATOR_SPEC,
+            shard_leaf,
+        )
+        with pytest.raises(ValueError, match="divide"):
+            shard_leaf(_mesh(2, 4), VALIDATOR_SPEC, np.zeros(13))
+
+
+# --- kernel bit-identity across every mesh shape ------------------------------
+
+
+@pytest.mark.mesh8
+class TestKernelsAcrossMeshShapes:
+    @pytest.mark.parametrize("shape", MESH_SHAPES)
+    def test_vote_pass_matches_numpy_oracle(self, shape):
+        from pos_evolution_tpu.parallel.sharded import vote_weights_for
+        mesh = _mesh(*shape)
+        n, capacity = 256, 32
+        rng = np.random.default_rng(1)
+        msg_block = rng.integers(-1, capacity, n).astype(np.int32)
+        weight = rng.integers(1, 33, n).astype(np.int64) * 10**9
+        got = np.asarray(vote_weights_for(mesh, capacity)(
+            jax.numpy.asarray(msg_block), jax.numpy.asarray(weight)))
+        want = np.zeros(capacity + 1, np.int64)
+        np.add.at(want, np.where(msg_block >= 0, msg_block, capacity),
+                  np.where(msg_block >= 0, weight, 0))
+        assert np.array_equal(got, want[:capacity])
+
+    @pytest.mark.parametrize("shape", MESH_SHAPES)
+    def test_link_and_windowed_tally_match_host(self, shape,
+                                                jax_backend_sharded_off):
+        from pos_evolution_tpu.ops.variant_tally import (
+            link_tally_host,
+            windowed_vote_tally_host,
+        )
+        backend = jax_backend_sharded_off
+        rng = np.random.default_rng(2)
+        k, nl = 41, 6  # deliberately not a power of two, not mesh-divisible
+        li = rng.integers(-1, nl, k)
+        w = rng.integers(1, 100, k).astype(np.int64)
+        ac = rng.random(k) < 0.8
+        vs = rng.integers(0, 12, k)
+        backend.enable_sharded(8, shape[0], mesh=_mesh(*shape))
+        got_link = backend.link_tally(li, w, ac, nl)
+        got_win = backend.variant_tally(li, vs, w, ac, 3, 9, nl)
+        assert np.array_equal(got_link, link_tally_host(li, w, ac, nl))
+        assert np.array_equal(
+            got_win, windowed_vote_tally_host(li, vs, w, ac, 3, 9, nl))
+
+    @pytest.mark.parametrize("shape", [(2, 4), (8, 1)])
+    def test_epoch_sweep_matches_numpy_spec_pipeline(self, shape,
+                                                     jax_backend_sharded_off):
+        """jax sharded process_epoch == the pure-NumPy spec pipeline,
+        state-root-identical (registry size NOT mesh-divisible, so the
+        inert-row padding contract is exercised too)."""
+        from pos_evolution_tpu.backend import set_backend
+        from pos_evolution_tpu.ssz import hash_tree_root
+        with use_config(minimal_config()) as c:
+            from pos_evolution_tpu.specs.epoch import process_epoch
+            from pos_evolution_tpu.specs.genesis import make_genesis
+            state, _ = make_genesis(50)
+            state.slot = np.uint64(c.slots_per_epoch * 3 - 1)
+            s_np = state.copy()
+            set_backend("numpy")
+            process_epoch(s_np)
+            backend = set_backend("jax")
+            backend.enable_sharded(mesh=_mesh(*shape))
+            s_sh = state.copy()
+            process_epoch(s_sh)
+            backend.disable_sharded()
+            assert hash_tree_root(s_np) == hash_tree_root(s_sh)
+
+
+# --- the dense end-to-end driver ----------------------------------------------
+
+
+@pytest.mark.mesh8
+class TestDenseSimulation:
+    def _cfg(self):
+        from pos_evolution_tpu.config import mainnet_config
+        return mainnet_config().replace(slots_per_epoch=8,
+                                        max_committees_per_slot=4)
+
+    def _run(self, mesh, n=256, epochs=4, seed=11):
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        sim = DenseSimulation(n, cfg=self._cfg(), mesh=mesh, seed=seed,
+                              shuffle_rounds=6, check_walk_every=8)
+        sim.run_epochs(epochs)
+        return sim
+
+    def test_finality_and_layout_bit_identity(self):
+        """The same seeded config on a 2x4 mesh and on a single device:
+        finality advances and EVERYTHING observable — per-slot head
+        roots, checkpoints, aggregate verdict counts, the host-walk
+        pins — is bit-identical (mesh = layout, never semantics; the
+        per-kernel tests above cover all four mesh shapes)."""
+        runs = [self._run(_mesh(2, 4)), self._run(None)]
+        summaries = []
+        for sim in runs:
+            s = sim.summary()
+            s.pop("mesh")
+            summaries.append((s, sim.metrics))
+        assert summaries[0] == summaries[1]
+        s = summaries[0][0]
+        assert s["finality_reached"] and s["finalized_epoch"] >= 2
+        assert s["resident_head_equals_spec_walk"]
+        assert s["aggregates_verified"] > 0
+
+    def test_checkpoint_resume_on_different_mesh(self):
+        """Mid-run checkpoint on 2x4 resumes bit-identically on 4x2 — a
+        DIFFERENT mesh shape: the gather/re-shard contract of the
+        snapshot layer (mesh shape is not part of the format)."""
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        sim = self._run(_mesh(2, 4), epochs=2)
+        data = sim.checkpoint()
+        resumed_42 = DenseSimulation.resume(data, mesh=_mesh(4, 2))
+        for s in (sim, resumed_42):
+            s.run_epochs(4)
+        ss = []
+        for s in (sim, resumed_42):
+            d = s.summary()
+            d.pop("mesh")
+            ss.append((d, s.metrics))
+        assert ss[0] == ss[1]
+        assert ss[0][0]["finality_reached"]
+
+    def test_registry_is_shard_resident_from_genesis(self):
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        mesh = _mesh(2, 4)
+        sim = DenseSimulation(256, cfg=self._cfg(), mesh=mesh, seed=1)
+        for col in (sim.registry.balance, sim.msg_block):
+            shards = col.addressable_shards
+            assert len(shards) == 8
+            assert all(s.data.shape == (32,) for s in shards)
+
+
+# --- the spec-level Simulation under sharded mode -----------------------------
+
+
+@pytest.mark.mesh8
+class TestShardedSimulation:
+    def _records(self, sim):
+        return [(m["head_root"], m["justified_epoch"], m["finalized_epoch"],
+                 m["participation"], m["n_blocks"]) for m in sim.metrics]
+
+    def test_bit_identical_to_single_device(self, jax_backend_sharded_off):
+        """Acceptance pin: sharded and single-device driver runs agree on
+        head roots, justified/finalized checkpoints and every per-slot
+        record, on both 1x8 and 2x4 mesh shapes."""
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.sim import Simulation
+            outs = []
+            for sharded in (False, (1, 8), (2, 4)):
+                sim = Simulation(64, accelerated_forkchoice=True,
+                                 sharded=sharded)
+                sim.run_epochs(3)
+                if sharded:
+                    jax_backend_sharded_off.disable_sharded()
+                assert not sim.groups[0].resident.degraded, \
+                    sim.groups[0].resident.incidents
+                outs.append(self._records(sim))
+            assert outs[0] == outs[1] == outs[2]
+
+    def test_ssf_variant_link_tally_through_sharded_mode(
+            self, jax_backend_sharded_off):
+        """ROADMAP item 5 remainder: the live SsfVariant dispatches its
+        supermajority-link tallies through the sharded backend kernel
+        when a mesh is active — whole-sim results identical to the
+        single-device run (finalized chain, justified sets, evidence)."""
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.sim import Simulation
+            from pos_evolution_tpu.variants import SsfVariant
+
+            def run(sharded):
+                sim = Simulation(32, variant=SsfVariant(), sharded=sharded)
+                sim.run_epochs(2)
+                if sharded:
+                    jax_backend_sharded_off.disable_sharded()
+                v = sim.variant
+                return (sorted((g, tuple(ch)) for g, ch in
+                               v.finalized.items()),
+                        sorted((g, tuple(sorted(cps))) for g, cps in
+                               v.justified.items()),
+                        sorted(v._slashable))
+
+            single = run(False)
+            sharded = run((2, 4))
+            assert single == sharded
+            assert single[0], "SSF finalized nothing — vacuous comparison"
+
+    def test_checkpoint_resume_across_mesh_shapes(self,
+                                                  jax_backend_sharded_off):
+        """A sharded driver checkpoint resumes bit-identically under a
+        DIFFERENT mesh shape (residents rebuild sharded on the current
+        mesh) and the checkpoint records the mesh shape."""
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.sim import Simulation
+            sim = Simulation(64, accelerated_forkchoice=True,
+                             sharded=(2, 4))
+            sim.run_epochs(1)
+            data = sim.checkpoint()
+            sim.run_epochs(3)
+            want = self._records(sim)
+            jax_backend_sharded_off.disable_sharded()
+
+            resumed = Simulation.resume(data, sharded=(4, 2))
+            assert resumed.sharded == {"pods": 4, "shard": 2}
+            resumed.run_epochs(3)
+            jax_backend_sharded_off.disable_sharded()
+            assert self._records(resumed) == want
+
+
+# --- host walk + resident memo ------------------------------------------------
+
+
+class TestGetHeadHost:
+    def _forked_store(self, n=64):
+        from pos_evolution_tpu.specs import forkchoice as fc
+        from pos_evolution_tpu.specs.containers import LatestMessage
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.specs.validator import build_block
+        from pos_evolution_tpu.ssz import hash_tree_root
+        state, anchor = make_genesis(n)
+        store = fc.get_forkchoice_store(state, anchor)
+        roots = [hash_tree_root(anchor)]
+        parent_state = state
+        for slot in (1, 2, 3):
+            fc.on_tick(store, store.genesis_time + slot * 12)
+            sb = build_block(parent_state, slot, graffiti=bytes([slot]) * 32)
+            fc.on_block(store, sb)
+            roots.append(hash_tree_root(sb.message))
+            parent_state = store.block_states[roots[-1]]
+        # a competing fork off block 1
+        fork_state = store.block_states[roots[1]]
+        sb = build_block(fork_state, 3, graffiti=b"\xff" * 32)
+        fc.on_block(store, sb)
+        roots.append(hash_tree_root(sb.message))
+        rng = np.random.default_rng(5)
+        for v in range(n):
+            store.latest_messages[v] = LatestMessage(
+                epoch=0, root=roots[rng.integers(1, len(roots))])
+        return store
+
+    def test_host_walk_matches_spec_walk(self, jax_backend_sharded_off):
+        """The vectorized host walk behind the resident self-check must
+        equal the pure-Python spec walk on a forked store with a full
+        latest-message table."""
+        from pos_evolution_tpu.ops.forkchoice import get_head_host
+        from pos_evolution_tpu.specs import forkchoice as fc
+        with use_config(minimal_config()):
+            store = self._forked_store()
+            assert get_head_host(store) == fc.get_head(store)
+            # and after the boost moves (proposer boost is part of the walk)
+            store.proposer_boost_root = list(store.blocks.keys())[-1]
+            assert get_head_host(store) == fc.get_head(store)
+
+    def test_resident_memo_invalidates_on_mutation(self,
+                                                   jax_backend_sharded_off):
+        """Repeated head queries are memoized; a landed vote batch, a new
+        block or a boost change invalidates — the memoized answer always
+        equals a fresh spec walk."""
+        from pos_evolution_tpu.ops.resident import ResidentForkChoice
+        from pos_evolution_tpu.specs import forkchoice as fc
+        with use_config(minimal_config()):
+            store = self._forked_store()
+            store.proposer_boost_root = b"\x00" * 32
+            resident = ResidentForkChoice(store, selfcheck_every=0)
+            h1 = resident.head(store)
+            queries_after_first = resident._head_queries
+            assert resident.head(store) == h1
+            assert resident._head_queries == queries_after_first, \
+                "second identical query must answer from the memo"
+            assert h1 == fc.get_head(store)
+            # land votes that flip the head to the fork tip
+            fork_tip = list(store.blocks.keys())[-1]
+            movers = list(range(40))
+            for v in movers:
+                from pos_evolution_tpu.specs.containers import LatestMessage
+                store.latest_messages[v] = LatestMessage(epoch=1,
+                                                         root=fork_tip)
+            resident.note_attestation(np.array(movers, np.int64), 1,
+                                      fork_tip)
+            h2 = resident.head(store)
+            assert h2 == fc.get_head(store)
+            assert resident._head_queries == queries_after_first + 1
+
+
+# --- bench_shard gate ---------------------------------------------------------
+
+
+class TestBenchShardGate:
+    def _emission(self, run_s=30.0, p50=5.0):
+        return {"metric": "scale_demo_sharded", "n_validators": 512,
+                "mesh": {"pods": 2, "shard": 4}, "run_s": run_s,
+                "handlers": {"get_head": {"count": 289, "p50_ms": p50,
+                                          "p95_ms": p50 * 3,
+                                          "total_s": run_s / 10}}}
+
+    def test_gate_passes_real_fails_doctored_slow(self, tmp_path):
+        import perf_gate
+
+        from pos_evolution_tpu.profiling import history
+        hist = tmp_path / "hist.jsonl"
+        for _ in range(3):
+            history.append_entry(hist, self._emission(), kind="bench_shard")
+        cand = tmp_path / "cand.json"
+        cand.write_text(json.dumps(self._emission(31.0, 5.2)))
+        assert perf_gate.main(["--candidate", str(cand),
+                               "--history", str(hist),
+                               "--kind", "bench_shard",
+                               "--strict-timing"]) == 0
+        slow = tmp_path / "slow.json"
+        slow.write_text(json.dumps(self._emission(300.0, 50.0)))
+        assert perf_gate.main(["--candidate", str(slow),
+                               "--history", str(hist),
+                               "--kind", "bench_shard",
+                               "--strict-timing"]) == 1
