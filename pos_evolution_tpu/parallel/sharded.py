@@ -73,6 +73,15 @@ def _replicated(mesh):
     return NamedSharding(mesh, P())
 
 
+def host_gather(tree):
+    """Gather a (possibly mesh-sharded) array pytree to host numpy —
+    the cheap, device-synchronous half of an async checkpoint (ISSUE
+    10): the caller keeps only this host copy on the critical path and
+    hands compression/serialization to the background writer. Works on
+    plain jnp/np arrays too, so call sites need no mesh conditional."""
+    return jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
+
+
 def shard_registry(mesh: Mesh, reg: DenseRegistry) -> DenseRegistry:
     """Place registry columns per the partition rules (``registry/*`` ->
     validator axes; per-shard slice placement — no full-size

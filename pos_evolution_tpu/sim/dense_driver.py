@@ -79,7 +79,7 @@ class DenseSimulation:
     def __init__(self, n_validators: int, cfg: Config | None = None,
                  mesh=None, seed: int = 0, shuffle_rounds: int = 10,
                  verify_aggregates: bool = True, capacity: int = 256,
-                 check_walk_every: int = 16):
+                 check_walk_every: int = 16, autocheckpoint=None):
         import jax.numpy as jnp
         self.cfg = cfg or mainnet_config()
         self.n = int(n_validators)
@@ -170,6 +170,16 @@ class DenseSimulation:
             rng.integers(0, 256, (self.n, 48)).astype(np.uint8))
 
         self._append_block(_hash(b"genesis", self.seed), -1, 0)
+
+        # Run supervision (resilience/, ISSUE 10, DESIGN.md §18): the
+        # dense driver's async capture is the gather-then-compress
+        # split — columns come to host synchronously (host_gather, the
+        # cheap device-synchronous part), npz compression runs on the
+        # manager's writer thread, so multi-epoch walls never stall on
+        # serialization.
+        self.supervision = None
+        if autocheckpoint is not None:
+            self.attach_autocheckpoint(autocheckpoint)
 
     # -- block tree ------------------------------------------------------------
 
@@ -431,6 +441,8 @@ class DenseSimulation:
             "finalized_epoch": self.finalized[0],
             "n_blocks": len(self.roots),
         })
+        if self.supervision is not None:
+            self.supervision.tick(self, s, self._checkpoint_async_capture)
 
     def run_epochs(self, n_epochs: int) -> None:
         """Run through the first slot of epoch ``n_epochs`` (inclusive),
@@ -463,13 +475,25 @@ class DenseSimulation:
 
     # -- checkpoint / resume (gather -> host -> re-shard) ----------------------
 
-    def checkpoint(self) -> bytes:
+    def checkpoint(self, path: str | None = None) -> bytes:
         """Gather every device column to host and serialize. The layout
         (mesh shape, sharding) is deliberately NOT part of the format:
         ``resume`` re-places columns on whatever mesh it is given —
         checkpoint on 2x4, resume on 4x2/1x8/single-device, bit-identical
-        (tests/test_sharded_e2e.py pins the round trip)."""
-        out = io.BytesIO()
+        (tests/test_sharded_e2e.py pins the round trip). ``path``
+        additionally lands the bytes on disk atomically
+        (``utils/snapshot.atomic_write_bytes``)."""
+        data = self._checkpoint_serialize(*self._checkpoint_capture())
+        if path is not None:
+            from pos_evolution_tpu.utils.snapshot import atomic_write_bytes
+            atomic_write_bytes(path, data)
+        return data
+
+    def _checkpoint_capture(self):
+        """The device-synchronous half: JSON-able meta plus host copies
+        of every sharded column (``parallel/sharded.host_gather``).
+        Cheap relative to compression — this is all that runs on the
+        epoch loop's critical path in async autocheckpoint mode."""
         meta = {
             "version": 1, "n": self.n, "seed": self.seed,
             "shuffle_rounds": self.shuffle_rounds,
@@ -485,25 +509,46 @@ class DenseSimulation:
             "finalized": list(self.finalized),
             "epoch_start_idx": {str(k): v
                                 for k, v in self.epoch_start_idx.items()},
+            # every mutable collection is COPIED here, not referenced:
+            # in async mode the writer thread serializes this meta while
+            # the loop keeps appending blocks — a live reference would
+            # tear the snapshot (roots of length B beside parents of
+            # length B+1, caught by the tier-1 suite under load)
             "roots": [r.hex() for r in self.roots],
-            "parents": self.parents,
-            "block_slots": self.block_slots,
+            "parents": list(self.parents),
+            "block_slots": list(self.block_slots),
             "aggregates_verified": self.aggregates_verified,
             "walk_checks": [bool(b) for b in self.walk_checks],
-            "metrics": self.metrics,
+            "metrics": list(self.metrics),
             "epoch_ready": self._epoch_ready,
         }
-        head = json.dumps(meta).encode()
-        out.write(np.uint64(len(head)).tobytes())
-        out.write(head)
-        cols = {f: np.asarray(getattr(self.registry, f))[: self.n]
-                for f in self.registry._fields}
+        from pos_evolution_tpu.parallel.sharded import host_gather
+        cols = host_gather({f: getattr(self.registry, f)
+                            for f in self.registry._fields})
+        cols = {f: a[: self.n] for f, a in cols.items()}
         cols["msg_block"] = np.asarray(self.msg_block)[: self.n]
         cols["msg_epoch"] = np.asarray(self.msg_epoch)[: self.n]
         if self._perm_host is not None:
             cols["perm"] = self._perm_host
+        return meta, cols
+
+    @staticmethod
+    def _checkpoint_serialize(meta: dict, cols: dict) -> bytes:
+        """The expensive half (json + npz compression): pure function
+        of the captured host state, safe on a background thread."""
+        out = io.BytesIO()
+        head = json.dumps(meta).encode()
+        out.write(np.uint64(len(head)).tobytes())
+        out.write(head)
         np.savez_compressed(out, **cols)
         return out.getvalue()
+
+    def _checkpoint_async_capture(self):
+        """RunSupervision capture: gather now, serialize whenever the
+        writer thread gets to it (the captured host copies are frozen —
+        the loop mutating ``self`` no longer races the write)."""
+        meta, cols = self._checkpoint_capture()
+        return lambda: self._checkpoint_serialize(meta, cols)
 
     @classmethod
     def resume(cls, data: bytes, mesh=None) -> "DenseSimulation":
@@ -566,6 +611,51 @@ class DenseSimulation:
             assigned = sim._perm_host * sim.S // sim.n
             sim._assigned = sim._place_validator_col(
                 assigned.astype(np.int64))
+        return sim
+
+    # -- run supervision (resilience/, ISSUE 10) -------------------------------
+
+    def attach_autocheckpoint(self, spec) -> None:
+        """Arm (or re-arm, after a resume) run supervision — see
+        ``Simulation.attach_autocheckpoint``; the dense driver's capture
+        additionally backgrounds the npz compression."""
+        from pos_evolution_tpu.resilience import RunSupervision
+        self.supervision = RunSupervision(spec, kind="dense",
+                                          cfg_obj=self.cfg)
+
+    def finish_autocheckpoint(self) -> dict | None:
+        """Final checkpoint at the current slot + writer drain; returns
+        the manager's overhead stats (None when unsupervised)."""
+        if self.supervision is None:
+            return None
+        return self.supervision.finish(self.slot,
+                                       self._checkpoint_async_capture)
+
+    @classmethod
+    def resume_latest(cls, dir, mesh=None,
+                      autocheckpoint=None) -> "DenseSimulation":
+        """Resume from the newest *valid* checkpoint under ``dir``,
+        quarantining and rolling past corrupt steps — onto whatever
+        mesh is ACTIVE now (``mesh=None`` = single device), which is
+        the device-loss path: a run checkpointed on 2x4 resumes
+        bit-identically on 1x4 or one device. Raises
+        ``FileNotFoundError`` when nothing valid exists."""
+        # no fingerprint pin here: the dense checkpoint carries its own
+        # Config in-band and ``resume`` reconstructs from it, so there
+        # is no "active config" to cross-check (unlike the spec driver)
+        from pos_evolution_tpu.resilience import CheckpointManager
+        found = CheckpointManager(dir).latest_valid()
+        if found is None:
+            raise FileNotFoundError(
+                f"no valid checkpoint under {dir!r} to resume from")
+        step, payloads = found
+        sim = cls.resume(payloads["payload.bin"], mesh=mesh)
+        if autocheckpoint is not None:
+            sim.attach_autocheckpoint(autocheckpoint)
+        from pos_evolution_tpu.telemetry import emit_global
+        import os as _os
+        emit_global("run_resumed", step=step, slot=sim.slot,
+                    dir=_os.fspath(dir))
         return sim
 
 

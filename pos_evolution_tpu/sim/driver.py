@@ -275,7 +275,7 @@ class Simulation:
                  genesis_time: int = 0, accelerated_forkchoice: bool = False,
                  telemetry=None, profile=None, adversaries=(), monitors=(),
                  das=None, prewarm: bool = False, compile_cache=None,
-                 variant=None, sharded=None):
+                 variant=None, sharded=None, autocheckpoint=None):
         self.cfg = cfg()
         self.schedule = schedule or honest_schedule(n_validators)
         self.n_validators = n_validators
@@ -470,6 +470,15 @@ class Simulation:
                 accelerated_forkchoice=accelerated_forkchoice,
                 sharded=self.sharded, debug=telemetry.debug)
         self._bind_adversaries_and_monitors()
+        # Run supervision (resilience/, ISSUE 10, DESIGN.md §18):
+        # ``autocheckpoint=(every_n_slots, dir)`` (or an AutoCheckpoint
+        # record) arms per-slot heartbeats, periodic integrity audits,
+        # and atomic checksummed autocheckpoints with bounded staleness.
+        # Like telemetry, NOT simulation state: a restarted process
+        # re-arms via ``resume_latest(..., autocheckpoint=...)``.
+        self.supervision = None
+        if autocheckpoint is not None:
+            self.attach_autocheckpoint(autocheckpoint)
 
     def _get_head(self, group: ViewGroup) -> bytes:
         t0 = _time.perf_counter()
@@ -935,6 +944,13 @@ class Simulation:
         self._serve_light_clients(slot)
         self._serve_das(slot)
         self.slot += 1
+        if self.supervision is not None:
+            # heartbeat -> integrity audit -> autocheckpoint, in that
+            # order (liveness never waits on an audit; a poisoned state
+            # is never checkpointed). The capture serializes on THIS
+            # thread — the stores are live mutable objects — so only
+            # the fsync+rename overlaps in async mode.
+            self.supervision.tick(self, self.slot, self.checkpoint)
 
     def run_until_slot(self, slot: int) -> None:
         if self.profile is not None and not self._profiled:
@@ -1270,6 +1286,59 @@ class Simulation:
         return load_simulation(data, schedule=schedule, telemetry=telemetry,
                                adversaries=adversaries, monitors=monitors,
                                das=das, variant=variant, sharded=sharded)
+
+    # -- run supervision (resilience/, ISSUE 10) -------------------------------
+
+    def attach_autocheckpoint(self, spec) -> None:
+        """Arm (or re-arm, after a resume) run supervision: accepts an
+        ``(every_n_slots, dir)`` tuple, a dict, or a full
+        ``resilience.AutoCheckpoint``."""
+        from pos_evolution_tpu.resilience import RunSupervision
+        self.supervision = RunSupervision(spec, kind="sim",
+                                          telemetry=self.telemetry)
+
+    def finish_autocheckpoint(self) -> dict | None:
+        """Take a final checkpoint at the current slot and drain the
+        async writer; returns the manager's overhead stats. Call once
+        at the end of a supervised run — the finished state must be as
+        durable as any mid-run step."""
+        if self.supervision is None:
+            return None
+        return self.supervision.finish(self.slot, self.checkpoint)
+
+    @classmethod
+    def resume_latest(cls, dir, schedule: Schedule | None = None,
+                      telemetry=None, adversaries=(), monitors=(),
+                      das=None, variant=None, sharded=None,
+                      autocheckpoint=None) -> "Simulation":
+        """Resume from the newest *valid* checkpoint under ``dir``
+        (``resilience.CheckpointManager`` layout): checksum + manifest
+        + active-config fingerprint are verified, corrupt steps are
+        quarantined and rolled past, and a fingerprint from a different
+        config refuses loudly. ``autocheckpoint`` re-arms supervision
+        on the resumed run (pass the same spec the original run used so
+        the restarted process keeps checkpointing into the same store).
+        Raises ``FileNotFoundError`` when no valid checkpoint exists —
+        the caller decides whether a fresh start is acceptable."""
+        from pos_evolution_tpu.resilience import CheckpointManager
+        from pos_evolution_tpu.resilience.runner import run_fingerprint
+        found = CheckpointManager(
+            dir, fingerprint=run_fingerprint("sim")).latest_valid()
+        if found is None:
+            raise FileNotFoundError(
+                f"no valid checkpoint under {dir!r} to resume from")
+        step, payloads = found
+        sim = cls.resume(payloads["payload.bin"], schedule=schedule,
+                         telemetry=telemetry, adversaries=adversaries,
+                         monitors=monitors, das=das, variant=variant,
+                         sharded=sharded)
+        if autocheckpoint is not None:
+            sim.attach_autocheckpoint(autocheckpoint)
+        if telemetry is not None:
+            import os as _os3
+            telemetry.bus.emit("run_resumed", step=step, slot=sim.slot,
+                               dir=_os3.fspath(dir))
+        return sim
 
     # -- accessors --
     def store(self, group: int = 0) -> fc.Store:

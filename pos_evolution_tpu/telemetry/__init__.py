@@ -64,8 +64,13 @@ class Telemetry:
 
     @classmethod
     def to_file(cls, path, debug: bool = False,
-                keep_in_memory: bool = True) -> "Telemetry":
-        return cls(bus=EventBus(path, keep_in_memory=keep_in_memory),
+                keep_in_memory: bool = True,
+                append: bool = False) -> "Telemetry":
+        """``append=True`` extends an existing log instead of truncating
+        it — the resumed-attempt contract of ISSUE 10 (seq ordinals
+        continue past the previous attempt's maximum)."""
+        return cls(bus=EventBus(path, keep_in_memory=keep_in_memory,
+                                append=append),
                    debug=debug)
 
     def install_jax_runtime(self) -> bool:

@@ -48,14 +48,43 @@ class EventBus:
     """
 
     def __init__(self, path: str | os.PathLike | None = None,
-                 keep_in_memory: bool = True):
+                 keep_in_memory: bool = True, append: bool = False):
         self.path = os.fspath(path) if path is not None else None
         self.keep_in_memory = keep_in_memory
         self.events: list[dict] = []
         self._seq = 0
         self._fh: io.TextIOBase | None = None
         if self.path is not None:
-            self._fh = open(self.path, "w", buffering=1)
+            # append mode (ISSUE 10): a resumed attempt extends the
+            # previous attempt's log instead of truncating it, and
+            # continues the seq ordinal past the existing maximum so the
+            # sort-by-seq contract keeps the attempts in order (a torn
+            # final line from the killed writer is tolerated, exactly as
+            # read_jsonl would)
+            if append and os.path.exists(self.path):
+                try:
+                    prior = read_versioned_jsonl(self.path, SCHEMA_VERSION)
+                    self._seq = 1 + max(
+                        (e.get("seq", -1) for e in prior), default=-1)
+                except ValueError:
+                    pass  # mid-log corruption: emit from 0, report sorts
+                # a killed writer can leave the final line without its
+                # newline. Appending straight onto it would corrupt BOTH
+                # events, and newline-terminating it would be worse: the
+                # fragment would become a NON-final unparseable line,
+                # which read_jsonl treats as fatal mid-log corruption.
+                # Readers already drop a torn tail, so TRUNCATE it.
+                with open(self.path, "rb+") as prev:
+                    prev.seek(0, os.SEEK_END)
+                    size = prev.tell()
+                    if size > 0:
+                        prev.seek(-1, os.SEEK_END)
+                        if prev.read(1) != b"\n":
+                            prev.seek(0)
+                            data = prev.read(size)
+                            keep = data.rfind(b"\n") + 1
+                            prev.truncate(keep)
+            self._fh = open(self.path, "a" if append else "w", buffering=1)
 
     # -- emission --------------------------------------------------------------
 

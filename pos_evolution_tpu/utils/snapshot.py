@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import struct
 
 import numpy as np
@@ -26,6 +27,25 @@ from pos_evolution_tpu.specs.containers import (
     LatestMessage,
 )
 from pos_evolution_tpu.ssz import deserialize, hash_tree_root, serialize
+
+
+def atomic_write_bytes(path: str | os.PathLike, data: bytes,
+                       fsync: bool = True) -> str:
+    """Tmp + (fsync) + rename, so a kill at ANY point leaves either the
+    previous complete file or the new complete file — never a torn one
+    that a later ``resume``/``load`` half-parses. Every checkpoint
+    write in the repo (manual snapshot files, the dense driver's npz,
+    chaos repro bundles, the resilience manager) goes through this or
+    its directory-level sibling in ``resilience/manager.py``."""
+    path = os.fspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        if fsync:
+            fh.flush()
+            os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    return path
 
 
 def _frame(out: io.BytesIO, payload: bytes) -> None:
@@ -163,12 +183,15 @@ def _payload_class(kind: str):
             "slashing": AttesterSlashing}[kind]
 
 
-def save_simulation(sim) -> bytes:
+def save_simulation(sim, path: str | os.PathLike | None = None) -> bytes:
     """Serialize a running ``sim.driver.Simulation`` so that ``resume``
     continues it bit-identically: per group the full Store
     (``save_store``), the pending message queue (times + arrival sequence
     + SSZ payloads), the attestation pool, and the per-block inclusion
     index; plus the slot cursor and recorded per-slot metrics.
+    ``path`` additionally lands the bytes on disk ATOMICALLY
+    (``atomic_write_bytes``): a kill mid-write can never leave a torn
+    file that a later manual ``resume()`` half-loads.
 
     Not serialized, by design: the Schedule/FaultPlan (callables — the
     caller passes the same one to ``resume``; fault decisions are
@@ -239,7 +262,10 @@ def save_simulation(sim) -> bytes:
             _frame(out, serialize(m.payload))
         for att in g.pool.values():
             _frame(out, serialize(att))
-    return out.getvalue()
+    data = out.getvalue()
+    if path is not None:
+        atomic_write_bytes(path, data)
+    return data
 
 
 def load_simulation(data: bytes, schedule=None, telemetry=None,
@@ -401,9 +427,13 @@ def _restore_das(sim, meta: dict, das) -> None:
 # --- dense-array host offload -------------------------------------------------
 
 def save_dense(path: str, registry) -> None:
-    """Host-offload a DenseRegistry pytree to .npz."""
-    np.savez_compressed(path, **{f: np.asarray(getattr(registry, f))
-                                 for f in registry._fields})
+    """Host-offload a DenseRegistry pytree to .npz, atomically (the
+    compressed stream lands in memory first, then tmp + fsync + rename
+    — a preempted offload can never leave a torn npz)."""
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **{f: np.asarray(getattr(registry, f))
+                                for f in registry._fields})
+    atomic_write_bytes(path, buf.getvalue())
 
 
 def load_dense(path: str):

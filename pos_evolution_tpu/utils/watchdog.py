@@ -41,6 +41,52 @@ class WatchdogTimeout(Exception):
     """A supervised step exceeded its wall-clock budget."""
 
 
+# -- run-loop heartbeats (ISSUE 10) --------------------------------------------
+#
+# The SIGALRM supervision above wraps bench STEPS; a long simulation run
+# needs liveness visible from OUTSIDE the process (a hang inside native
+# code never returns to any in-process handler, and an OOM-killed
+# process answers nothing). The heartbeat is the watchdog's file-based
+# leg: the run loop beats once per slot, and the resilience supervisor
+# (pos_evolution_tpu/resilience/supervisor.py) kills + resumes a child
+# whose heartbeat file stops advancing.
+
+class Heartbeat:
+    """Atomic single-file heartbeat: each ``beat`` replaces the file
+    with ``{"unix": <now>, ...fields}`` via write + rename, so a reader
+    never sees a torn payload and the previous beat survives a kill
+    mid-write (the same posture as ``Watchdog.commit``)."""
+
+    def __init__(self, path: str):
+        self.path = os.fspath(path)
+        self.beats = 0
+
+    def beat(self, **fields) -> None:
+        payload = {"unix": round(time.time(), 3), "pid": os.getpid(),
+                   **fields}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.write("\n")
+        os.replace(tmp, self.path)
+        self.beats += 1
+
+
+def read_heartbeat(path: str) -> dict | None:
+    """``{"age_s": <seconds since the last beat>, "payload": {...}}``,
+    or None when the file does not exist yet (a child that has not
+    reached its run loop is not hung — the supervisor falls back to
+    time-since-launch). A torn/unparseable file reads as None too: the
+    writer is atomic, so that means no beat has landed."""
+    try:
+        with open(os.fspath(path)) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return {"age_s": max(time.time() - float(payload.get("unix", 0.0)), 0.0),
+            "payload": payload}
+
+
 def _can_arm(timeout_s) -> bool:
     """Whether a step timeout can actually be armed here: a timeout was
     requested, the platform has SIGALRM, we are on the main thread, and

@@ -31,10 +31,18 @@ a chosen slot (no real equivocation behind them): the
 evidence set cannot reach 1/3) and a bundle must appear — the CI
 negative proving the pipeline fails loudly.
 
+Bundles are flushed INCREMENTALLY (ISSUE 10): each episode's config,
+episode-start checkpoint and event stream land in
+``<out>/inflight_ep<N>/`` before and during the run — a crashed or
+killed episode leaves a replayable partial bundle instead of nothing
+(resume it with ``--resume-bundle``); violating episodes are finalized
+by renaming the inflight dir to ``bundle_ep<N>``, clean ones remove it.
+
 Usage:
     python scripts/chaos_fuzz.py --episodes 20 --seed 7 --out chaos_out/
     python scripts/chaos_fuzz.py --doctor --out chaos_out/
     python scripts/chaos_fuzz.py --replay chaos_out/bundle_ep0/
+    python scripts/chaos_fuzz.py --resume-bundle chaos_out/inflight_ep3/
 """
 
 from __future__ import annotations
@@ -238,15 +246,31 @@ def _doctor_stores(sim, epoch: int) -> None:
 
 
 def run_episode(cfg: dict, events_path: str | None = None,
-                resume_from: bytes | None = None) -> dict:
+                resume_from: bytes | None = None,
+                bundle_dir: str | None = None) -> dict:
     """Run one composed episode; returns violations + the episode-start
     checkpoint (the repro-bundle payload). ``resume_from`` replays from a
     bundle's checkpoint through ``Simulation.resume`` instead of
-    constructing fresh — the replay contract."""
+    constructing fresh — the replay contract.
+
+    ``bundle_dir`` flushes the bundle INCREMENTALLY (ISSUE 10): the
+    config and the episode-start checkpoint land on disk (atomically)
+    BEFORE the first slot runs, and the event log streams there
+    line-at-a-time — a crashed or killed episode still leaves a
+    replayable artifact (``--resume-bundle``), instead of evaporating
+    with the process."""
     from pos_evolution_tpu.sim.driver import Simulation
     from pos_evolution_tpu.telemetry import Telemetry
+    from pos_evolution_tpu.utils.snapshot import atomic_write_bytes
     from pos_evolution_tpu.variants import variant_from_config
 
+    if bundle_dir is not None:
+        os.makedirs(bundle_dir, exist_ok=True)
+        atomic_write_bytes(
+            os.path.join(bundle_dir, "config.json"),
+            (json.dumps(cfg, indent=1, sort_keys=True) + "\n").encode())
+        if events_path is None:
+            events_path = os.path.join(bundle_dir, "events.jsonl")
     telemetry = (Telemetry.to_file(events_path)
                  if events_path is not None else None)
     adversaries = build_adversaries(cfg)
@@ -265,6 +289,9 @@ def run_episode(cfg: dict, events_path: str | None = None,
                              telemetry=telemetry, adversaries=adversaries,
                              monitors=monitors, variant=variant)
             checkpoint = sim.checkpoint()
+        if bundle_dir is not None:
+            atomic_write_bytes(os.path.join(bundle_dir, "checkpoint.bin"),
+                               checkpoint)
         doctor = cfg.get("doctor")
         while sim.slot <= cfg["n_slots"]:
             sim.run_slot()
@@ -344,17 +371,29 @@ def shrink(cfg: dict, reference_violation: dict) -> tuple[dict, list[dict]]:
 # -- bundles -------------------------------------------------------------------
 
 def write_bundle(out_dir: str, cfg: dict, result: dict,
-                 events_src: str | None, do_shrink: bool = True) -> str:
+                 events_src: str | None = None, do_shrink: bool = True,
+                 inflight_dir: str | None = None) -> str:
+    """Finalize a violating episode's bundle. With ``inflight_dir`` the
+    incrementally-flushed directory (config + checkpoint + streamed
+    events already inside) is renamed into place; otherwise the legacy
+    shape writes everything here."""
+    from pos_evolution_tpu.utils.snapshot import atomic_write_bytes
     bundle = os.path.join(out_dir, f"bundle_ep{cfg['episode']}")
+    if inflight_dir is not None and os.path.isdir(inflight_dir):
+        if os.path.isdir(bundle):
+            shutil.rmtree(bundle)
+        os.replace(inflight_dir, bundle)
     os.makedirs(bundle, exist_ok=True)
-    with open(os.path.join(bundle, "config.json"), "w") as fh:
-        json.dump(cfg, fh, indent=1, sort_keys=True)
-        fh.write("\n")
-    with open(os.path.join(bundle, "checkpoint.bin"), "wb") as fh:
-        fh.write(result["checkpoint"])
-    with open(os.path.join(bundle, "violations.json"), "w") as fh:
-        json.dump(result["violations"], fh, indent=1, sort_keys=True)
-        fh.write("\n")
+    atomic_write_bytes(
+        os.path.join(bundle, "config.json"),
+        (json.dumps(cfg, indent=1, sort_keys=True) + "\n").encode())
+    if not os.path.exists(os.path.join(bundle, "checkpoint.bin")):
+        atomic_write_bytes(os.path.join(bundle, "checkpoint.bin"),
+                           result["checkpoint"])
+    atomic_write_bytes(
+        os.path.join(bundle, "violations.json"),
+        (json.dumps(result["violations"], indent=1, sort_keys=True)
+         + "\n").encode())
     if events_src and os.path.exists(events_src):
         shutil.move(events_src, os.path.join(bundle, "events.jsonl"))
     if do_shrink and result["violations"]:
@@ -372,18 +411,37 @@ def write_bundle(out_dir: str, cfg: dict, result: dict,
 
 def replay_bundle(bundle: str) -> dict:
     """Re-run a bundle from its checkpoint via ``Simulation.resume`` and
-    compare the violations against the recorded ones."""
+    compare the violations against the recorded ones.
+
+    Also accepts a PARTIAL (inflight) bundle — the incremental flush of
+    a crashed episode, which has config + checkpoint but no
+    ``violations.json`` yet. The episode then runs to completion and
+    ``match`` is None (there is no recorded verdict to compare): the
+    ``--resume-bundle`` contract."""
     with open(os.path.join(bundle, "config.json")) as fh:
         cfg = json.load(fh)
-    with open(os.path.join(bundle, "checkpoint.bin"), "rb") as fh:
-        checkpoint = fh.read()
-    with open(os.path.join(bundle, "violations.json")) as fh:
-        recorded = json.load(fh)
+    cpath = os.path.join(bundle, "checkpoint.bin")
+    checkpoint = None
+    if os.path.exists(cpath):
+        with open(cpath, "rb") as fh:
+            checkpoint = fh.read()
+    # else: the episode died BEFORE the start checkpoint flushed. For a
+    # non-resumed episode the start checkpoint is a pure function of the
+    # config (a freshly constructed Simulation), so running from scratch
+    # reproduces the identical episode.
+    vpath = os.path.join(bundle, "violations.json")
+    recorded = None
+    if os.path.exists(vpath):
+        with open(vpath) as fh:
+            recorded = json.load(fh)
     result = run_episode(cfg, resume_from=checkpoint)
     key = lambda v: (v["slot"], v["monitor"], v["kind"])  # noqa: E731
-    match = sorted(map(key, result["violations"])) == sorted(map(key, recorded))
+    match = (None if recorded is None else
+             sorted(map(key, result["violations"]))
+             == sorted(map(key, recorded)))
     return {"match": match, "replayed": result["violations"],
-            "recorded": recorded}
+            "recorded": recorded,
+            "finalized": result["finalized"]}
 
 
 # -- CLI -----------------------------------------------------------------------
@@ -403,14 +461,18 @@ def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
     for ep in indices:
         cfg = episode_config(seed, ep, n_validators, n_slots, doctor=doctor,
                              variant=variant)
-        events_path = os.path.join(out_dir, f"ep{ep}.events.jsonl")
+        # incremental flush (ISSUE 10): config + start checkpoint +
+        # streamed events land in an inflight dir BEFORE the run, so a
+        # crashed/killed episode leaves a --resume-bundle artifact
+        inflight = os.path.join(out_dir, f"inflight_ep{ep}")
         result = wd.step(f"episode_{ep}", run_episode, cfg,
-                         events_path=events_path)
+                         bundle_dir=inflight)
         summary["episodes"] += 1
         if result is None:         # watchdog incident (timeout / crash)
             summary["incidents"] += 1
-            if os.path.exists(events_path):
-                os.remove(events_path)  # partial log of a dead episode
+            summary.setdefault("inflight", []).append(inflight)
+            print(f"episode {ep}: DIED mid-run — partial bundle kept at "
+                  f"{inflight} (replay with --resume-bundle)")
             continue
         # An accountable_fault is the protocol SURVIVING as designed —
         # the adversary bought a break by burning >= 1/3 of the relevant
@@ -421,8 +483,9 @@ def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
         unexplained = [v for v in result["violations"]
                        if v.get("kind") != "accountable_fault"]
         if result["violations"]:
-            bundle = write_bundle(out_dir, cfg, result, events_path,
-                                  do_shrink=do_shrink and bool(unexplained))
+            bundle = write_bundle(out_dir, cfg, result,
+                                  do_shrink=do_shrink and bool(unexplained),
+                                  inflight_dir=inflight)
             summary["bundles"].append(bundle)
         if unexplained:
             summary["violating"] += 1
@@ -433,8 +496,7 @@ def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
             print(f"episode {ep}: {len(result['violations'])} accountable "
                   f"fault(s), evidence bundled -> {bundle}")
         else:
-            if os.path.exists(events_path):
-                os.remove(events_path)
+            shutil.rmtree(inflight, ignore_errors=True)
             print(f"episode {ep}: clean "
                   f"(finalized={result['finalized']})")
     return summary
@@ -462,14 +524,24 @@ def main(argv=None) -> int:
                          "subdirectories")
     ap.add_argument("--replay", metavar="BUNDLE",
                     help="replay a repro bundle and verify the violation")
+    ap.add_argument("--resume-bundle", metavar="BUNDLE",
+                    help="resume a PARTIAL (inflight) bundle left by a "
+                         "crashed episode: run it to completion from its "
+                         "flushed config + checkpoint; verifies the "
+                         "violations only when the bundle recorded some")
     args = ap.parse_args(argv)
 
     with use_config(minimal_config()):
-        if args.replay:
-            out = replay_bundle(args.replay)
+        if args.replay or args.resume_bundle:
+            out = replay_bundle(args.replay or args.resume_bundle)
             print(json.dumps({"match": out["match"],
-                              "replayed": out["replayed"]}, indent=1))
-            return 0 if out["match"] else 1
+                              "replayed": out["replayed"],
+                              "finalized": out["finalized"]}, indent=1))
+            if args.replay:
+                return 0 if out["match"] else 1
+            # resume mode: completing the episode IS the success
+            # criterion; a recorded verdict, when present, must agree
+            return 0 if out["match"] in (True, None) else 1
         variants = (("gasper", "goldfish", "rlmd", "ssf")
                     if args.variant == "all" else (args.variant,))
         rc = 0

@@ -1,0 +1,386 @@
+"""Self-healing long runs: supervised, autocheckpointed, resumable
+(ISSUE 10, DESIGN.md §18).
+
+One entry point, two roles:
+
+- **parent** (default): launches itself as a ``--child`` process under
+  ``resilience.supervise`` — crash detection by exit code, hang
+  detection by heartbeat-file age, resume with capped jittered backoff,
+  loud refusal after ``--max-failures`` consecutive failures. After
+  success it folds the interruption/retry/overhead story into a
+  ``bench_resilience`` emission (gated by ``perf_gate.py`` via
+  ``--history``) and a ``goodput`` telemetry event;
+- **child**: builds (or ``resume_latest``-resumes) the requested driver
+  with ``autocheckpoint=`` armed — atomic checksummed steps every
+  ``--every`` slots, async writer, per-slot heartbeats, optional
+  integrity audits — runs to the target epoch, takes a final
+  checkpoint, and writes ``result.json`` (slot, state digest, overhead
+  stats) atomically.
+
+Failure injection for smokes/CI: ``--crash-at-slot N`` SIGKILLs the
+child the first time slot N completes (a marker file keeps the resumed
+attempt from re-crashing) — the honest simulation of preemption, OOM
+kills, and device loss. ``--degraded-sharded AxB`` makes every
+*resumed* attempt come up on a smaller mesh: the device-loss path of
+PR 9's resume-across-mesh-shapes, exercised end-to-end.
+
+Bit-identity contract: the final ``state_digest`` of a killed-and-
+resumed run equals an uninterrupted twin's, whatever the interruption
+history or mesh shape (pinned in tests/test_resilience.py and the
+resilience-smoke CI job).
+
+Usage:
+    python scripts/resilient_run.py --validators 64 --epochs 3 \
+        --ckpt-dir /tmp/res [--sharded 2x2] [--dense] [--every 8] \
+        [--crash-at-slot 14] [--degraded-sharded 1x2] \
+        [--events events.jsonl] [--json bench.json] [--history h.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_mesh(s: str | None):
+    if not s:
+        return None
+    pods, shard = (int(x) for x in s.lower().split("x"))
+    return pods, shard
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--validators", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--ckpt-dir", required=True,
+                    help="CheckpointManager store (also heartbeat + "
+                         "result.json)")
+    ap.add_argument("--every", type=int, default=8,
+                    help="autocheckpoint interval in slots")
+    ap.add_argument("--retain", type=int, default=3)
+    ap.add_argument("--sync", action="store_true",
+                    help="synchronous checkpoint writes (default: async "
+                         "writer thread)")
+    ap.add_argument("--guard-every", type=int, default=0,
+                    help="IntegrityGuard audit interval in slots (0=off)")
+    ap.add_argument("--dense", action="store_true",
+                    help="drive sim/dense_driver.DenseSimulation instead "
+                         "of the spec-level Simulation")
+    ap.add_argument("--sharded", default=None,
+                    help="mesh shape PxS (spec driver: "
+                         "Simulation(sharded=...); dense: a make_mesh)")
+    ap.add_argument("--degraded-sharded", default=None,
+                    help="mesh shape for RESUMED attempts (device-loss "
+                         "path: resume on fewer devices)")
+    ap.add_argument("--config", choices=("minimal", "mainnet"),
+                    default="minimal")
+    ap.add_argument("--crash-at-slot", type=int, default=None,
+                    help="SIGKILL the child once after this slot "
+                         "completes (failure injection)")
+    ap.add_argument("--hang-timeout", type=float, default=300.0)
+    ap.add_argument("--max-failures", type=int, default=3)
+    ap.add_argument("--backoff", type=float, default=0.5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--events", default=None,
+                    help="append-mode telemetry JSONL shared by the "
+                         "supervisor and every attempt")
+    ap.add_argument("--json", default=None,
+                    help="write the bench_resilience emission here")
+    ap.add_argument("--history", default=None,
+                    help="append the emission to this bench_history.jsonl")
+    ap.add_argument("--no-cpu-pin", action="store_true",
+                    help="do not force JAX_PLATFORMS=cpu + virtual host "
+                         "devices onto the child (real-hardware runs)")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    return ap
+
+
+# -- child ---------------------------------------------------------------------
+
+def _crash_marker(args) -> str:
+    return os.path.join(args.ckpt_dir, "crash_injected")
+
+
+def _maybe_crash(args, slot: int) -> None:
+    if args.crash_at_slot is None or slot != args.crash_at_slot:
+        return
+    marker = _crash_marker(args)
+    if os.path.exists(marker):
+        return  # already crashed once; the resumed attempt runs through
+    with open(marker, "w") as fh:
+        fh.write(f"SIGKILL after slot {slot}\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _autocheckpoint_spec(args) -> dict:
+    return {"every_n_slots": args.every, "dir": args.ckpt_dir,
+            "retain": args.retain, "async_mode": not args.sync,
+            "guard_every": args.guard_every,
+            "heartbeat": os.path.join(args.ckpt_dir, "heartbeat.json")}
+
+
+def _refuse_unless_virgin_store(args) -> None:
+    """Called when ``resume_latest`` found nothing valid. A store that
+    never held a checkpoint (crash before the first interval) may
+    legitimately start fresh; a store with steps that were REFUSED
+    (fingerprint mismatch) or quarantined (corruption) must NOT be
+    silently laundered into a from-genesis run that exits 0 — that
+    would invert the refuse-loudly contract (DESIGN.md §18)."""
+    remnants = []
+    if os.path.isdir(args.ckpt_dir):
+        from pos_evolution_tpu.resilience import CheckpointManager
+        steps = CheckpointManager(args.ckpt_dir).steps()
+        if steps:
+            remnants.append(f"{len(steps)} refused step(s) {steps}")
+        qdir = os.path.join(args.ckpt_dir, "quarantine")
+        if os.path.isdir(qdir) and os.listdir(qdir):
+            remnants.append(
+                f"{len(os.listdir(qdir))} quarantined step(s)")
+    if remnants:
+        raise SystemExit(
+            f"resilient_run: checkpoint store {args.ckpt_dir!r} holds "
+            f"{' and '.join(remnants)} but nothing resumable — refusing "
+            f"to restart from genesis as if nothing happened; inspect "
+            f"the store (wrong --config? corrupted disk?)")
+    print("# child: no checkpoints yet — starting fresh", file=sys.stderr)
+
+
+def run_child(args) -> int:
+    from pos_evolution_tpu.config import (
+        mainnet_config,
+        minimal_config,
+        use_config,
+    )
+    from pos_evolution_tpu.resilience import state_digest
+    from pos_evolution_tpu.telemetry import Telemetry
+    cfg_obj = (minimal_config() if args.config == "minimal"
+               else mainnet_config())
+    sharded = _parse_mesh(args.sharded)
+    degraded = _parse_mesh(args.degraded_sharded)
+    resumed_degraded = degraded if os.path.exists(_crash_marker(args)) \
+        else None
+    telemetry = (Telemetry.to_file(args.events, append=True)
+                 if args.events else None)
+    if telemetry is not None:
+        # bus-less emitters (CheckpointManager quarantine/reject, the
+        # dense driver's supervision) reach the same log via the
+        # global sink — without this their events silently vanish
+        telemetry.install_global()
+    spec = _autocheckpoint_spec(args)
+    t0 = time.perf_counter()
+    with use_config(cfg_obj):
+        if args.dense:
+            sim, target = _build_dense(args, cfg_obj, sharded,
+                                       resumed_degraded, spec)
+            while sim.slot < target:
+                sim.run_slot()
+                _maybe_crash(args, sim.slot)
+        else:
+            sim, target = _build_spec(args, sharded, resumed_degraded,
+                                      spec, telemetry)
+            while sim.slot <= target:
+                sim.run_slot()
+                _maybe_crash(args, sim.slot)
+        stats = sim.finish_autocheckpoint()
+        run_wall = time.perf_counter() - t0
+        result = {
+            "driver": "dense" if args.dense else "sim",
+            "n_validators": args.validators,
+            "slot": sim.slot,
+            "finalized_epoch": (sim.finalized[0] if args.dense
+                                else sim.finalized_epoch()),
+            "state_digest": state_digest(sim),
+            "run_wall_s": round(run_wall, 3),
+            "checkpoint": stats,
+            "resumed_on_degraded_mesh": (
+                list(resumed_degraded) if resumed_degraded else None),
+        }
+    from pos_evolution_tpu.utils.snapshot import atomic_write_bytes
+    atomic_write_bytes(os.path.join(args.ckpt_dir, "result.json"),
+                       (json.dumps(result, indent=1, sort_keys=True)
+                        + "\n").encode())
+    if telemetry is not None:
+        telemetry.bus.emit("run_segment", wall_s=result["run_wall_s"],
+                           final_slot=sim.slot)
+        telemetry.close()
+    print(json.dumps(result, indent=1, sort_keys=True))
+    return 0
+
+
+def _build_spec(args, sharded, resumed_degraded, spec, telemetry):
+    from pos_evolution_tpu.backend import set_backend
+    from pos_evolution_tpu.config import cfg as active_cfg
+    from pos_evolution_tpu.sim import Simulation
+    if sharded or resumed_degraded:
+        set_backend("jax")
+    use_sharded = resumed_degraded or sharded
+    try:
+        sim = Simulation.resume_latest(args.ckpt_dir, telemetry=telemetry,
+                                       sharded=use_sharded,
+                                       autocheckpoint=spec)
+        print(f"# child: resumed at slot {sim.slot} "
+              f"(mesh {use_sharded or 'single'})", file=sys.stderr)
+    except FileNotFoundError:
+        _refuse_unless_virgin_store(args)
+        sim = Simulation(args.validators, sharded=sharded,
+                         telemetry=telemetry, autocheckpoint=spec)
+    return sim, args.epochs * active_cfg().slots_per_epoch
+
+
+def _build_dense(args, cfg_obj, sharded, resumed_degraded, spec):
+    from pos_evolution_tpu.parallel.sharded import make_mesh
+    from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+    shape = resumed_degraded or sharded
+    mesh = make_mesh(shape[0] * shape[1], shape[0]) if shape else None
+    try:
+        sim = DenseSimulation.resume_latest(args.ckpt_dir, mesh=mesh,
+                                            autocheckpoint=spec)
+        print(f"# child: resumed at slot {sim.slot} "
+              f"(mesh {shape or 'single'})", file=sys.stderr)
+    except FileNotFoundError:
+        _refuse_unless_virgin_store(args)
+        sim = DenseSimulation(args.validators, cfg=cfg_obj, mesh=mesh,
+                              verify_aggregates=False, check_walk_every=8,
+                              autocheckpoint=spec)
+    return sim, args.epochs * cfg_obj.slots_per_epoch
+
+
+# -- parent --------------------------------------------------------------------
+
+class _AppendBus:
+    """Emit supervisor events into the shared JSONL without holding the
+    file open across a child's lifetime: each emission reopens in
+    append mode, so the seq ordinal continues past everything the child
+    wrote and the two writers never interleave."""
+
+    def __init__(self, path: str | None):
+        self.path = path
+
+    def emit(self, type_: str, **fields) -> None:
+        if self.path is None:
+            return
+        from pos_evolution_tpu.telemetry.events import EventBus
+        bus = EventBus(self.path, keep_in_memory=False, append=True)
+        bus.emit(type_, **fields)
+        bus.close()
+
+
+def _child_env(args) -> dict:
+    env = dict(os.environ)
+    if not args.no_cpu_pin:
+        env["JAX_PLATFORMS"] = "cpu"
+        mesh = _parse_mesh(args.sharded)
+        n_dev = mesh[0] * mesh[1] if mesh else 1
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                        f"{max(n_dev, 1)}").strip()
+    return env
+
+
+def _replayed_slots(events_path: str | None) -> int:
+    if not events_path or not os.path.exists(events_path):
+        return 0
+    from pos_evolution_tpu.resilience import replayed_slots_from_events
+    from pos_evolution_tpu.telemetry import read_jsonl
+    return replayed_slots_from_events(read_jsonl(events_path))
+
+
+def run_parent(args, argv: list[str]) -> int:
+    from pos_evolution_tpu.resilience import SupervisorGaveUp, supervise
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    heartbeat = os.path.join(args.ckpt_dir, "heartbeat.json")
+    bus = _AppendBus(args.events)
+
+    def build_argv(attempt: int) -> list[str]:
+        # the PARSED invocation, not sys.argv: a programmatic
+        # main([...]) caller must supervise the child it asked for
+        return [sys.executable, os.path.abspath(__file__), "--child",
+                *argv]
+
+    try:
+        summary = supervise(
+            build_argv, heartbeat_path=heartbeat,
+            hang_timeout_s=args.hang_timeout,
+            max_failures=args.max_failures, backoff_s=args.backoff,
+            seed=args.seed, env=_child_env(args), events_bus=bus)
+    except SupervisorGaveUp as e:
+        print(f"resilient_run: GAVE UP — {e}", file=sys.stderr)
+        print(json.dumps(e.summary, indent=1))
+        return 1
+
+    with open(os.path.join(args.ckpt_dir, "result.json")) as fh:
+        result = json.load(fh)
+    ckpt = result.get("checkpoint") or {}
+    run_wall = max(result.get("run_wall_s") or 0.0, 1e-9)
+    replayed = _replayed_slots(args.events)
+    final_slot = result["slot"]
+    emission = {
+        "metric": "resilient_run",
+        "driver": result["driver"],
+        "n_validators": args.validators,
+        "epochs": args.epochs,
+        "sharded": args.sharded,
+        "attempts": summary["attempts"],
+        "interruptions": len(summary["interruptions"]),
+        "interruption_reasons": sorted(
+            {i["reason"] for i in summary["interruptions"]}),
+        "replayed_slots": replayed,
+        "final_slot": final_slot,
+        "goodput_pct": round(100.0 * final_slot
+                             / max(final_slot + replayed, 1), 2),
+        "ckpt_saves": ckpt.get("saves", 0),
+        "ckpt_bytes": ckpt.get("bytes", 0),
+        "ckpt_blocked_s": ckpt.get("loop_blocked_s", 0.0),
+        "ckpt_background_s": ckpt.get("background_s", 0.0),
+        "ckpt_overhead_pct": round(
+            100.0 * ckpt.get("loop_blocked_s", 0.0) / run_wall, 3),
+        "run_wall_s": result["run_wall_s"],
+        "total_wall_s": summary["total_wall_s"],
+        "resumed_on_degraded_mesh": result.get("resumed_on_degraded_mesh"),
+        "state_digest": result["state_digest"],
+        "finalized_epoch": result["finalized_epoch"],
+        # count leaves for perf_gate.py (timing leaves gate via their
+        # *_s suffixes): more interruptions / replayed slots / saves at
+        # the same workload is a resilience regression
+        "counts": {"attempts": summary["attempts"],
+                   "interruptions": len(summary["interruptions"]),
+                   "replayed_slots": replayed,
+                   "ckpt_saves": ckpt.get("saves", 0)},
+    }
+    bus.emit("goodput", **{k: v for k, v in emission.items()
+                           if k != "metric"})
+    print(json.dumps(emission, indent=1, sort_keys=True))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(emission, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    if args.history:
+        from pos_evolution_tpu.profiling import history
+        history.append_entry(args.history, emission,
+                             kind="bench_resilience")
+        print(f"# appended bench_resilience emission to {args.history}",
+              file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    args = build_parser().parse_args(argv)
+    if args.child:
+        return run_child(args)
+    return run_parent(args, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -233,6 +233,64 @@ def build_report(events: list[dict], top_ops: dict | None = None,
             "scheme": (attach.get("engine") or {}).get("scheme"),
         }
 
+    # -- resilience (resilience/ checkpoint + supervisor events) --------------
+    ckpts = by_type.get("checkpoint_saved", [])
+    interruptions = by_type.get("supervisor_interruption", [])
+    resumes = by_type.get("run_resumed", [])
+    quarantines = by_type.get("checkpoint_quarantined", [])
+    integrity = by_type.get("integrity_violation", [])
+    goodput_ev = (by_type.get("goodput") or [None])[-1]
+    resilience = None
+    if ckpts or interruptions or resumes or goodput_ev or integrity:
+        final = (by_type.get("checkpoint_final") or [{}])[-1]
+        segments = by_type.get("run_segment", [])
+        run_wall = sum(float(s.get("wall_s", 0)) for s in segments)
+        blocked_ms = sum(float(e.get("blocked_ms", 0)) for e in ckpts)
+        from pos_evolution_tpu.resilience import replayed_slots_from_events
+        replayed = replayed_slots_from_events(events)
+        # overhead: the goodput event's figure is canonical (final
+        # attempt's in-loop blocked time over that attempt's wall); the
+        # event-derived fallback sums blocked_ms over EVERY attempt but
+        # run_segment only over completed ones, so it overstates
+        # overhead whenever a run was interrupted
+        if goodput_ev and goodput_ev.get("ckpt_overhead_pct") is not None:
+            overhead_pct = goodput_ev["ckpt_overhead_pct"]
+        elif run_wall and not interruptions:
+            overhead_pct = round(100.0 * blocked_ms / (run_wall * 1e3), 3)
+        else:
+            overhead_pct = None
+        resilience = {
+            "checkpoints_saved": len(ckpts),
+            "checkpoint_blocked_ms": round(blocked_ms, 3),
+            "checkpoint_overhead_pct": overhead_pct,
+            "checkpoint_bytes": final.get("bytes"),
+            "async_mode": ckpts[-1].get("async_mode") if ckpts else None,
+            "interruptions": [
+                {k: e.get(k) for k in ("attempt", "reason", "exit_code",
+                                       "wall_s") if e.get(k) is not None}
+                for e in interruptions],
+            "resumes": [{"step": e.get("step"), "slot": e.get("slot")}
+                        for e in resumes],
+            "replayed_slots": replayed,
+            "quarantined_checkpoints": [
+                {"step": e.get("step"), "reason": e.get("reason")}
+                for e in quarantines],
+            "rejected_checkpoints": [
+                {"step": e.get("step"), "reason": e.get("reason")}
+                for e in by_type.get("checkpoint_rejected", [])],
+            "integrity_violations": [
+                {"slot": e.get("slot"), "findings": e.get("findings")}
+                for e in integrity],
+            "gave_up": bool(by_type.get("supervisor_gaveup")),
+        }
+        if goodput_ev is not None:
+            resilience["goodput"] = {
+                k: goodput_ev.get(k) for k in
+                ("attempts", "interruptions", "replayed_slots",
+                 "final_slot", "goodput_pct", "ckpt_overhead_pct",
+                 "total_wall_s", "resumed_on_degraded_mesh")
+                if goodput_ev.get(k) is not None}
+
     # -- variant audit (variants/ per-slot records + variant_safety) ----------
     variant_events = by_type.get("variant", [])
     variant_audit = None
@@ -315,6 +373,8 @@ def build_report(events: list[dict], top_ops: dict | None = None,
         "handlers": handlers,
         "light_clients": {str(k): v for k, v in sorted(lc.items())},
     }
+    if resilience:
+        report["resilience"] = resilience
     if merkleization:
         report["merkleization"] = merkleization
     if das_serving:
@@ -453,6 +513,45 @@ def to_markdown(report: dict) -> str:
                  for v in va["violations"]])]
         else:
             md.append("- no variant-safety violations")
+
+    if report.get("resilience"):
+        res = report["resilience"]
+        md += ["", "## Resilience", ""]
+        md.append(f"- checkpoints saved: **{res['checkpoints_saved']}** "
+                  f"({'async' if res.get('async_mode') else 'sync'} mode, "
+                  f"{res['checkpoint_blocked_ms']} ms blocked in-loop"
+                  + (f", {res['checkpoint_overhead_pct']}% of run wall"
+                     if res.get("checkpoint_overhead_pct") is not None
+                     else "") + ")")
+        ints = res.get("interruptions") or []
+        md.append(f"- interruptions: {len(ints)}"
+                  + (" — " + ", ".join(
+                      f"attempt {i.get('attempt')}: {i.get('reason')} "
+                      f"(exit {i.get('exit_code')})" for i in ints)
+                     if ints else " (uninterrupted)"))
+        if res.get("resumes"):
+            md.append("- resumes: " + ", ".join(
+                f"step {r['step']} -> slot {r['slot']}"
+                for r in res["resumes"])
+                + f" (replayed slots: {res.get('replayed_slots', 0)})")
+        gp = res.get("goodput")
+        if gp:
+            md.append(f"- effective goodput: **{gp.get('goodput_pct')}%** "
+                      f"({gp.get('final_slot')} useful slots, "
+                      f"{gp.get('replayed_slots')} replayed, "
+                      f"{gp.get('attempts')} attempt(s), total wall "
+                      f"{gp.get('total_wall_s')}s)")
+            if gp.get("resumed_on_degraded_mesh"):
+                md.append(f"- resumed on a DEGRADED mesh: "
+                          f"{gp['resumed_on_degraded_mesh']}")
+        for q in res.get("quarantined_checkpoints") or []:
+            md.append(f"- **quarantined checkpoint** step {q['step']}: "
+                      f"{q['reason']}")
+        for iv in res.get("integrity_violations") or []:
+            md.append(f"- **integrity violation** at slot {iv['slot']}: "
+                      f"{iv['findings']}")
+        if res.get("gave_up"):
+            md.append("- **SUPERVISOR GAVE UP** — retry budget exhausted")
 
     if report.get("merkleization"):
         merk = report["merkleization"]

@@ -1,0 +1,724 @@
+"""ISSUE 10: self-healing long runs — atomic checksummed
+autocheckpoints (sync + async), supervised auto-resume with SIGKILL
+injection, corruption detection/quarantine/rollback, degraded-mesh
+resume, goodput reporting, and the bench_resilience perf gate."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config, use_config
+from pos_evolution_tpu.resilience import (
+    AutoCheckpoint,
+    CheckpointCorruption,
+    CheckpointManager,
+    FingerprintMismatch,
+    IntegrityError,
+    backoff_delay,
+    scan_columns,
+    state_digest,
+    supervise,
+)
+
+jax = pytest.importorskip("jax")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+
+def _payload_path(mgr, step, name="payload.bin"):
+    return os.path.join(mgr._step_dir(step), name)
+
+
+# --- CheckpointManager --------------------------------------------------------
+
+
+class TestCheckpointManager:
+    def test_roundtrip_and_retention(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, retain=2)
+        for step, blob in ((4, b"a" * 100), (8, b"b" * 100),
+                           (12, b"c" * 100)):
+            mgr.save(step, blob)
+        assert mgr.steps() == [8, 12]  # oldest GC'd
+        step, payloads = mgr.latest_valid()
+        assert step == 12 and payloads["payload.bin"] == b"c" * 100
+
+    def test_async_callable_payload_and_stats(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, retain=4, async_mode=True)
+        mgr.save(1, {"payload.bin": lambda: b"lazy" * 1000})
+        mgr.save(2, b"eager")
+        mgr.drain()
+        assert mgr.load(1)["payload.bin"] == b"lazy" * 1000
+        s = mgr.stats()
+        assert s["saves"] == 2 and s["background_s"] > 0
+        mgr.close()
+
+    def test_async_worker_error_surfaces(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, async_mode=True)
+
+        def boom():
+            raise ValueError("serialize died")
+        mgr.save(1, {"payload.bin": boom})
+        with pytest.raises(RuntimeError, match="background checkpoint"):
+            mgr.save(2, b"x", wait=True)
+        mgr.close()
+
+    def test_truncated_payload_refused_and_rolled_past(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, retain=4)
+        mgr.save(4, b"good" * 64)
+        mgr.save(8, b"newer" * 64)
+        p = _payload_path(mgr, 8)
+        with open(p, "rb") as fh:
+            data = fh.read()
+        with open(p, "wb") as fh:
+            fh.write(data[: len(data) // 2])  # torn write
+        with pytest.raises(CheckpointCorruption, match="truncated"):
+            mgr.validate(8)
+        step, payloads = mgr.latest_valid()
+        assert step == 4 and payloads["payload.bin"] == b"good" * 64
+        # the torn step is quarantined as evidence, not deleted
+        assert mgr.steps() == [4]
+        assert os.path.isdir(os.path.join(str(tmp_path), "quarantine",
+                                          "step_00000008"))
+
+    def test_bit_flip_refused(self, tmp_path):
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(4, b"\x00" * 256)
+        p = _payload_path(mgr, 4)
+        with open(p, "r+b") as fh:
+            fh.seek(128)
+            fh.write(b"\x01")  # single bit flip, length unchanged
+        with pytest.raises(CheckpointCorruption, match="checksum"):
+            mgr.load(4)
+
+    def test_forged_checksum_quarantined_not_loaded(self, tmp_path):
+        """The doctored negative: an attacker (or a bug) rewriting the
+        manifest checksum must not smuggle altered bytes into a resume —
+        the recomputed payload hash disagrees with the forged one."""
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(4, b"truth")
+        mgr.save(8, b"newer-truth")
+        mpath = os.path.join(mgr._step_dir(8), "manifest.json")
+        manifest = json.load(open(mpath))
+        manifest["files"]["payload.bin"]["sha256"] = "f" * 64
+        json.dump(manifest, open(mpath, "w"))
+        step, _ = mgr.latest_valid()
+        assert step == 4
+        assert 8 not in mgr.steps()  # quarantined
+        assert mgr.stats()["quarantined"] == 1
+
+    def test_missing_manifest_refused(self, tmp_path):
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(4, b"x")
+        os.remove(os.path.join(mgr._step_dir(4), "manifest.json"))
+        with pytest.raises(CheckpointCorruption, match="no manifest"):
+            mgr.load(4)
+
+    def test_fingerprint_mismatch_refused_without_quarantine(self,
+                                                             tmp_path):
+        """A checkpoint from a different run shape is REFUSED but kept:
+        it is somebody's good checkpoint, just not this run's."""
+        CheckpointManager(tmp_path, fingerprint={"cfg": "aaaa"}).save(4,
+                                                                      b"x")
+        other = CheckpointManager(tmp_path, fingerprint={"cfg": "bbbb"})
+        with pytest.raises(FingerprintMismatch):
+            other.validate(4)
+        assert other.latest_valid() is None
+        assert other.steps() == [4]  # still there, NOT quarantined
+
+    def test_resave_same_step_never_loses_the_durable_copy(self, tmp_path):
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(4, b"first")
+        mgr.save(4, b"second")  # re-save (the finish() at slot N case)
+        assert mgr.load(4)["payload.bin"] == b"second"
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.startswith(".old-")]
+        # kill between displace and rename: the displaced previous copy
+        # must be RESTORED by the next manager start, not lost
+        displaced = os.path.join(str(tmp_path), ".old-step_00000004-999")
+        os.replace(mgr._step_dir(4), displaced)
+        assert CheckpointManager(tmp_path).latest_valid()[0] == 4
+
+    def test_kill_mid_write_leaves_previous_step(self, tmp_path):
+        """Simulated preemption inside a staged write: the tmp dir is
+        invisible to steps() and swept on the next manager start."""
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(4, b"committed")
+        tmp = os.path.join(str(tmp_path), ".tmp-step_00000008-99999")
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, "payload.bin"), "wb") as fh:
+            fh.write(b"half-writ")  # no manifest: the kill point
+        assert mgr.steps() == [4]
+        mgr2 = CheckpointManager(tmp_path)
+        assert not os.path.exists(tmp)  # swept
+        assert mgr2.latest_valid()[0] == 4
+
+
+# --- heartbeat + backoff ------------------------------------------------------
+
+
+class TestHeartbeatAndBackoff:
+    def test_beat_roundtrip_and_age(self, tmp_path):
+        from pos_evolution_tpu.utils.watchdog import Heartbeat, read_heartbeat
+        p = str(tmp_path / "hb.json")
+        assert read_heartbeat(p) is None
+        hb = Heartbeat(p)
+        hb.beat(slot=17)
+        out = read_heartbeat(p)
+        assert out["payload"]["slot"] == 17
+        assert out["age_s"] < 5.0
+
+    def test_backoff_caps_and_is_deterministic(self):
+        assert backoff_delay(0, 1.0, 30.0, 0.25, seed=1) == 0.0
+        a = backoff_delay(3, 1.0, 30.0, 0.25, seed=1)
+        b = backoff_delay(3, 1.0, 30.0, 0.25, seed=1)
+        assert a == b  # same (seed, failures) -> same jitter
+        assert 4.0 <= a <= 5.0  # base * 2**2 * (1 + [0, .25))
+        assert backoff_delay(30, 1.0, 30.0, 0.0, seed=1) == 30.0  # cap
+
+
+# --- supervise() over real child processes ------------------------------------
+
+
+class TestSupervisor:
+    def _script(self, tmp_path, body) -> list:
+        path = tmp_path / "child.py"
+        path.write_text(textwrap.dedent(body))
+        return [sys.executable, str(path)]
+
+    def test_crash_then_success(self, tmp_path):
+        argv = self._script(tmp_path, f"""
+            import os, sys
+            marker = {str(tmp_path / 'once')!r}
+            if not os.path.exists(marker):
+                open(marker, 'w').close()
+                sys.exit(3)       # first attempt crashes
+            sys.exit(0)
+        """)
+        summary = supervise(lambda attempt: argv, max_failures=3,
+                            backoff_s=0.01, poll_s=0.02)
+        assert summary["ok"] and summary["attempts"] == 2
+        (i,) = summary["interruptions"]
+        assert i["reason"] == "crash" and i["exit_code"] == 3
+
+    def test_hang_detected_and_killed(self, tmp_path):
+        hb_path = str(tmp_path / "hb.json")
+        # first attempt beats once then hangs forever; the resumed
+        # attempt exits clean
+        argv = self._script(tmp_path, f"""
+            import json, os, sys, time
+            sys.path.insert(0, {_REPO!r})
+            from pos_evolution_tpu.utils.watchdog import Heartbeat
+            marker = {str(tmp_path / 'hung_once')!r}
+            hb = Heartbeat({hb_path!r})
+            hb.beat(slot=1)
+            if not os.path.exists(marker):
+                open(marker, 'w').close()
+                time.sleep(600)   # wedged
+            sys.exit(0)
+        """)
+        t0 = time.time()
+        summary = supervise(lambda attempt: argv, heartbeat_path=hb_path,
+                            hang_timeout_s=1.0, max_failures=3,
+                            backoff_s=0.01, poll_s=0.05)
+        assert summary["ok"] and summary["attempts"] == 2
+        assert summary["interruptions"][0]["reason"] == "hang"
+        assert summary["interruptions"][0]["exit_code"] == -signal.SIGKILL
+        assert time.time() - t0 < 60  # killed, not waited out
+
+    def test_gives_up_loudly_after_n_failures(self, tmp_path):
+        from pos_evolution_tpu.resilience import SupervisorGaveUp
+        argv = self._script(tmp_path, "import sys; sys.exit(7)")
+        with pytest.raises(SupervisorGaveUp) as ei:
+            supervise(lambda attempt: argv, max_failures=2,
+                      backoff_s=0.01, poll_s=0.02)
+        assert ei.value.summary["attempts"] == 2
+        assert not ei.value.summary["ok"]
+
+
+# --- driver autocheckpointing (spec level) ------------------------------------
+
+
+@pytest.mark.usefixtures("minimal_cfg")
+class TestSimulationAutocheckpoint:
+    def test_autocheckpoint_resume_bit_identical_to_twin(self, tmp_path):
+        from pos_evolution_tpu.sim import Simulation
+        d = str(tmp_path / "ckpt")
+        sim = Simulation(32, autocheckpoint=(4, d))
+        sim.run_epochs(1)
+        sim.finish_autocheckpoint()
+        resumed = Simulation.resume_latest(d)
+        twin = Simulation(32)
+        twin.run_epochs(1)
+        assert resumed.slot == twin.slot
+        assert state_digest(resumed) == state_digest(twin)
+        resumed.run_epochs(2)
+        twin.run_epochs(2)
+        assert state_digest(resumed) == state_digest(twin)
+
+    def test_resume_skips_torn_newest_step(self, tmp_path):
+        """The supervisor contract of the satellite: a kill mid-write
+        (or post-write corruption) of the NEWEST step must roll the
+        resume back to the previous valid one, loudly."""
+        from pos_evolution_tpu.sim import Simulation
+        d = str(tmp_path / "ckpt")
+        sim = Simulation(32, autocheckpoint=(4, d))
+        sim.run_epochs(2)
+        sim.finish_autocheckpoint()
+        mgr = CheckpointManager(d)
+        steps = mgr.steps()
+        assert len(steps) >= 2
+        newest = steps[-1]
+        p = _payload_path(mgr, newest)
+        with open(p, "r+b") as fh:  # truncate = torn write
+            fh.truncate(os.path.getsize(p) // 2)
+        resumed = Simulation.resume_latest(d)
+        assert resumed.slot == steps[-2]
+        assert newest not in CheckpointManager(d).steps()  # quarantined
+
+    def test_resume_refuses_when_all_steps_corrupt(self, tmp_path):
+        from pos_evolution_tpu.sim import Simulation
+        d = str(tmp_path / "ckpt")
+        sim = Simulation(32, autocheckpoint=(8, d))
+        sim.run_epochs(1)
+        sim.finish_autocheckpoint()
+        mgr = CheckpointManager(d)
+        for step in mgr.steps():
+            p = _payload_path(mgr, step)
+            with open(p, "r+b") as fh:
+                fh.truncate(10)
+        with pytest.raises(FileNotFoundError, match="no valid checkpoint"):
+            Simulation.resume_latest(d)
+
+    def test_config_fingerprint_mismatch_refuses(self, tmp_path):
+        """A checkpoint taken under one protocol config must not resume
+        under another (same failure mode as resuming a mainnet store
+        with minimal constants: silent nonsense)."""
+        from pos_evolution_tpu.config import mainnet_config
+        from pos_evolution_tpu.sim import Simulation
+        d = str(tmp_path / "ckpt")
+        sim = Simulation(16, autocheckpoint=(4, d))
+        sim.run_epochs(1)
+        sim.finish_autocheckpoint()
+        with use_config(mainnet_config()):
+            with pytest.raises(FileNotFoundError):
+                Simulation.resume_latest(d)
+        # NOT quarantined: it is a good checkpoint for the right config
+        assert CheckpointManager(d).steps()
+        assert Simulation.resume_latest(d).slot == sim.slot
+
+
+# --- driver autocheckpointing (dense, sharded, cross-mesh) --------------------
+
+
+class TestDenseAutocheckpoint:
+    @pytest.mark.mesh8
+    def test_kill_resume_on_degraded_mesh_bit_identical(self, tmp_path):
+        """Checkpoint on 2x2, 'lose' half the devices, resume on 1x2
+        and finish — bit-identical to an uninterrupted single-device
+        twin (the device-loss path of PR 9's resume-across-mesh)."""
+        from pos_evolution_tpu.parallel.sharded import make_mesh
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        cfg = minimal_config()
+        d = str(tmp_path / "ckpt")
+        sim = DenseSimulation(64, cfg=cfg, mesh=make_mesh(4, 2),
+                              verify_aggregates=False, check_walk_every=0,
+                              autocheckpoint=(4, d))
+        sim.run_epochs(2)
+        sim.finish_autocheckpoint()
+        resumed = DenseSimulation.resume_latest(d, mesh=make_mesh(2, 1))
+        twin = DenseSimulation(64, cfg=cfg, mesh=None,
+                               verify_aggregates=False, check_walk_every=0)
+        twin.run_epochs(2)
+        assert state_digest(resumed) == state_digest(twin)
+        resumed.run_epochs(4)
+        twin.run_epochs(4)
+        assert state_digest(resumed) == state_digest(twin)
+
+    def test_torn_dense_checkpoint_refused(self, tmp_path):
+        """The corrupt-checkpoint satellite on the dense backend: a
+        bit-flipped npz payload must refuse with a checksum error and
+        the resume must land on the previous valid step."""
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        cfg = minimal_config()
+        d = str(tmp_path / "ckpt")
+        sim = DenseSimulation(32, cfg=cfg, verify_aggregates=False,
+                              check_walk_every=0, autocheckpoint=(4, d))
+        sim.run_epochs(1)
+        sim.finish_autocheckpoint()
+        mgr = CheckpointManager(d)
+        steps = mgr.steps()
+        p = _payload_path(mgr, steps[-1])
+        with open(p, "r+b") as fh:
+            fh.seek(os.path.getsize(p) // 2)
+            fh.write(b"\xff\xff")
+        with pytest.raises(CheckpointCorruption, match="checksum"):
+            mgr.load(steps[-1])
+        resumed = DenseSimulation.resume_latest(d)
+        assert resumed.slot == steps[-2]
+
+
+# --- integrity guard: detect -> quarantine -> rollback -> replay --------------
+
+
+class TestIntegrityGuard:
+    def test_scan_columns_flags_nan_and_oob(self):
+        findings = scan_columns(
+            {"weights": np.array([1.0, np.nan, np.inf]),
+             "balance": np.array([5, -3], dtype=np.int64),
+             "msg_block": np.array([0, 7], dtype=np.int32)},
+            n_blocks=4)
+        text = "; ".join(findings)
+        assert "2 non-finite" in text
+        assert "negative balance" in text
+        assert "outside the 4-entry block table" in text
+
+    def test_rollback_replay_bit_identical_to_twin(self, tmp_path):
+        """The full recovery loop, in-process: corrupt the dense state
+        mid-run -> the guard trips -> the newest checkpoint is
+        quarantined -> resume from the last good step -> replay to the
+        end — final state bit-identical to an uninterrupted twin."""
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        cfg = minimal_config()
+        d = str(tmp_path / "ckpt")
+        spec = AutoCheckpoint(every_n_slots=4, dir=d, guard_every=4,
+                              retain=8)
+        sim = DenseSimulation(32, cfg=cfg, verify_aggregates=False,
+                              check_walk_every=0, autocheckpoint=spec)
+        target = 2 * cfg.slots_per_epoch
+        poisoned_at = None
+        with pytest.raises(IntegrityError) as ei:
+            while sim.slot < target:
+                sim.run_slot()
+                if sim.slot == 9 and poisoned_at is None:
+                    # memory corruption between audits: a vote pointer
+                    # wanders outside the block table
+                    poisoned_at = sim.slot
+                    sim.msg_block = sim.msg_block.at[3].set(10_000)
+        assert "msg_block" in str(ei.value)
+        mgr = CheckpointManager(d)
+        assert mgr.stats()["quarantined"] == 0  # fresh manager view
+        assert os.path.isdir(os.path.join(d, "quarantine"))
+        good = mgr.steps()[-1]
+        assert good <= 8  # the post-poison step is out of the sequence
+        resumed = DenseSimulation.resume_latest(d)
+        assert resumed.slot == good
+        twin = DenseSimulation(32, cfg=cfg, verify_aggregates=False,
+                               check_walk_every=0)
+        while twin.slot < target:
+            twin.run_slot()
+        while resumed.slot < target:
+            resumed.run_slot()
+        assert state_digest(resumed) == state_digest(twin)
+
+    @pytest.mark.usefixtures("minimal_cfg")
+    def test_spec_driver_guard_catches_resident_corruption(self):
+        from pos_evolution_tpu.resilience import IntegrityGuard
+        from pos_evolution_tpu.sim import Simulation
+        sim = Simulation(32)
+        sim.run_epochs(1)
+        guard = IntegrityGuard(every_n_slots=1)
+        assert guard.check(sim) == []
+        # clobber a store invariant: finality ahead of justification
+        from pos_evolution_tpu.specs.containers import Checkpoint
+        sim.groups[0].store.finalized_checkpoint = Checkpoint(
+            epoch=9, root=bytes(32))
+        findings = guard.check(sim)
+        assert any("ahead of justified" in f for f in findings)
+
+
+# --- the supervised SIGKILL end-to-end (satellite 4) --------------------------
+
+
+@pytest.mark.mesh8
+class TestKillMidRunSupervised:
+    def _run(self, tmp_path, tag, extra):
+        out = tmp_path / f"bench_{tag}.json"
+        argv = [sys.executable,
+                os.path.join(_REPO, "scripts", "resilient_run.py"),
+                "--validators", "64", "--epochs", "2",
+                "--ckpt-dir", str(tmp_path / f"ckpt_{tag}"),
+                "--every", "4", "--backoff", "0.05",
+                "--json", str(out), *extra]
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # the parent sets the child's devices
+        proc = subprocess.run(argv, env=env, capture_output=True,
+                              text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return json.load(open(out))
+
+    def test_sigkill_between_epochs_resumes_bit_identical(self, tmp_path):
+        """SIGKILL a supervised 64-validator SHARDED run between epochs
+        (slot 10 of 16), auto-resume onto a DEGRADED mesh (2x2 -> 1x2),
+        finish, and pin bit-identity of the final state against an
+        uninterrupted twin."""
+        killed = self._run(
+            tmp_path, "killed",
+            ["--sharded", "2x2", "--degraded-sharded", "1x2",
+             "--crash-at-slot", "10",
+             "--events", str(tmp_path / "events.jsonl")])
+        assert killed["attempts"] == 2
+        assert killed["interruptions"] == 1
+        assert killed["interruption_reasons"] == ["crash"]
+        assert killed["resumed_on_degraded_mesh"] == [1, 2]
+        assert killed["replayed_slots"] >= 1  # slot 10 back to step 8
+        twin = self._run(tmp_path, "twin", ["--sharded", "2x2"])
+        assert twin["attempts"] == 1 and twin["interruptions"] == 0
+        assert killed["state_digest"] == twin["state_digest"]
+        assert killed["final_slot"] == twin["final_slot"]
+        # async autocheckpointing overhead is measured and bounded
+        assert twin["ckpt_overhead_pct"] < 10.0, twin
+        # the events log reconstructs the story offline
+        import run_report
+        from pos_evolution_tpu.telemetry import read_jsonl
+        report = run_report.build_report(
+            read_jsonl(str(tmp_path / "events.jsonl")))
+        res = report["resilience"]
+        assert res["checkpoints_saved"] >= 2
+        assert len(res["interruptions"]) == 1
+        assert res["resumes"] and res["resumes"][0]["step"] == 8
+        md = run_report.to_markdown(report)
+        assert "## Resilience" in md
+        assert "effective goodput" in md
+
+
+# --- run_report + perf gate ---------------------------------------------------
+
+
+class TestResilienceReport:
+    def _events(self):
+        seq = [0]
+
+        def ev(type_, **f):
+            seq[0] += 1
+            return {"v": 1, "seq": seq[0], "type": type_, **f}
+        return [
+            ev("checkpoint_saved", slot=8, step=8, async_mode=True,
+               blocked_ms=12.5),
+            ev("supervisor_interruption", attempt=0, reason="crash",
+               exit_code=-9, wall_s=4.2, last_heartbeat={"slot": 10}),
+            ev("run_resumed", step=8, slot=8, dir="/tmp/x"),
+            ev("checkpoint_saved", slot=12, step=12, async_mode=True,
+               blocked_ms=11.0),
+            ev("checkpoint_quarantined", step=16, reason="checksum"),
+            ev("integrity_violation", slot=14, findings=["boom"]),
+            ev("checkpoint_final", slot=16, saves=3, bytes=1000,
+               loop_blocked_s=0.02, blocked_s=0.03, background_s=0.4),
+            ev("run_segment", wall_s=9.0, final_slot=16),
+            ev("goodput", attempts=2, interruptions=1, replayed_slots=2,
+               final_slot=16, goodput_pct=88.9, ckpt_overhead_pct=2.0,
+               total_wall_s=13.0),
+        ]
+
+    def test_build_report_resilience_section(self):
+        import run_report
+        rep = run_report.build_report(self._events())
+        res = rep["resilience"]
+        assert res["checkpoints_saved"] == 2
+        assert res["replayed_slots"] == 2
+        assert res["interruptions"][0]["reason"] == "crash"
+        assert res["quarantined_checkpoints"][0]["step"] == 16
+        assert res["integrity_violations"][0]["slot"] == 14
+        assert res["goodput"]["goodput_pct"] == 88.9
+        md = run_report.to_markdown(rep)
+        assert "## Resilience" in md
+        assert "quarantined checkpoint" in md
+        assert "integrity violation" in md
+
+    def test_no_resilience_events_no_section(self):
+        import run_report
+        rep = run_report.build_report(
+            [{"v": 1, "seq": 0, "type": "slot", "slot": 1}])
+        assert "resilience" not in rep
+        assert "## Resilience" not in run_report.to_markdown(rep)
+
+
+class TestBenchResilienceGate:
+    def _emission(self, blocked=0.2, interruptions=1):
+        return {"metric": "resilient_run", "driver": "sim",
+                "attempts": interruptions + 1,
+                "interruptions": interruptions,
+                "replayed_slots": 2, "final_slot": 16,
+                "goodput_pct": 88.9,
+                "ckpt_blocked_s": blocked, "ckpt_background_s": 1.0,
+                "ckpt_overhead_pct": 100.0 * blocked / 9.0,
+                "run_wall_s": 9.0, "total_wall_s": 13.0,
+                "counts": {"attempts": interruptions + 1,
+                           "interruptions": interruptions,
+                           "replayed_slots": 2, "ckpt_saves": 3}}
+
+    def test_gate_passes_real_fails_doctored_overhead(self, tmp_path):
+        import perf_gate
+
+        from pos_evolution_tpu.profiling import history
+        hist = tmp_path / "hist.jsonl"
+        for _ in range(3):
+            history.append_entry(hist, self._emission(),
+                                 kind="bench_resilience")
+        cand = tmp_path / "cand.json"
+        cand.write_text(json.dumps(self._emission(blocked=0.21)))
+        assert perf_gate.main(["--candidate", str(cand),
+                               "--history", str(hist),
+                               "--kind", "bench_resilience",
+                               "--strict-timing"]) == 0
+        slow = tmp_path / "slow.json"
+        slow.write_text(json.dumps(self._emission(blocked=2.0)))
+        assert perf_gate.main(["--candidate", str(slow),
+                               "--history", str(hist),
+                               "--kind", "bench_resilience",
+                               "--strict-timing"]) == 1
+
+    def test_gate_fails_on_more_interruptions(self, tmp_path):
+        import perf_gate
+
+        from pos_evolution_tpu.profiling import history
+        hist = tmp_path / "hist.jsonl"
+        for _ in range(3):
+            history.append_entry(hist, self._emission(),
+                                 kind="bench_resilience")
+        worse = tmp_path / "worse.json"
+        worse.write_text(json.dumps(self._emission(interruptions=30)))
+        assert perf_gate.main(["--candidate", str(worse),
+                               "--history", str(hist),
+                               "--kind", "bench_resilience"]) == 1
+
+
+class TestRefuseUnlessVirginStore:
+    def _args(self, d):
+        import resilient_run
+        return resilient_run.build_parser().parse_args(
+            ["--ckpt-dir", str(d)])
+
+    def test_empty_store_allows_fresh_start(self, tmp_path, capsys):
+        import resilient_run
+        resilient_run._refuse_unless_virgin_store(self._args(tmp_path))
+
+    def test_refused_or_quarantined_steps_block_fresh_start(self,
+                                                            tmp_path):
+        """A store whose steps were all refused (wrong config) or
+        quarantined (corruption) must NOT silently restart from genesis
+        and exit 0 — the refuse-loudly contract."""
+        import resilient_run
+        CheckpointManager(tmp_path, fingerprint={"cfg": "aa"}).save(4,
+                                                                    b"x")
+        with pytest.raises(SystemExit, match="refusing"):
+            resilient_run._refuse_unless_virgin_store(self._args(tmp_path))
+        CheckpointManager(tmp_path).quarantine(4, reason="test")
+        with pytest.raises(SystemExit, match="quarantined"):
+            resilient_run._refuse_unless_virgin_store(self._args(tmp_path))
+
+
+class TestEventBusAppendMode:
+    def test_append_continues_seq_past_previous_attempt(self, tmp_path):
+        from pos_evolution_tpu.telemetry import read_jsonl
+        from pos_evolution_tpu.telemetry.events import EventBus
+        p = str(tmp_path / "events.jsonl")
+        with EventBus(p) as bus:
+            bus.emit("slot", slot=1)
+            bus.emit("slot", slot=2)
+        with EventBus(p, append=True) as bus:
+            bus.emit("slot", slot=3)
+        events = read_jsonl(p)
+        assert [e["slot"] for e in events] == [1, 2, 3]
+        assert [e["seq"] for e in events] == [0, 1, 2]
+
+    def test_append_truncates_torn_tail_log_stays_readable(self, tmp_path):
+        """A writer killed mid-line leaves a torn tail; the resumed
+        attempt must TRUNCATE it (not newline-terminate it into fatal
+        mid-log corruption) so every later read_jsonl still works."""
+        from pos_evolution_tpu.telemetry import read_jsonl
+        from pos_evolution_tpu.telemetry.events import EventBus
+        p = str(tmp_path / "events.jsonl")
+        with EventBus(p) as bus:
+            bus.emit("slot", slot=1)
+        with open(p, "a") as fh:
+            fh.write('{"v": 1, "seq": 1, "type": "slot", "sl')  # killed
+        with EventBus(p, append=True) as bus:
+            bus.emit("slot", slot=9)
+        events = read_jsonl(p)  # must NOT raise mid-log corruption
+        assert [e.get("slot") for e in events] == [1, 9]
+
+
+# --- atomic snapshot writes (satellite 1) -------------------------------------
+
+
+class TestAtomicSnapshotWrites:
+    def test_atomic_write_bytes_no_partial_on_failure(self, tmp_path):
+        from pos_evolution_tpu.utils.snapshot import atomic_write_bytes
+        p = str(tmp_path / "blob.bin")
+        atomic_write_bytes(p, b"first")
+        assert open(p, "rb").read() == b"first"
+        atomic_write_bytes(p, b"second")
+        assert open(p, "rb").read() == b"second"
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+    @pytest.mark.usefixtures("minimal_cfg")
+    def test_save_simulation_path_is_atomic_and_loadable(self, tmp_path):
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.utils.snapshot import save_simulation
+        sim = Simulation(16)
+        sim.run_epochs(1)
+        p = str(tmp_path / "sim.ckpt")
+        data = save_simulation(sim, path=p)
+        assert open(p, "rb").read() == data
+        back = Simulation.resume(data)
+        assert state_digest(back) == state_digest(sim)
+
+    def test_save_dense_goes_through_atomic_path(self, tmp_path):
+        from pos_evolution_tpu.ops.epoch import densify
+        from pos_evolution_tpu.specs.genesis import make_genesis
+        from pos_evolution_tpu.utils.snapshot import load_dense, save_dense
+        with use_config(minimal_config()):
+            state, _ = make_genesis(16)
+            reg = densify(state)
+        p = str(tmp_path / "reg.npz")
+        save_dense(p, reg)
+        back = load_dense(p)
+        assert np.array_equal(np.asarray(reg.balance),
+                              np.asarray(back.balance))
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+# --- chaos bundle incremental flush (satellite 2) -----------------------------
+
+
+class TestChaosIncrementalBundles:
+    @pytest.mark.usefixtures("minimal_cfg")
+    def test_crashed_episode_leaves_replayable_bundle(self, tmp_path):
+        import chaos_fuzz
+        cfg = chaos_fuzz.episode_config(5, 0, 64, 16)
+        inflight = str(tmp_path / "inflight_ep0")
+
+        class _Die(Exception):
+            pass
+
+        # die deterministically mid-episode (the in-process stand-in
+        # for a preemption: run_episode never reaches its return)
+        from pos_evolution_tpu.sim import driver as drv
+        real_run_slot = drv.Simulation.run_slot
+
+        def dying_run_slot(self):
+            real_run_slot(self)
+            if self.slot >= 6:
+                raise _Die("preempted")
+        drv.Simulation.run_slot = dying_run_slot
+        try:
+            with pytest.raises(_Die):
+                chaos_fuzz.run_episode(cfg, bundle_dir=inflight)
+        finally:
+            drv.Simulation.run_slot = real_run_slot
+        # the incremental flush survived the death
+        for name in ("config.json", "checkpoint.bin", "events.jsonl"):
+            p = os.path.join(inflight, name)
+            assert os.path.exists(p) and os.path.getsize(p) > 0, name
+        # and the partial bundle replays to completion
+        out = chaos_fuzz.replay_bundle(inflight)
+        assert out["match"] is None  # no recorded verdict on a partial
+        assert out["replayed"] == []
