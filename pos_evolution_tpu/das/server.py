@@ -10,7 +10,10 @@ served block:
    verification cost scales with the grid, not the crowd;
 3. unique cells are answered from an **LRU proof-path cache** (hot cells
    of recent blocks stay resident; misses batch-build branches off one
-   shared leaf tree per blob);
+   shared leaf tree per blob, through a per-(block, blob) single-flight
+   so a new block's cache miss populates ONCE under concurrency — the
+   cache and the stampede suppression are shared with the socket-facing
+   serve tier, ``serve/server.py``);
 4. the coalesced batch runs the ``ExecutionBackend`` sample-verification
    kernel (``ops/das_verify.py``) once, and verdicts fan back out to
    clients by the coalescing inverse index.
@@ -28,6 +31,7 @@ served block, which ``scripts/run_report.py`` folds into its
 
 from __future__ import annotations
 
+import threading
 import time as _time
 from collections import OrderedDict
 
@@ -36,6 +40,7 @@ import numpy as np
 from pos_evolution_tpu.config import cfg
 from pos_evolution_tpu.das.commitment import CellCommitmentScheme
 from pos_evolution_tpu.ops.das_verify import DasSampleBatch, verify_das_samples
+from pos_evolution_tpu.utils.singleflight import SingleFlight
 
 __all__ = ["LRUCache", "DasServer"]
 
@@ -43,34 +48,63 @@ _MISS = object()
 
 
 class LRUCache:
-    """Minimal ordered-dict LRU with hit/miss counters (no extra deps)."""
+    """Minimal ordered-dict LRU with hit/miss counters (no extra deps).
+
+    Concurrency-safe: the serving tier (``serve/server.py``) hits one
+    shared cache from many worker threads, so every operation — lookup,
+    insert+evict, clear — is atomic under one lock. ``move_to_end`` on a
+    bare OrderedDict from two threads can corrupt the linked list; the
+    lock is not optional hardening.
+    """
 
     def __init__(self, capacity: int):
         self.capacity = int(capacity)
         self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 
     def get(self, key):
-        v = self._d.get(key, _MISS)
-        if v is _MISS:
-            self.misses += 1
-            return _MISS
-        self._d.move_to_end(key)
-        self.hits += 1
-        return v
+        with self._lock:
+            v = self._d.get(key, _MISS)
+            if v is _MISS:
+                self.misses += 1
+                return _MISS
+            self._d.move_to_end(key)
+            self.hits += 1
+            return v
 
     def put(self, key, value) -> None:
-        self._d[key] = value
-        self._d.move_to_end(key)
-        while len(self._d) > self.capacity:
-            self._d.popitem(last=False)
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+
+    def peek(self, key):
+        """Lookup WITHOUT touching counters or recency — for the
+        single-flight leader's double-check (its probes are bookkeeping,
+        not client traffic, and must not inflate the hit rate)."""
+        with self._lock:
+            return self._d.get(key, _MISS)
+
+    def clear(self) -> None:
+        """Drop every entry (counters survive — the chaos mode's
+        block-boundary cache wipe must stay visible in the hit rate)."""
+        with self._lock:
+            self._d.clear()
 
     def __len__(self) -> int:
-        return len(self._d)
+        with self._lock:
+            return len(self._d)
+
+    @property
+    def lookups(self) -> int:
+        return self.hits + self.misses
 
     @property
     def hit_rate(self) -> float:
+        # guarded: a freshly attached server reports 0.0, never ZeroDivision
         total = self.hits + self.misses
         return self.hits / total if total else 0.0
 
@@ -79,11 +113,23 @@ class DasServer:
     """Serves coalesced DAS samples (and cached best-updates) for one node."""
 
     def __init__(self, scheme: CellCommitmentScheme, registry=None,
-                 proof_cache: int = 4096, update_cache: int = 64):
+                 proof_cache: int | LRUCache = 4096, update_cache: int = 64):
         self.scheme = scheme
         self.registry = registry
-        self.proof_cache = LRUCache(proof_cache)
+        # an existing LRUCache instance is shared as-is: the serve tier
+        # (serve/server.py) and the in-process vectorized path warm the
+        # SAME proof cache, so a block served to sockets answers the
+        # sampling population from cache and vice versa
+        self.proof_cache = (proof_cache if isinstance(proof_cache, LRUCache)
+                            else LRUCache(proof_cache))
         self.update_cache = LRUCache(update_cache)
+        # stampede suppression: a new-block miss populates the proof
+        # cache ONCE per (block, blob) however many threads miss
+        # concurrently; scheme_builds counts actual backing builds (the
+        # regression contract of tests/test_serve.py)
+        self._flight = SingleFlight()
+        self.scheme_builds = 0
+        self._stats_lock = threading.Lock()
         self.served_blocks = 0
         self.samples_served = 0
 
@@ -111,6 +157,46 @@ class DasServer:
     def _count(self, name: str, help_: str, n: int = 1) -> None:
         if self.registry is not None:
             self.registry.counter(name, help_).inc(n)
+
+    def build_blob_proofs(self, block_root: bytes, blob: int,
+                          sidecar) -> dict[int, tuple]:
+        """All of one blob's (cell, branch) pairs, built at most once per
+        concurrent set of requesters (single-flight) and left in the
+        proof-path cache.
+
+        This is the new-block stampede path: before the single-flight,
+        every concurrent requester that missed the cache re-ran the
+        backing-scheme branch build for the same blob. The leader builds
+        the WHOLE grid's branches off one shared leaf tree (the same
+        amortized cost as building the missed subset, since the tree
+        dominates) and populates the cache; waiters block on the leader
+        and read its result. ``scheme_builds`` counts actual backing
+        builds — the regression contract: concurrent misses on a fresh
+        block bump it once per blob, not once per requester.
+        """
+        def _build() -> dict[int, tuple]:
+            grid = np.ascontiguousarray(sidecar.cells, dtype=np.uint8)
+            n = grid.shape[0]
+            # double-check under the flight: a caller whose miss was
+            # observed BEFORE an earlier flight finished lands here
+            # after it — the cache already holds every cell, so there
+            # is nothing left to build (this is what makes "one build
+            # per (block, blob)" exact, not just likely)
+            cached = {cell: self.proof_cache.peek((block_root, blob, cell))
+                      for cell in range(n)}
+            if all(v is not _MISS for v in cached.values()):
+                return cached
+            _leaves, built = self.scheme.branches(grid, list(range(n)))
+            with self._stats_lock:
+                self.scheme_builds += 1
+            out = {}
+            for cell in range(n):
+                pair = (grid[cell].copy(), built[cell].copy())
+                self.proof_cache.put((block_root, blob, cell), pair)
+                out[cell] = pair
+            return out
+
+        return self._flight.do(("blob_proofs", block_root, blob), _build)
 
     def serve_samples(self, block_root: bytes, sidecars: list,
                       population) -> dict:
@@ -148,18 +234,15 @@ class DasServer:
             else:
                 cells[j], branches[j] = hit
 
-        # phase 2: batch-build missing branches, one shared leaf tree per
-        # blob (a miss costs amortized O(log n_cells), not a tree rebuild)
+        # phase 2: batch-build missing branches through the per-(block,
+        # blob) single-flight — one shared leaf tree per blob, built ONCE
+        # even when many threads miss the same new block concurrently
         for blob, slots in miss_by_blob.items():
             t0 = _time.perf_counter()
-            grid = np.ascontiguousarray(sidecars[blob].cells, dtype=np.uint8)
-            want = [int(indices[j]) for j in slots]
-            _leaves, built = self.scheme.branches(grid, want)
-            for j, cell, branch in zip(slots, want, built):
-                cells[j] = grid[cell]
-                branches[j] = branch
-                self.proof_cache.put((bytes(block_root), blob, cell),
-                                     (grid[cell].copy(), branch.copy()))
+            built = self.build_blob_proofs(bytes(block_root), blob,
+                                           sidecars[blob])
+            for j in slots:
+                cells[j], branches[j] = built[int(indices[j])]
             per = (_time.perf_counter() - t0) / len(slots)
             for j in slots:
                 latency[j] += per
@@ -177,8 +260,9 @@ class DasServer:
         n_samples = int(flat.shape[0])
         failed = int((~result["ok"]).sum())
 
-        self.served_blocks += 1
-        self.samples_served += n_samples
+        with self._stats_lock:
+            self.served_blocks += 1
+            self.samples_served += n_samples
         cache_hits = u - sum(len(s) for s in miss_by_blob.values())
         self._count("das_samples_total",
                     "client cell samples served (pre-coalescing)", n_samples)

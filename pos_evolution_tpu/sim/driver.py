@@ -275,7 +275,8 @@ class Simulation:
                  genesis_time: int = 0, accelerated_forkchoice: bool = False,
                  telemetry=None, profile=None, adversaries=(), monitors=(),
                  das=None, prewarm: bool = False, compile_cache=None,
-                 variant=None, sharded=None, autocheckpoint=None):
+                 variant=None, sharded=None, autocheckpoint=None,
+                 serve=None):
         self.cfg = cfg()
         self.schedule = schedule or honest_schedule(n_validators)
         self.n_validators = n_validators
@@ -401,6 +402,20 @@ class Simulation:
         self.das_population = None
         self._das_group = 0
         self._das_window = 2
+        # Live serving tier (serve/, DESIGN.md §19): ``serve`` turns on
+        # per-slot publication of an immutable ``ServeView`` (head +
+        # finality scalars, the pre-serialized best light-client update,
+        # the DAS window's sidecars) into a ``ServingState`` that a
+        # socket-facing ``serve.ServeFront`` — live in this process, or
+        # replaying the recorded view history on a wall-clock schedule —
+        # reads atomically. Accepts True (fresh recording state) or an
+        # existing ``ServingState``. Not simulation state: checkpoints
+        # exclude it, a resumed run re-attaches.
+        self.serving_state = None
+        if serve:
+            from pos_evolution_tpu.serve import ServingState
+            self.serving_state = (serve if isinstance(serve, ServingState)
+                                  else ServingState(keep_history=True))
         # One PoW-chain view per Simulation (shared by its groups — the PoW
         # chain is objective): merge-transition state never leaks between
         # Simulation instances in the same process.
@@ -943,6 +958,7 @@ class Simulation:
         self._run_monitors(slot)
         self._serve_light_clients(slot)
         self._serve_das(slot)
+        self._publish_serve_view(slot)
         self.slot += 1
         if self.supervision is not None:
             # heartbeat -> integrity audit -> autocheckpoint, in that
@@ -1170,6 +1186,22 @@ class Simulation:
                                     engine=self.das.describe())
         return self.das_population
 
+    def _das_targets(self, group) -> list[tuple[bytes, object]]:
+        """The newest ``window`` canonical blob-carrying blocks from
+        ``group``'s head — the per-slot serving window shared by the
+        vectorized sampling round and the published ``ServeView``."""
+        from pos_evolution_tpu.das.containers import parse_das_graffiti
+        targets = []
+        root = self._get_head(group)
+        while len(targets) < self._das_window and root in group.store.blocks:
+            block = group.store.blocks[root]
+            if parse_das_graffiti(bytes(block.body.graffiti)) is not None:
+                targets.append((root, block))
+            if int(block.slot) == 0:
+                break
+            root = bytes(block.parent_root)
+        return targets
+
     def _serve_das(self, slot: int) -> None:
         """End-of-slot sampling round: the serving group's head block's
         sidecars are sampled by the whole population through the
@@ -1181,19 +1213,9 @@ class Simulation:
         group = self.groups[self._das_group]
         if group.crashed:
             return
-        # the newest ``window`` canonical blocks that carry blobs — the
-        # head freshly, its recent ancestors again (their cells answer
-        # from the proof-path LRU warmed by the previous slots)
-        targets = []
-        root = self._get_head(group)
-        while len(targets) < self._das_window and root in group.store.blocks:
-            block = group.store.blocks[root]
-            if parse_das_graffiti(bytes(block.body.graffiti)) is not None:
-                targets.append((root, block))
-            if int(block.slot) == 0:
-                break
-            root = bytes(block.parent_root)
-        for age, (root, block) in enumerate(targets):
+        # the head freshly, its recent ancestors again (their cells
+        # answer from the proof-path LRU warmed by the previous slots)
+        for age, (root, block) in enumerate(self._das_targets(group)):
             n_blobs = parse_das_graffiti(bytes(block.body.graffiti))[0]
             sidecars = (group.blob_store.sidecars_for_block(root)
                         if group.blob_store is not None else [])
@@ -1207,6 +1229,56 @@ class Simulation:
                 self.telemetry.bus.emit("das_serve", slot=slot, age=age,
                                         block_root=root.hex()[:16],
                                         **summary)
+
+    # -- live serving tier (serve/, DESIGN.md §19) -----------------------------
+
+    def _publish_serve_view(self, slot: int) -> None:
+        """End-of-slot view publication for the socket-facing serve tier:
+        one immutable snapshot of everything the RPC handlers answer from
+        (serve/state.py), swapped in atomically. A crashed serving group
+        freezes the view — the front keeps serving its last published
+        state, exactly like a real node that lost its beacon backend."""
+        if self.serving_state is None:
+            return
+        from pos_evolution_tpu.serve import ServeView
+        group = self.groups[self._das_group if self.das is not None
+                            else self._lc_group]
+        if group.crashed:
+            return
+        head = self._get_head(group)
+        store = group.store
+        update_ssz = update_root = None
+        if self.das_server is not None:
+            update = self.das_server.best_update(
+                store, head, archive=self.block_archive)
+        else:
+            from pos_evolution_tpu.lightclient import build_update
+            update = build_update(store, head, archive=self.block_archive)
+        if update is not None:
+            from pos_evolution_tpu.ssz import hash_tree_root as _htr
+            from pos_evolution_tpu.ssz import serialize as _ser
+            update_ssz = _ser(update)
+            update_root = bytes(_htr(update))
+        sidecars: dict[bytes, list] = {}
+        if self.das is not None:
+            for root, _block in self._das_targets(group):
+                cars = (group.blob_store.sidecars_for_block(root)
+                        if group.blob_store is not None else [])
+                if not cars:
+                    cars = self.blob_archive.get(root, [])
+                if cars:
+                    sidecars[root] = cars
+        self.serving_state.publish(ServeView(
+            slot=slot,
+            head_root=bytes(head),
+            head_slot=int(store.blocks[head].slot),
+            justified_epoch=int(store.justified_checkpoint.epoch),
+            justified_root=bytes(store.justified_checkpoint.root),
+            finalized_epoch=int(store.finalized_checkpoint.epoch),
+            finalized_root=bytes(store.finalized_checkpoint.root),
+            update_ssz=update_ssz, update_root=update_root,
+            sidecars=sidecars,
+            n_cells=2 * self.cfg.das_cells_per_blob))
 
     def flush_light_clients(self) -> None:
         """Serve one off-chain finality update for the serving group's

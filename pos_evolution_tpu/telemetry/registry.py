@@ -22,6 +22,7 @@ Design constraints, in order:
 from __future__ import annotations
 
 import json
+import threading
 from bisect import bisect_left
 
 
@@ -42,6 +43,13 @@ class _Metric:
         self.name = name
         self.help = help_
         self.series: dict[tuple, object] = {}
+        # updates are read-modify-writes: the serving tier
+        # (serve/server.py) increments one registry from N worker and
+        # reader threads, where an unlocked `get + set` silently drops
+        # counts — and the perf gate gates on those counts. One
+        # uncontended lock acquisition is ~100 ns; the driver hot loop
+        # doesn't notice.
+        self._lock = threading.Lock()
 
     def _prom_header(self) -> list[str]:
         out = []
@@ -66,7 +74,8 @@ class Counter(_Metric):
     def inc(self, amount: int | float = 1, **labels) -> None:
         assert amount >= 0, "counters only go up"
         key = _label_key(labels)
-        self.series[key] = self.series.get(key, 0) + amount
+        with self._lock:
+            self.series[key] = self.series.get(key, 0) + amount
 
     def value(self, **labels):
         return self.series.get(_label_key(labels), 0)
@@ -82,7 +91,8 @@ class Gauge(_Metric):
 
     def inc(self, amount: float = 1, **labels) -> None:
         key = _label_key(labels)
-        self.series[key] = self.series.get(key, 0) + amount
+        with self._lock:
+            self.series[key] = self.series.get(key, 0) + amount
 
     def value(self, **labels):
         return self.series.get(_label_key(labels), 0)
@@ -105,16 +115,17 @@ class Histogram(_Metric):
 
     def observe(self, value: float, **labels) -> None:
         key = _label_key(labels)
-        row = self.series.get(key)
-        if row is None:
-            row = {"bucket_counts": [0] * len(self.buckets),
-                   "sum": 0.0, "count": 0}
-            self.series[key] = row
-        i = bisect_left(self.buckets, value)
-        if i < len(self.buckets):
-            row["bucket_counts"][i] += 1
-        row["sum"] += value
-        row["count"] += 1
+        with self._lock:
+            row = self.series.get(key)
+            if row is None:
+                row = {"bucket_counts": [0] * len(self.buckets),
+                       "sum": 0.0, "count": 0}
+                self.series[key] = row
+            i = bisect_left(self.buckets, value)
+            if i < len(self.buckets):
+                row["bucket_counts"][i] += 1
+            row["sum"] += value
+            row["count"] += 1
 
     def value(self, **labels) -> dict | None:
         return self.series.get(_label_key(labels))
@@ -141,12 +152,17 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
 
     def _get(self, cls, name: str, help_: str, **kw):
-        m = self._metrics.get(name)
-        if m is None:
-            m = cls(name, help_, **kw)
-            self._metrics[name] = m
+        # get-or-create under the lock: two threads first touching the
+        # same metric name concurrently must share ONE object, or the
+        # loser's updates land on an orphan and vanish
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, **kw)
+                self._metrics[name] = m
         assert isinstance(m, cls), \
             f"metric {name!r} already registered as {m.kind}"
         return m

@@ -195,3 +195,15 @@ def trace_region(name: str):
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+def percentile_ms(xs, q: float) -> float:
+    """q-th percentile of a raw sample list in SECONDS, reported in
+    MILLISECONDS (NaN when empty) — the free-function twin of
+    ``HandlerTimer.percentile`` for consumers that hold their own
+    sample lists (the serving tier's latency reservoirs), so percentile
+    math isn't re-implemented with subtly different interpolation at
+    every call site."""
+    if not xs:
+        return float("nan")
+    return round(float(np.percentile(xs, q)) * 1e3, 4)

@@ -1,0 +1,72 @@
+"""Single-flight: concurrent cache misses for one key build ONCE.
+
+The stampede the serving tier must survive: a new block is published,
+every sampling client's next request misses the proof-path cache for the
+same (block, blob), and — without suppression — each concurrent requester
+re-runs the same backing-scheme branch build. ``SingleFlight.do`` lets
+the FIRST caller per key run the build while every concurrent caller
+blocks on the leader's result (value or exception, shared either way).
+
+The flight entry is removed once the leader finishes, so a LATER call
+with the same key builds again — single-flight is stampede suppression,
+not a cache; pair it with one (the leader's job is to populate it).
+
+Lives in ``utils/`` (not ``serve/``) on purpose: ``das/server.py`` needs
+it too, and ``serve/`` already imports from ``das/`` — this is the
+neutral ground that keeps the dependency one-directional.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["SingleFlight"]
+
+
+class _Flight:
+    __slots__ = ("done", "value", "error")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.value = None
+        self.error: BaseException | None = None
+
+
+class SingleFlight:
+    """Per-key call deduplication for concurrent builders."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights: dict = {}
+        # leaders actually ran the build; waits piggybacked on one
+        self.leads = 0
+        self.waits = 0
+
+    def do(self, key, fn):
+        """Run ``fn()`` once per concurrent set of callers of ``key``;
+        every caller gets the leader's result (or its exception)."""
+        with self._lock:
+            flight = self._flights.get(key)
+            if flight is None:
+                flight = _Flight()
+                self._flights[key] = flight
+                leader = True
+                self.leads += 1
+            else:
+                leader = False
+                self.waits += 1
+        if leader:
+            try:
+                flight.value = fn()
+            except BaseException as e:  # share failures too: every
+                flight.error = e        # waiter must see the same verdict
+                raise
+            finally:
+                flight.done.set()
+                with self._lock:
+                    self._flights.pop(key, None)
+        else:
+            flight.done.wait()
+            if flight.error is not None:
+                raise flight.error
+        return flight.value

@@ -20,6 +20,11 @@ Reconstructs, from the event log alone (no live ``Simulation``):
   coalesced unique fetches, per-request p50/p95 serving latency,
   proof-path cache hit rate and verification failures, aggregated from
   the per-block ``das_serve`` events (``das/server.py``);
+- **serving** — the live RPC tier's traffic story from ``serve_attach``
+  / ``serve_summary`` events (``pos_evolution_tpu/serve/``): per-tier
+  p50/p99/p999, goodput vs. shed rate with shed reasons, hedges and
+  retries, verified-proof counts, brownout/breaker transitions, chaos
+  injections, and the SLO verdict;
 - the **property audit** — the online monitor verdicts
   (``sim/monitors.py`` ``monitor`` events: accountable-safety /
   liveness / fork-choice-parity violations with slot, evidence size and
@@ -233,6 +238,46 @@ def build_report(events: list[dict], top_ops: dict | None = None,
             "scheme": (attach.get("engine") or {}).get("scheme"),
         }
 
+    # -- serving (serve/ RPC tier: serve_attach + serve_summary events) -------
+    serve_events = by_type.get("serve_summary", [])
+    serving = None
+    if serve_events:
+        last = serve_events[-1]
+        attach = (by_type.get("serve_attach") or [{}])[0]
+        server = last.get("server") or {}
+        load = last.get("load") or {}
+        chaos = last.get("chaos") or {}
+        serving = {
+            "workers": server.get("workers"),
+            "pattern": load.get("pattern"),
+            "arrivals": load.get("arrivals"),
+            "rate": load.get("rate"),
+            "wall_s": load.get("wall_s"),
+            "tiers": load.get("tiers"),
+            "requests_total": server.get("requests_total"),
+            "by_status": server.get("by_status"),
+            "shed_rate": server.get("shed_rate"),
+            "shed_by_reason": server.get("shed_by_reason"),
+            "hedges": load.get("hedges"),
+            "retries": load.get("retries"),
+            "verified_proofs": load.get("verified_proofs"),
+            "verify_failures": load.get("verify_failures"),
+            "brownout_transitions": server.get("brownout_transitions"),
+            "breaker_state": server.get("breaker_state"),
+            "breaker_transitions": server.get("breaker_transitions"),
+            "singleflight": server.get("singleflight"),
+            "scheme_builds": server.get("scheme_builds"),
+            "proof_cache": server.get("proof_cache"),
+            "slow_loris_closed": server.get("slow_loris_closed"),
+            "chaos_stalls": server.get("chaos_stalls"),
+            "chaos_injections": chaos.get("injections"),
+            "slo_ms": last.get("slo_ms"),
+            "slo_ok": last.get("slo_ok"),
+            "attach": {k: attach.get(k) for k in
+                       ("workers", "pattern", "arrivals", "rate", "chaos")
+                       if attach.get(k) is not None} or None,
+        }
+
     # -- resilience (resilience/ checkpoint + supervisor events) --------------
     ckpts = by_type.get("checkpoint_saved", [])
     interruptions = by_type.get("supervisor_interruption", [])
@@ -375,6 +420,8 @@ def build_report(events: list[dict], top_ops: dict | None = None,
     }
     if resilience:
         report["resilience"] = resilience
+    if serving:
+        report["serving"] = serving
     if merkleization:
         report["merkleization"] = merkleization
     if das_serving:
@@ -563,6 +610,45 @@ def to_markdown(report: dict) -> str:
         md += ["", *_md_table(
             ["counter", "total"],
             [[k, v] for k, v in merk["totals"].items()])]
+
+    if report.get("serving"):
+        s = report["serving"]
+        md += ["", "## Serving", ""]
+        md.append(f"- RPC front: **{s.get('workers')}** workers, "
+                  f"pattern **{s.get('pattern')}**, "
+                  f"{s.get('arrivals')} arrivals at {s.get('rate')}/s "
+                  f"over {s.get('wall_s')}s")
+        tiers = s.get("tiers") or {}
+        if tiers:
+            md += ["", *_md_table(
+                ["tier", "arrivals", "goodput %", "shed %",
+                 "p50 ms", "p99 ms", "p999 ms"],
+                [[name, row.get("arrivals"), row.get("goodput_pct"),
+                  row.get("shed_pct"), row.get("p50_ms"),
+                  row.get("p99_ms"), row.get("p999_ms")]
+                 for name, row in sorted(tiers.items())]), ""]
+        md.append(f"- honest rejections: shed rate "
+                  f"**{s.get('shed_rate')}** by reason "
+                  f"{s.get('shed_by_reason')}")
+        md.append(f"- hedged retries: {s.get('hedges')} hedges, "
+                  f"{s.get('retries')} retries")
+        md.append(f"- verified proofs: **{s.get('verified_proofs')}** "
+                  f"(failures: {s.get('verify_failures')})")
+        sf = s.get("singleflight") or {}
+        md.append(f"- stampede suppression: {s.get('scheme_builds')} "
+                  f"backing builds, {sf.get('waits', 0)} coalesced "
+                  f"waiters, proof cache {s.get('proof_cache')}")
+        md.append(f"- brownout transitions: "
+                  f"{s.get('brownout_transitions')}; circuit breaker: "
+                  f"{s.get('breaker_state')} "
+                  f"({s.get('breaker_transitions')} transitions)")
+        if s.get("chaos_injections"):
+            md.append(f"- chaos injections: {s['chaos_injections']} "
+                      f"(worker stalls served: {s.get('chaos_stalls')}, "
+                      f"slow-loris closed: {s.get('slow_loris_closed')})")
+        if s.get("slo_ms") is not None:
+            verdict = "**met**" if s.get("slo_ok") else "**MISSED**"
+            md.append(f"- interactive p99 SLO {s['slo_ms']} ms: {verdict}")
 
     if report.get("das_serving"):
         d = report["das_serving"]

@@ -1,0 +1,320 @@
+"""Serving-tier demo: real socket traffic against the hardened RPC front.
+
+The "heavy traffic from millions of users" story as an actual traffic
+story (ROADMAP item 3): a DAS-enabled simulation records per-slot
+``ServeView`` snapshots, then a multi-worker ``serve.ServeFront`` serves
+them over sockets while a seeded **open-loop** load generator drives
+head/finality/update + cell-sampling traffic at it, in two phases:
+
+1. **steady state** — uniform arrivals, no chaos: interactive p99 must
+   land inside the SLO;
+2. **chaos** — 10x burst windows, seeded worker stalls, proof-cache
+   wipes at block boundaries, a backing-store outage window, and a
+   slow-loris swarm: the tier must shed with honest rejections instead
+   of collapsing (interactive goodput > 95%), and **every proof served
+   must still verify** — zero correctness violations.
+
+Usage:
+    python scripts/serve_demo.py [--arrivals 100000] [--rate 6000]
+        [--workers 4] [--validators 32] [--epochs 2] [--slo-ms 50]
+        [--pattern hotspot] [--no-chaos] [--seed 7]
+        [--events events.jsonl] [--json bench_serve.json]
+        [--history bench_history.jsonl] [--record N]
+
+``--events`` records ``serve_attach``/``serve_summary`` for
+``scripts/run_report.py`` (the "Serving" section); ``--json`` writes a
+``bench_serve`` emission gated by
+``scripts/perf_gate.py --history --kind bench_serve``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pos_evolution_tpu.config import minimal_config, use_config  # noqa: E402
+
+
+def _replay(state, views, duration_s: float, stop: threading.Event) -> None:
+    """Publish recorded views evenly across the load window — every
+    publish is a block boundary (new cache keys, chaos wipe hook)."""
+    if not views:
+        return
+    gap = duration_s / len(views)
+    for view in views:
+        if stop.is_set():
+            return
+        state.publish(view)
+        stop.wait(gap)
+
+
+def _targets_fn(state):
+    def fn():
+        view = state.current()
+        if view is None:
+            return {"roots": [], "n_cells": 0, "n_blobs": {}}
+        return {"roots": [r.hex() for r in view.sidecars],
+                "n_cells": view.n_cells,
+                "n_blobs": {r.hex(): len(s)
+                            for r, s in view.sidecars.items()}}
+    return fn
+
+
+def _verify_update_fn():
+    from pos_evolution_tpu.lightclient.containers import LightClientUpdate
+    from pos_evolution_tpu.ssz import deserialize, hash_tree_root
+
+    def verify(result: dict) -> bool:
+        if result.get("update") is None:
+            return True  # "no update yet" is honest, not a violation
+        data = bytes.fromhex(result["update"])
+        obj = deserialize(data, LightClientUpdate)
+        return bytes(hash_tree_root(obj)).hex() == result["update_root"]
+    return verify
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--arrivals", type=int, default=100_000,
+                    help="total client arrivals across both phases")
+    ap.add_argument("--rate", type=float, default=6000.0,
+                    help="mean arrival rate per second")
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--validators", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--pattern", default="hotspot",
+                    choices=("uniform", "diurnal", "bursty", "hotspot"),
+                    help="chaos-phase arrival pattern")
+    ap.add_argument("--slo-ms", type=float, default=50.0,
+                    help="steady-state interactive p99 SLO")
+    ap.add_argument("--no-chaos", action="store_true")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--events", help="telemetry JSONL output path")
+    ap.add_argument("--json", help="write the bench_serve emission here")
+    ap.add_argument("--history",
+                    help="append the emission to this bench_history.jsonl")
+    ap.add_argument("--record", type=int, default=None,
+                    help="also write SERVE_DEMO_r{N}.json at the repo root")
+    args = ap.parse_args(argv)
+
+    with use_config(minimal_config()):
+        from pos_evolution_tpu.serve import (
+            LoadGenerator,
+            ServeChaos,
+            ServeFront,
+            ServingState,
+            SlowLorisSwarm,
+        )
+        from pos_evolution_tpu.sim import Simulation
+        from pos_evolution_tpu.telemetry import Telemetry
+        telemetry = (Telemetry.to_file(args.events) if args.events
+                     else Telemetry())
+
+        print(f"== serving demo: {args.arrivals} arrivals @ "
+              f"{args.rate:.0f}/s, {args.workers} workers, "
+              f"chaos={'off' if args.no_chaos else 'on'} ==")
+        # 1. record the chain + per-slot serving views
+        sim = Simulation(args.validators, das=True, serve=True,
+                         telemetry=telemetry)
+        sim.run_epochs(args.epochs)
+        views = sim.serving_state.views
+        assert views, "the simulation never published a serving view"
+        print(f"recorded {len(views)} serving views "
+              f"({sum(len(v.sidecars) for v in views)} windowed blob "
+              f"blocks)")
+
+        # 2. the live front over a replayed view stream
+        state = ServingState()
+        state.publish(views[0])
+        chaos = None if args.no_chaos else ServeChaos(
+            args.seed, stall_prob=0.0, stall_s=0.08, wipe_prob=0.5)
+        # read timeout below the loris dribble interval: a connection
+        # stalled MID-frame longer than this is closed (real requests
+        # arrive in one sendall; only an attacker dribbles)
+        front = ServeFront(state, scheme=sim.das.scheme,
+                           registry=telemetry.registry, workers=args.workers,
+                           read_timeout_s=0.4, chaos=chaos)
+        addr = front.start()
+        n_steady = args.arrivals // 2
+        n_chaos = args.arrivals - n_steady
+        steady_dur = n_steady / args.rate
+        chaos_dur = n_chaos / args.rate
+        telemetry.bus.emit(
+            "serve_attach", workers=args.workers, pattern=args.pattern,
+            arrivals=args.arrivals, rate=args.rate,
+            chaos=(None if args.no_chaos else
+                   {"seed": args.seed, "stall_s": 0.08, "wipe_prob": 0.5,
+                    "bursts": 2, "slow_loris": 8}))
+
+        # warmup: a short ping/head burst before the SLO phase — the
+        # SLO is a STEADY-STATE contract, and the first packets pay
+        # one-time costs (connection setup, code-path warmth) that say
+        # nothing about serving capacity
+        from pos_evolution_tpu.serve import ServeClient
+        warm = ServeClient(addr, connections=4)
+        for _ in range(50):
+            warm.request("head", deadline_s=1.0, tier=0)
+        warm.close()
+
+        # 3. phase 1: steady state (SLO phase)
+        mid = max(len(views) // 2, 1)
+        stop = threading.Event()
+        replayer = threading.Thread(
+            target=_replay, args=(state, views[1:mid], steady_dur, stop),
+            daemon=True)
+        replayer.start()
+        steady = LoadGenerator(
+            addr, n_steady, args.rate, pattern="uniform",
+            seed=args.seed, targets_fn=_targets_fn(state),
+            verify_update=_verify_update_fn()).run()
+        stop.set()  # the load is done: no stale steady-phase publishes
+        replayer.join(timeout=5.0)
+        s_int = steady["tiers"]["interactive"]
+        print(f"steady: interactive p50 {s_int['p50_ms']} ms / "
+              f"p99 {s_int['p99_ms']} ms / p999 {s_int['p999_ms']} ms, "
+              f"goodput {s_int['goodput_pct']}%")
+
+        # 4. phase 2: chaos (burst + stalls + wipes + outage + loris)
+        loris = None
+        burst_windows = ()
+        if chaos is not None:
+            burst_windows = chaos.burst_windows(chaos_dur, n_bursts=2,
+                                                mult=10.0,
+                                                width_frac=0.05)
+        chaos_gen = LoadGenerator(
+            addr, n_chaos, args.rate, pattern=args.pattern,
+            seed=args.seed + 1, burst_windows=burst_windows,
+            targets_fn=_targets_fn(state),
+            verify_update=_verify_update_fn())
+        # 10x bursts COMPRESS the realized schedule (the same n arrives
+        # sooner), so injections are armed against the actual span of
+        # the generated arrivals, not the nominal duration — chaos that
+        # fires after the last arrival tests nothing
+        span = float(chaos_gen.offsets[-1])
+        if chaos is not None:
+            # two seeded worker-stall windows inside the active span —
+            # each freezes one of the workers for half a second
+            chaos.arm_stalls(time.monotonic(), span * 0.8, n_stalls=2,
+                             stall_s=0.5, workers=args.workers)
+            loris = SlowLorisSwarm(addr, n=8, dribble_s=0.6)
+            loris.start()
+            # backing outage in the middle of the chaos window
+            threading.Timer(span * 0.4,
+                            chaos.fail_backing_for, (0.4,)).start()
+        stop = threading.Event()
+        replayer = threading.Thread(
+            target=_replay, args=(state, views[mid:], span, stop),
+            daemon=True)
+        replayer.start()
+        chaos_load = chaos_gen.run()
+        stop.set()
+        replayer.join(timeout=5.0)
+        if loris is not None:
+            loris.stop()
+        c_int = chaos_load["tiers"]["interactive"]
+        c_blk = chaos_load["tiers"]["bulk"]
+        print(f"chaos:  interactive p50 {c_int['p50_ms']} ms / "
+              f"p99 {c_int['p99_ms']} ms, goodput {c_int['goodput_pct']}%"
+              f" | bulk goodput {c_blk['goodput_pct']}%, "
+              f"shed {c_blk['shed_pct']}%")
+
+        server_summary = front.summary()
+        front.stop()
+
+        # 5. the acceptance contract
+        slo_ok = (s_int["p99_ms"] or 0) <= args.slo_ms
+        verified = steady["verified_proofs"] + chaos_load["verified_proofs"]
+        failures = (steady["verify_failures"]
+                    + chaos_load["verify_failures"])
+        int_goodput = c_int["goodput_pct"] or 0.0
+        honest_rejects = (server_summary["by_status"].get("shed", 0)
+                          + server_summary["by_status"].get("unavailable",
+                                                            0)
+                          + server_summary["by_status"].get("timeout", 0))
+        print(f"verified proofs: {verified} (failures: {failures}); "
+              f"honest rejections: {honest_rejects} "
+              f"(shed/unavailable/timeout); hedges: "
+              f"{steady['hedges'] + chaos_load['hedges']}")
+        print(f"SLO (steady interactive p99 <= {args.slo_ms} ms): "
+              f"{'MET' if slo_ok else 'MISSED'}; chaos interactive "
+              f"goodput {int_goodput}%")
+        assert failures == 0, \
+            "a served proof failed verification — correctness violation"
+        assert slo_ok, "steady-state p99 blew the SLO"
+        assert int_goodput > 95.0, \
+            "interactive goodput collapsed under chaos"
+
+        load_combined = dict(chaos_load)
+        load_combined["arrivals"] = (steady["arrivals"]
+                                     + chaos_load["arrivals"])
+        load_combined["verified_proofs"] = verified
+        load_combined["verify_failures"] = failures
+        load_combined["hedges"] = steady["hedges"] + chaos_load["hedges"]
+        load_combined["retries"] = (steady["retries"]
+                                    + chaos_load["retries"])
+        load_combined["wall_s"] = round(steady["wall_s"]
+                                        + chaos_load["wall_s"], 3)
+        telemetry.bus.emit(
+            "serve_summary", server=server_summary, load=load_combined,
+            chaos=(chaos.summary() if chaos is not None else None),
+            steady=steady, slo_ms=args.slo_ms, slo_ok=slo_ok)
+
+        emission = {
+            "metric": "bench_serve",
+            "arrivals": args.arrivals,
+            "rate": args.rate,
+            "workers": args.workers,
+            "pattern": args.pattern,
+            "chaos": not args.no_chaos,
+            "slo_ms": args.slo_ms,
+            "slo_ok": slo_ok,
+            "serving": {
+                "steady": {k: s_int[k] for k in
+                           ("p50_ms", "p99_ms", "p999_ms",
+                            "goodput_pct")},
+                "chaos_interactive": {k: c_int[k] for k in
+                                      ("p50_ms", "p99_ms",
+                                       "goodput_pct")},
+                "chaos_bulk": {"goodput_pct": c_blk["goodput_pct"],
+                               "shed_pct": c_blk["shed_pct"]},
+                "shed_rate": server_summary["shed_rate"],
+                "verified_proofs": verified,
+                "verify_failures": failures,
+                "scheme_builds": server_summary["scheme_builds"],
+                "singleflight_waits":
+                    server_summary["singleflight"]["waits"],
+            },
+            "telemetry": {"counts": telemetry.registry.counts()},
+        }
+        if args.json:
+            with open(args.json, "w") as fh:
+                json.dump(emission, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            print(f"emission -> {args.json}")
+        if args.record is not None:
+            path = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                f"SERVE_DEMO_r{args.record:02d}.json")
+            with open(path, "w") as fh:
+                json.dump(emission, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            print(f"record   -> {path}")
+        if args.history:
+            from pos_evolution_tpu.profiling import history
+            history.append_entry(args.history, emission, kind="bench_serve")
+            print(f"history  -> {args.history} (kind=bench_serve)")
+        if args.events:
+            telemetry.close()
+            print(f"events   -> {args.events}\n  next: "
+                  f"python scripts/run_report.py {args.events}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

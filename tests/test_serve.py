@@ -1,0 +1,923 @@
+"""Serving-tier tests (pos_evolution_tpu/serve/, DESIGN.md §19).
+
+Covers, roughly inside-out:
+
+- the wire protocol (framing, oversize/garbage refusal);
+- single-flight stampede suppression, including the ``DasServer``
+  proof-path regression: concurrent misses on a new block run the
+  backing-scheme branch build ONCE per (block, blob), not once per
+  requester;
+- admission control (deadline-derived shedding with honest retry-after),
+  brownout hysteresis, and the circuit breaker — all on fake clocks;
+- the hardened ``LRUCache`` under thread hammering;
+- the client library's hedge / retry-after / deadline machinery against
+  a deliberately stalling fake server;
+- the socket front end-to-end: correct proofs under concurrency, honest
+  rejections, deadline propagation, chaos (stalls, wipes, backing
+  outage, slow-loris), and the run report's "Serving" section.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import minimal_config, use_config
+
+
+# --- protocol -----------------------------------------------------------------
+
+class TestProtocol:
+    def test_round_trip_and_pipelining(self):
+        from pos_evolution_tpu.serve.protocol import recv_frame, send_frame
+        a, b = socket.socketpair()
+        try:
+            send_frame(a, {"id": 1, "method": "ping"})
+            send_frame(a, {"id": 2, "params": {"x": [1, 2]}})
+            assert recv_frame(b) == {"id": 1, "method": "ping"}
+            assert recv_frame(b) == {"id": 2, "params": {"x": [1, 2]}}
+            a.close()
+            assert recv_frame(b) is None  # clean EOF
+        finally:
+            b.close()
+
+    def test_oversize_and_garbage_refused(self):
+        from pos_evolution_tpu.serve.protocol import (
+            MAX_FRAME_BYTES,
+            ProtocolError,
+            recv_frame,
+        )
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">I", MAX_FRAME_BYTES + 1))
+            with pytest.raises(ProtocolError):
+                recv_frame(b)
+        finally:
+            a.close(), b.close()
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">I", 3) + b"{{{")
+            with pytest.raises(ProtocolError):
+                recv_frame(b)
+        finally:
+            a.close(), b.close()
+
+
+# --- single-flight ------------------------------------------------------------
+
+class TestSingleFlight:
+    def test_concurrent_callers_build_once(self):
+        from pos_evolution_tpu.utils.singleflight import SingleFlight
+        sf = SingleFlight()
+        builds, results = [], []
+        gate = threading.Event()
+
+        def build():
+            gate.wait(2.0)
+            builds.append(1)
+            return 42
+
+        threads = [threading.Thread(
+            target=lambda: results.append(sf.do("k", build)))
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)  # let every caller join the flight
+        gate.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert builds == [1]
+        assert results == [42] * 8
+        assert sf.leads == 1 and sf.waits == 7
+
+    def test_exception_shared_and_flight_cleared(self):
+        from pos_evolution_tpu.utils.singleflight import SingleFlight
+        sf = SingleFlight()
+        with pytest.raises(ValueError):
+            sf.do("k", lambda: (_ for _ in ()).throw(ValueError("boom")))
+        # the failed flight is gone: a later call builds fresh
+        assert sf.do("k", lambda: 7) == 7
+
+
+# --- admission / brownout / breaker -------------------------------------------
+
+class TestAdmission:
+    def _queue(self, ema_s: float, workers: int = 2, **kw):
+        from pos_evolution_tpu.serve.admission import (
+            AdmissionQueue,
+            ServiceEstimator,
+        )
+        est = ServiceEstimator(initial_s=ema_s, alpha=0.5)
+        return AdmissionQueue(workers, estimator=est, **kw)
+
+    def test_admits_and_priority_order(self):
+        q = self._queue(0.001)
+        assert q.offer("bulk1", 1, budget_s=1.0) is None
+        assert q.offer("int1", 0, budget_s=1.0) is None
+        assert q.offer("bulk2", 1, budget_s=1.0) is None
+        # interactive pops strictly first, then bulk FIFO
+        assert q.take(0.1) == "int1"
+        assert q.take(0.1) == "bulk1"
+        assert q.take(0.1) == "bulk2"
+
+    def test_deadline_derived_shed_with_honest_retry_after(self):
+        # EMA 50ms, 1 worker: 3 queued bulk items project 150ms of wait
+        q = self._queue(0.05, workers=1)
+        for i in range(3):
+            assert q.offer(i, 1, budget_s=10.0) is None
+        verdict = q.offer("late", 1, budget_s=0.1)  # 100ms budget < 150ms
+        assert verdict is not None and verdict["reason"] == "deadline"
+        assert verdict["retry_after_ms"] >= 100.0  # the projected wait
+        assert q.shed["deadline"] == 1
+        # a patient request (10s budget) still gets in
+        assert q.offer("patient", 1, budget_s=10.0) is None
+
+    def test_depth_cap_and_brownout_shed(self):
+        q = self._queue(0.0001, max_depth=2)
+        assert q.offer("a", 1, budget_s=5.0) is None
+        assert q.offer("b", 1, budget_s=5.0) is None
+        assert q.offer("c", 1, budget_s=5.0)["reason"] == "depth"
+        # brownout sheds BULK outright but interactive still enters
+        assert q.offer("d", 1, budget_s=5.0,
+                       brownout=True)["reason"] == "brownout"
+        assert q.offer("i", 0, budget_s=5.0, brownout=True) is None
+
+    def test_bulk_waits_behind_interactive(self):
+        q = self._queue(0.01, workers=1)
+        for i in range(4):
+            q.offer(f"i{i}", 0, budget_s=10.0)
+        # bulk's projected wait includes the interactive backlog
+        assert q.projected_wait_s(1) == pytest.approx(0.04)
+        assert q.projected_wait_s(0) == pytest.approx(0.04)
+
+
+class TestBrownout:
+    def test_hysteresis(self):
+        from pos_evolution_tpu.serve.admission import BrownoutController
+        clock = [0.0]
+        b = BrownoutController(enter_wait_s=0.1, exit_wait_s=0.02,
+                               exit_streak=3, clock=lambda: clock[0])
+        assert not b.observe_interactive_wait(0.05)
+        assert b.observe_interactive_wait(0.2)      # enter
+        assert b.observe_interactive_wait(0.01)     # calm 1
+        assert b.observe_interactive_wait(0.05)     # not calm -> reset
+        for _ in range(2):
+            assert b.observe_interactive_wait(0.01)
+        assert not b.observe_interactive_wait(0.01)  # calm 3 -> exit
+        assert [t["state"] for t in b.transitions] == ["brownout",
+                                                       "normal"]
+
+
+class TestCircuitBreaker:
+    def test_abandoned_probe_frees_the_slot(self):
+        # a probe whose deadline expires mid-handler reaches no verdict;
+        # without abandon() the breaker would wedge half-open forever
+        from pos_evolution_tpu.serve.admission import CircuitBreaker
+        clock = [0.0]
+        cb = CircuitBreaker(failure_threshold=1, cooldown_s=1.0,
+                            clock=lambda: clock[0])
+        cb.record_failure()
+        clock[0] = 2.0
+        assert cb.allow()[0]        # the half-open probe slot
+        assert not cb.allow()[0]    # held
+        cb.abandon()                # probe expired without a verdict
+        assert cb.allow()[0]        # the NEXT caller can probe
+        cb.record_success()
+        assert cb.state == cb.CLOSED
+
+    def test_trip_halfopen_probe(self):
+        from pos_evolution_tpu.serve.admission import CircuitBreaker
+        clock = [0.0]
+        cb = CircuitBreaker(failure_threshold=3, cooldown_s=1.0,
+                            clock=lambda: clock[0])
+        for _ in range(3):
+            assert cb.allow()[0]
+            cb.record_failure()
+        assert cb.state == cb.OPEN
+        ok, retry = cb.allow()
+        assert not ok and retry == pytest.approx(1.0)
+        clock[0] = 1.5  # cooldown over -> half-open, ONE probe slot
+        ok1, _ = cb.allow()
+        ok2, _ = cb.allow()
+        assert ok1 and not ok2
+        cb.record_failure()  # probe fails -> reopen
+        assert cb.state == cb.OPEN
+        clock[0] = 3.0
+        assert cb.allow()[0]
+        cb.record_success()  # probe succeeds -> closed
+        assert cb.state == cb.CLOSED
+
+
+# --- hardened LRU -------------------------------------------------------------
+
+class TestLRUCacheConcurrency:
+    def test_hit_rate_guarded_before_any_lookup(self):
+        from pos_evolution_tpu.das import LRUCache
+        assert LRUCache(4).hit_rate == 0.0
+
+    def test_thread_hammer_keeps_invariants(self):
+        from pos_evolution_tpu.das import LRUCache
+        from pos_evolution_tpu.das.server import _MISS
+        lru = LRUCache(32)
+        errors = []
+
+        def hammer(tid):
+            try:
+                for i in range(2000):
+                    k = (tid * 7 + i) % 64
+                    if lru.get(k) is _MISS:
+                        lru.put(k, k)
+                    if i % 500 == 499:
+                        lru.clear()
+            except Exception as e:  # corruption surfaces as exceptions
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errors
+        assert len(lru) <= 32
+        assert lru.hits + lru.misses == lru.lookups == 8 * 2000
+
+
+# --- DasServer proof-path single-flight (the stampede regression) -------------
+
+class _CountingScheme:
+    """Wraps a scheme, counting backing branch builds."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.branch_calls = 0
+        self._lock = threading.Lock()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def branches(self, cells, indices):
+        with self._lock:
+            self.branch_calls += 1
+        return self._inner.branches(cells, indices)
+
+
+class TestServeSamplesSingleFlight:
+    def test_new_block_miss_populates_once_under_concurrency(self):
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.das import (
+                BlobEngine,
+                DasServer,
+                SamplingClientPopulation,
+            )
+            eng = BlobEngine(seed=4)
+            grids, coms, _ = eng.build_for(2, b"\x07" * 32)
+
+            class _Sidecar:
+                def __init__(self, cells, commitment):
+                    self.cells, self.commitment = cells, commitment
+
+            sidecars = [_Sidecar(g, c) for g, c in zip(grids, coms)]
+            scheme = _CountingScheme(eng.scheme)
+            server = DasServer(scheme, registry=None)
+            n_threads = 8
+            pops = [SamplingClientPopulation(400, samples_per_client=4,
+                                             seed=s)
+                    for s in range(n_threads)]
+            gate = threading.Event()
+            summaries, errors = [], []
+
+            def serve(pop):
+                gate.wait(5.0)
+                try:
+                    # cfg() is thread-local: each serving thread enters
+                    # the same config the sidecars were built under
+                    with use_config(minimal_config()):
+                        summaries.append(server.serve_samples(
+                            b"\x09" * 32, sidecars, pop))
+                except Exception as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(target=serve, args=(p,))
+                       for p in pops]
+            for t in threads:
+                t.start()
+            gate.set()
+            for t in threads:
+                t.join(timeout=60.0)
+            assert not errors
+            assert len(summaries) == n_threads
+            # THE regression contract: one backing build per (block,
+            # blob), however many threads missed concurrently
+            assert server.scheme_builds == len(sidecars)
+            assert scheme.branch_calls == len(sidecars)
+            assert all(s["failed"] == 0 for s in summaries)
+            # a later serve of the same block is all cache hits
+            s2 = server.serve_samples(b"\x09" * 32, sidecars, pops[0])
+            assert s2["cache_misses"] == 0
+            assert server.scheme_builds == len(sidecars)
+
+
+# --- client vs a deliberately stalling fake server ----------------------------
+
+class _FakeServer:
+    """Protocol-speaking server with a scripted per-request behavior
+    queue: "ok", "stall" (never answer), ("slow", s), ("shed", ms)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self._lock = threading.Lock()
+        self.seen = 0
+        self.request_conns: list[int] = []  # id(sock) per request seen
+        self.lst = socket.socket()
+        self.lst.bind(("127.0.0.1", 0))
+        self.lst.listen(16)
+        self.addr = self.lst.getsockname()
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while not self._stop.is_set():
+            try:
+                sock, _ = self.lst.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(sock,),
+                             daemon=True).start()
+
+    def _serve(self, sock):
+        from pos_evolution_tpu.serve.protocol import recv_frame, send_frame
+        while not self._stop.is_set():
+            try:
+                req = recv_frame(sock)
+            except Exception:
+                return
+            if req is None:
+                return
+            with self._lock:
+                self.seen += 1
+                self.request_conns.append(id(sock))
+                step = (self.script.pop(0) if self.script else "ok")
+            if step == "stall":
+                continue  # never answer THIS request
+            if isinstance(step, tuple) and step[0] == "slow":
+                time.sleep(step[1])
+                step = "ok"
+            if isinstance(step, tuple) and step[0] == "shed":
+                send_frame(sock, {"id": req["id"], "status": "shed",
+                                  "reason": "depth",
+                                  "retry_after_ms": step[1]})
+                continue
+            send_frame(sock, {"id": req["id"], "status": "ok",
+                              "result": {"pong": True}})
+
+    def close(self):
+        self._stop.set()
+        self.lst.close()
+
+
+class TestClientRetryHedgeDeadline:
+    def test_hedge_rescues_a_stalled_worker(self):
+        from pos_evolution_tpu.serve import ServeClient
+        srv = _FakeServer(["stall", "ok"])
+        try:
+            cli = ServeClient(srv.addr, connections=2, hedge_ms=30.0)
+            res = cli.request("ping", deadline_s=2.0, tier=0)
+            assert res.ok and res.result == {"pong": True}
+            assert res.hedges == 1  # the duplicate won
+            # ...and it went down a DIFFERENT connection than the
+            # primary: a same-socket duplicate would inherit the stall
+            assert len(set(srv.request_conns)) == 2
+            cli.close()
+        finally:
+            srv.close()
+
+    def test_retry_after_path_after_a_shed(self):
+        from pos_evolution_tpu.serve import ServeClient
+        srv = _FakeServer([("shed", 40.0), "ok"])
+        try:
+            cli = ServeClient(srv.addr, connections=1, hedge_ms=None)
+            t0 = time.monotonic()
+            res = cli.request("ping", deadline_s=2.0, tier=1)
+            elapsed = time.monotonic() - t0
+            assert res.ok and res.retries >= 1
+            assert elapsed >= 0.04  # honored the server's retry-after
+            cli.close()
+        finally:
+            srv.close()
+
+    def test_shed_beyond_budget_returns_honestly(self):
+        from pos_evolution_tpu.serve import ServeClient
+        srv = _FakeServer([("shed", 5000.0)])
+        try:
+            cli = ServeClient(srv.addr, connections=1, hedge_ms=None)
+            res = cli.request("ping", deadline_s=0.3, tier=1)
+            # retry-after exceeds the budget: the client gives up NOW
+            # with the server's verdict instead of sleeping past its own
+            # deadline
+            assert res.status == "shed" and res.reason == "depth"
+            cli.close()
+        finally:
+            srv.close()
+
+    def test_deadline_bounds_a_fully_stalled_server(self):
+        from pos_evolution_tpu.serve import ServeClient
+        srv = _FakeServer(["stall"] * 20)
+        try:
+            cli = ServeClient(srv.addr, connections=2, hedge_ms=50.0,
+                              max_retries=1)
+            t0 = time.monotonic()
+            res = cli.request("ping", deadline_s=0.4, tier=0)
+            elapsed = time.monotonic() - t0
+            assert res.status == "timeout"
+            assert elapsed < 2.0  # bounded by the budget, not by hope
+            cli.close()
+        finally:
+            srv.close()
+
+
+# --- the socket front end-to-end ----------------------------------------------
+
+def _synthetic_view():
+    from pos_evolution_tpu.config import cfg
+    from pos_evolution_tpu.das import BlobEngine
+    from pos_evolution_tpu.serve import ServeView
+    eng = BlobEngine(seed=4)
+    grids, coms, _ = eng.build_for(2, b"\x07" * 32)
+
+    class _Sidecar:
+        def __init__(self, cells, commitment):
+            self.cells, self.commitment = cells, commitment
+
+    root = b"\x07" * 32
+    view = ServeView(
+        slot=2, head_root=root, head_slot=2,
+        justified_epoch=0, justified_root=b"\x00" * 32,
+        finalized_epoch=0, finalized_root=b"\x00" * 32,
+        update_ssz=b"\x01\x02", update_root=b"\x03" * 32,
+        sidecars={root: [_Sidecar(g, c) for g, c in zip(grids, coms)]},
+        n_cells=2 * cfg().das_cells_per_blob)
+    return eng, root, view
+
+
+class TestServeFrontE2E:
+    def _front(self, **kw):
+        from pos_evolution_tpu.serve import ServeFront, ServingState
+        from pos_evolution_tpu.telemetry.registry import MetricsRegistry
+        eng, root, view = _synthetic_view()
+        state = ServingState()
+        state.publish(view)
+        front = ServeFront(state, scheme=eng.scheme,
+                           registry=MetricsRegistry(), **kw)
+        addr = front.start()
+        return front, addr, root, state, view
+
+    def test_served_cells_verify_and_errors_are_honest(self):
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.serve import ServeClient
+            from pos_evolution_tpu.serve.loadgen import LoadGenerator
+            front, addr, root, _state, view = self._front(workers=2)
+            try:
+                cli = ServeClient(addr, connections=2)
+                res = cli.request("das_cells", {
+                    "block_root": root.hex(),
+                    "samples": [[0, 1], [1, 3], [0, 1], [1, 15]]},
+                    deadline_s=2.0)
+                assert res.ok
+                lg = LoadGenerator.__new__(LoadGenerator)
+                assert lg._verify_bulk(res.result)
+                # unknown method and unknown block are honest errors
+                assert cli.request("nope", deadline_s=1.0).status == \
+                    "error"
+                bad = cli.request("das_cells", {
+                    "block_root": "ab" * 32, "samples": [[0, 0]]},
+                    deadline_s=1.0)
+                assert bad.status == "error"
+                assert "not in the serving window" in bad.error
+                # out-of-range sample is refused, not crashed into
+                oob = cli.request("das_cells", {
+                    "block_root": root.hex(),
+                    "samples": [[0, 9999]]}, deadline_s=1.0)
+                assert oob.status == "error"
+                cli.close()
+            finally:
+                front.stop()
+
+    def test_expired_deadline_is_refused_before_work(self):
+        # raw protocol (the client library would refuse to even send an
+        # expired request): deadline_ms=0 means expired AT arrival by
+        # construction — the worker must answer an honest timeout
+        # without ever touching the backing store
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.serve.protocol import (
+                recv_frame,
+                send_frame,
+            )
+            front, addr, root, _state, _view = self._front(workers=1)
+            try:
+                sock = socket.create_connection(addr, timeout=5.0)
+                send_frame(sock, {"id": 1, "method": "das_cells",
+                                  "params": {"block_root": root.hex(),
+                                             "samples": [[0, 0]]},
+                                  "deadline_ms": 0.0})
+                resp = recv_frame(sock)
+                assert resp["status"] == "timeout"
+                assert front.summary()["by_status"].get("timeout") == 1
+                assert front.das.scheme_builds == 0  # no work was done
+                sock.close()
+            finally:
+                front.stop()
+
+    def test_hostile_frames_neither_kill_the_reader_nor_trip_breaker(self):
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.serve.protocol import (
+                recv_frame,
+                send_frame,
+            )
+            front, addr, root, _state, _view = self._front(workers=1)
+            try:
+                sock = socket.create_connection(addr, timeout=5.0)
+                # non-numeric deadline falls back to the default budget
+                send_frame(sock, {"id": 1, "method": "head",
+                                  "deadline_ms": None})
+                assert recv_frame(sock)["status"] == "ok"
+                # unhashable method is an honest error, not a dead reader
+                send_frame(sock, {"id": 2, "method": []})
+                assert recv_frame(sock)["status"] == "error"
+                # client-side garbage params must NOT count against the
+                # backing store: breaker stays closed past its threshold
+                for i in range(front.breaker.failure_threshold + 2):
+                    send_frame(sock, {"id": 10 + i,
+                                      "method": "das_cells",
+                                      "params": {"block_root": "zz",
+                                                 "samples": [[0, 0]]}})
+                    assert recv_frame(sock)["status"] == "error"
+                assert front.breaker.state == front.breaker.CLOSED
+                # an oversize sample list is an honest refusal (the
+                # response would outgrow the frame cap), never a dead
+                # worker
+                from pos_evolution_tpu.serve.server import (
+                    MAX_SAMPLES_PER_REQUEST,
+                )
+                send_frame(sock, {"id": 50, "method": "das_cells",
+                                  "params": {
+                                      "block_root": root.hex(),
+                                      "samples": [[0, 0]] * (
+                                          MAX_SAMPLES_PER_REQUEST + 1)}})
+                big = recv_frame(sock)
+                assert big["status"] == "error"
+                assert "cap" in big["error"]
+                # the same connection still serves real work
+                send_frame(sock, {"id": 99, "method": "das_cells",
+                                  "params": {"block_root": root.hex(),
+                                             "samples": [[0, 1]]}})
+                assert recv_frame(sock)["status"] == "ok"
+                sock.close()
+            finally:
+                front.stop()
+
+    def test_dead_connections_are_pruned(self):
+        with use_config(minimal_config()):
+            front, addr, _root, _state, _view = self._front(workers=1)
+            try:
+                for _ in range(6):
+                    socket.create_connection(addr, timeout=5.0).close()
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    # a fresh accept prunes the dead entries
+                    probe = socket.create_connection(addr, timeout=5.0)
+                    with front._conn_lock:
+                        n = len(front._conns)
+                    probe.close()
+                    if n <= 2:
+                        break
+                    time.sleep(0.05)
+                else:
+                    pytest.fail(f"dead connections never pruned "
+                                f"({n} retained)")
+            finally:
+                front.stop()
+
+    def test_nan_deadline_cannot_bypass_admission(self):
+        # NaN/Infinity are valid JSON numbers to json.loads: they must
+        # fall back to the DEFAULT budget, not sail past every
+        # `now >= expires_at` comparison forever
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.serve.protocol import (
+                recv_frame,
+                send_frame,
+            )
+            front, addr, root, _state, _view = self._front(workers=1)
+            try:
+                sock = socket.create_connection(addr, timeout=5.0)
+                for bad in (float("nan"), float("inf")):
+                    send_frame(sock, {"id": 1, "method": "head",
+                                      "deadline_ms": bad})
+                    assert recv_frame(sock)["status"] == "ok"
+                # the admitted item carries a finite expiry
+                item = ({"id": 9, "method": "head"}, None, 0.0,
+                        front.default_deadline_ms, 0)
+                assert front.queue.offer(item, 0,
+                                         float("nan")) is None or True
+                sock.close()
+            finally:
+                front.stop()
+
+    def test_unpublished_view_is_unavailable_not_a_breaker_trip(self):
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.serve import (
+                ServeClient,
+                ServeFront,
+                ServingState,
+            )
+            eng, root, _view = _synthetic_view()
+            front = ServeFront(ServingState(), scheme=eng.scheme,
+                               workers=1)  # nothing published yet
+            addr = front.start()
+            try:
+                cli = ServeClient(addr, connections=1, hedge_ms=None,
+                                  max_retries=0)
+                for _ in range(front.breaker.failure_threshold + 2):
+                    res = cli.request(
+                        "das_cells",
+                        {"block_root": root.hex(), "samples": [[0, 0]]},
+                        deadline_s=0.5)
+                    assert res.status == "unavailable"
+                    assert "no serving view" in (res.reason or "")
+                # not-ready is not a backing verdict: breaker closed
+                assert front.breaker.state == front.breaker.CLOSED
+                cli.close()
+            finally:
+                front.stop()
+
+    def test_brownout_sheds_bulk_keeps_interactive(self):
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.serve import ServeClient
+            front, addr, root, _state, _view = self._front(workers=2)
+            try:
+                front.brownout.active = True  # force the state machine
+                cli = ServeClient(addr, connections=1, hedge_ms=None,
+                                  max_retries=0)
+                bulk = cli.request("das_cells", {
+                    "block_root": root.hex(), "samples": [[0, 0]]},
+                    deadline_s=0.2)
+                assert bulk.status == "shed"
+                assert bulk.reason == "brownout"
+                head = cli.request("head", deadline_s=1.0, tier=0)
+                assert head.ok and head.result["head_slot"] == 2
+                assert front.queue.shed["brownout"] == 1
+                cli.close()
+            finally:
+                front.stop()
+
+    def test_breaker_opens_on_backing_outage_and_recovers(self):
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.serve import ServeChaos, ServeClient
+            from pos_evolution_tpu.serve.admission import CircuitBreaker
+            chaos = ServeChaos(1)
+            front, addr, root, _state, _view = self._front(
+                workers=1, chaos=chaos,
+                breaker=CircuitBreaker(failure_threshold=2,
+                                       cooldown_s=0.2))
+            try:
+                cli = ServeClient(addr, connections=1, hedge_ms=None,
+                                  max_retries=0)
+                chaos.fail_backing_for(0.4)
+                params = {"block_root": root.hex(), "samples": [[0, 2]]}
+                statuses = [cli.request("das_cells", params,
+                                        deadline_s=0.5).status
+                            for _ in range(4)]
+                assert statuses[:2] == ["error", "error"]  # tripping
+                assert "unavailable" in statuses[2:]  # open = honest
+                # interactive is untouched by a backing outage
+                assert cli.request("head", deadline_s=1.0,
+                                   tier=0).ok
+                # after the outage + cooldown the half-open probe closes
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    if cli.request("das_cells", params,
+                                   deadline_s=0.5).ok:
+                        break
+                    time.sleep(0.05)
+                else:
+                    pytest.fail("breaker never recovered")
+                assert front.breaker.state == front.breaker.CLOSED
+                cli.close()
+            finally:
+                front.stop()
+
+    def test_slow_loris_is_closed_while_real_traffic_flows(self):
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.serve import (
+                ServeClient,
+                SlowLorisSwarm,
+            )
+            front, addr, root, _state, _view = self._front(
+                workers=2, read_timeout_s=0.15)
+            try:
+                swarm = SlowLorisSwarm(addr, n=4, dribble_s=0.3)
+                swarm.start()
+                cli = ServeClient(addr, connections=2)
+                oks = sum(cli.request("head", deadline_s=1.0,
+                                      tier=0).ok
+                          for _ in range(20))
+                assert oks == 20  # the swarm never cost a worker
+                deadline = time.monotonic() + 5.0
+                while (front.slow_loris_closed < 4
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                assert front.slow_loris_closed >= 4
+                swarm.stop()
+                cli.close()
+            finally:
+                front.stop()
+
+    def test_cache_wipe_on_publish_then_stampede_rebuild_once(self):
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.serve import ServeChaos, ServeClient
+            chaos = ServeChaos(3, wipe_prob=1.0)
+            front, addr, root, state, view = self._front(
+                workers=4, chaos=chaos)
+            try:
+                cli = ServeClient(addr, connections=4)
+                params = {"block_root": root.hex(),
+                          "samples": [[0, c] for c in range(8)]}
+                assert cli.request("das_cells", params,
+                                   deadline_s=2.0).ok
+                builds_before = front.das.scheme_builds
+                state.publish(view)  # block boundary -> chaos wipes
+                assert len(front.das.proof_cache) == 0
+                # concurrent stampede on the wiped cache
+                results = []
+                threads = [threading.Thread(
+                    target=lambda: results.append(cli.request(
+                        "das_cells", params, deadline_s=3.0)))
+                    for _ in range(6)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=30.0)
+                assert all(r.ok for r in results)
+                # blob 0 was rebuilt exactly once, not once per caller
+                assert front.das.scheme_builds == builds_before + 1
+                assert any(e["kind"] == "cache_wipe"
+                           for e in chaos.log)
+                cli.close()
+            finally:
+                front.stop()
+
+
+# --- load generator + driver attach + report ----------------------------------
+
+class TestLoadgen:
+    def test_arrival_patterns_deterministic_and_shaped(self):
+        from pos_evolution_tpu.serve import arrival_times
+        for pattern in ("uniform", "diurnal", "bursty", "hotspot"):
+            a = arrival_times(pattern, 500, 1000.0, seed=5)
+            b = arrival_times(pattern, 500, 1000.0, seed=5)
+            assert np.array_equal(a, b), pattern
+            assert a.shape == (500,) and (np.diff(a) >= 0).all()
+        assert not np.array_equal(
+            arrival_times("uniform", 500, 1000.0, seed=5),
+            arrival_times("uniform", 500, 1000.0, seed=6))
+        # a 10x burst window densifies arrivals inside it
+        t = arrival_times("uniform", 2000, 1000.0, seed=5,
+                          burst_windows=((0.5, 1.0, 10.0),))
+        inside = ((t >= 0.5) & (t < 1.0)).sum()
+        before = ((t >= 0.0) & (t < 0.5)).sum()
+        assert inside > 2 * before
+        # stacking a window on the BURSTY pattern multiplies rates (the
+        # thinning peak is the product, not the max): the same n then
+        # arrives strictly sooner — with the capped-acceptance bug the
+        # on-phase-inside-window rate silently saturated and the span
+        # barely moved
+        base = arrival_times("bursty", 3000, 1000.0, seed=5)
+        stacked = arrival_times("bursty", 3000, 1000.0, seed=5,
+                                burst_windows=((0.0, 1.0, 4.0),))
+        assert stacked[-1] < base[-1] * 0.8
+
+    def test_mini_open_loop_run_all_verified(self):
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.serve import (
+                LoadGenerator,
+                ServeFront,
+                ServingState,
+            )
+            from pos_evolution_tpu.telemetry.registry import (
+                MetricsRegistry,
+            )
+            eng, root, view = _synthetic_view()
+            state = ServingState()
+            state.publish(view)
+            front = ServeFront(state, scheme=eng.scheme,
+                               registry=MetricsRegistry(), workers=2)
+            addr = front.start()
+            try:
+                def targets():
+                    v = state.current()
+                    return {"roots": [r.hex() for r in v.sidecars],
+                            "n_cells": v.n_cells,
+                            "n_blobs": {r.hex(): len(s)
+                                        for r, s in v.sidecars.items()}}
+                lg = LoadGenerator(addr, 400, 2000.0, pattern="hotspot",
+                                   seed=11, client_threads=16,
+                                   targets_fn=targets)
+                summary = lg.run()
+                assert summary["arrivals"] == 400
+                assert summary["verify_failures"] == 0
+                assert summary["verified_proofs"] > 0
+                tiers = summary["tiers"]
+                assert tiers["bulk"]["by_status"].get("ok", 0) > 0
+                assert tiers["interactive"]["by_status"].get("ok",
+                                                             0) > 0
+            finally:
+                front.stop()
+
+
+class TestDriverServeAttach:
+    def test_simulation_publishes_views(self):
+        with use_config(minimal_config()):
+            from pos_evolution_tpu.sim import Simulation
+            sim = Simulation(32, das=True, serve=True)
+            sim.run_epochs(1)
+            views = sim.serving_state.views
+            assert len(views) == sim.slot
+            last = views[-1]
+            assert last.sidecars, "DAS window never carried sidecars"
+            assert last.update_root is not None
+            assert last.n_cells == 2 * sim.cfg.das_cells_per_blob
+            # the published update bytes re-hash to the advertised root
+            from pos_evolution_tpu.lightclient.containers import (
+                LightClientUpdate,
+            )
+            from pos_evolution_tpu.ssz import deserialize, hash_tree_root
+            obj = deserialize(last.update_ssz, LightClientUpdate)
+            assert bytes(hash_tree_root(obj)) == last.update_root
+
+
+class TestServingReport:
+    def test_report_section_from_events(self, tmp_path):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "scripts"))
+        import run_report as sys_path_report
+
+        from pos_evolution_tpu.telemetry import EventBus
+        path = tmp_path / "ev.jsonl"
+        with EventBus(path) as bus:
+            bus.emit("serve_attach", workers=4, pattern="bursty",
+                     arrivals=1000, rate=500.0,
+                     chaos={"seed": 1})
+            bus.emit("serve_summary",
+                     server={"workers": 4, "requests_total": 1000,
+                             "by_status": {"ok": 950, "shed": 50},
+                             "shed_rate": 0.05,
+                             "shed_by_reason": {"deadline": 50,
+                                                "depth": 0,
+                                                "brownout": 0},
+                             "brownout_transitions": 2,
+                             "breaker_state": "closed",
+                             "breaker_transitions": 0,
+                             "singleflight": {"leads": 8, "waits": 40},
+                             "scheme_builds": 8,
+                             "proof_cache": {"hits": 900, "misses": 100,
+                                             "hit_rate": 0.9},
+                             "slow_loris_closed": 4,
+                             "chaos_stalls": 2},
+                     load={"pattern": "bursty", "arrivals": 1000,
+                           "rate": 500.0, "wall_s": 2.0,
+                           "tiers": {"interactive": {
+                               "arrivals": 300, "goodput_pct": 99.0,
+                               "shed_pct": 0.0, "p50_ms": 1.0,
+                               "p99_ms": 9.0, "p999_ms": 20.0},
+                               "bulk": {
+                               "arrivals": 700, "goodput_pct": 92.0,
+                               "shed_pct": 7.1, "p50_ms": 2.0,
+                               "p99_ms": 30.0, "p999_ms": 80.0}},
+                           "hedges": 12, "retries": 30,
+                           "verified_proofs": 640,
+                           "verify_failures": 0},
+                     chaos={"injections": {"cache_wipe": 3}},
+                     slo_ms=50.0, slo_ok=True)
+        from pos_evolution_tpu.telemetry import read_jsonl
+        events = read_jsonl(path)
+        report = sys_path_report.build_report(events)
+        s = report["serving"]
+        assert s["arrivals"] == 1000
+        assert s["shed_rate"] == 0.05
+        assert s["verified_proofs"] == 640
+        assert s["slo_ok"] is True
+        assert s["tiers"]["interactive"]["p999_ms"] == 20.0
+        md = sys_path_report.to_markdown(report)
+        assert "## Serving" in md
+        assert "p999" in md
+        assert "verified proofs" in md
+        assert "honest rejections" in md
+        assert json.dumps(report)  # JSON-serializable end to end
