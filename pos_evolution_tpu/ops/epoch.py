@@ -150,6 +150,14 @@ def densify_sharded(state, mesh) -> tuple[DenseRegistry, int]:
     return shard_registry(mesh, pad_registry(reg, npad)), n
 
 
+def masked_stake_host(mask: np.ndarray, weight: np.ndarray) -> int:
+    """Host twin of ``parallel/sharded.masked_stake_for``: summed int64
+    stake where ``mask`` — the monitors' gathered-tally oracle (int64
+    addition reassociates exactly, so host == sharded bit-for-bit)."""
+    return int(np.sum(np.where(np.asarray(mask), np.asarray(weight), 0),
+                      dtype=np.int64))
+
+
 def isqrt_i64(x):
     """Exact integer sqrt for non-negative int64 via float estimate + fixup."""
     s = jnp.floor(jnp.sqrt(x.astype(jnp.float64))).astype(jnp.int64)

@@ -59,8 +59,10 @@ PARTITION_RULES: tuple[tuple[str, P], ...] = (
     # DenseRegistry / epoch-sweep columns: int64/uint8/bool [N]
     (r"registry/.*", VALIDATOR_SPEC),
     # resident fork-choice latest-message table + the dense driver's
-    # committee-assignment column: [N] over validators
-    (r"messages/(msg_block|msg_epoch|weight|ok|assigned)", VALIDATOR_SPEC),
+    # committee-assignment, vote-delivery-mask (faults/adversary, ISSUE
+    # 13), evidence and genesis-stake columns: [N] over validators
+    (r"messages/(msg_block|msg_epoch|weight|ok|assigned"
+     r"|allow|evidence|stake)", VALIDATOR_SPEC),
     # fused-transition session columns: [N] over validators
     (r"session/(balances|prev_flags|cur_flags|eff_units)", VALIDATOR_SPEC),
     # block-tree columns are O(B), replicated for the descent pass

@@ -250,6 +250,52 @@ def windowed_tally_for(mesh: Mesh, n_blocks: int):
     return _cached(("windowed", mesh, n_blocks), build)
 
 
+def vote_apply_for(mesh: Mesh):
+    """Memoized masked vote application INSIDE a ``shard_map`` over the
+    validator axes (ISSUE 13): the dense driver's per-slot vote landing
+    — latest-message table + participation flags updated where the
+    delivery mask is True. The mask is the composition of duty
+    (committee selector), view membership, and the ``DenseFaultPlan``
+    drop/delay/crash masks, computed replicated on host and placed
+    sharded; elementwise, zero collectives, so faulted == unfaulted-
+    with-all-pass-masks bit-for-bit on every mesh shape (and identical
+    to the single-device jitted twin in sim/dense_driver.py)."""
+    vspec = P((POD_AXIS, SHARD_AXIS))
+
+    def build():
+        @jax.jit
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(vspec, vspec, vspec, vspec, P(), P(), P()),
+                 out_specs=(vspec, vspec, vspec))
+        def apply(msg_block, msg_epoch, cur_flags, mask, idx, ep, flag_on):
+            return (jnp.where(mask, idx, msg_block),
+                    jnp.where(mask, ep, msg_epoch),
+                    jnp.where(mask & flag_on,
+                              cur_flags | np.uint8(7), cur_flags))
+        return apply
+    return _cached(("vote_apply", mesh), build)
+
+
+def masked_stake_for(mesh: Mesh):
+    """Memoized masked-stake tally (ISSUE 13): summed effective balance
+    where ``mask`` — the gathered per-slot tally the dense monitors read
+    (double-vote evidence stake, per-view target participation). Each
+    shard sums its local slice, partials allreduce ICI-first then DCN;
+    int64 adds reassociate exactly, so the result is bit-identical to
+    the host twin ``ops/epoch.masked_stake_host``."""
+    vspec = P((POD_AXIS, SHARD_AXIS))
+
+    def build():
+        @jax.jit
+        @partial(shard_map, mesh=mesh, in_specs=(vspec, vspec),
+                 out_specs=P())
+        def tally(mask, weight):
+            local = jnp.sum(jnp.where(mask, weight, 0))
+            return JaxCollectives.psum_two_level(local)  # ICI, then DCN
+        return tally
+    return _cached(("masked_stake", mesh), build)
+
+
 def shuffle_for(mesh: Mesh, n: int, rounds: int):
     """Memoized ``sharded_shuffle`` (config #2) — the dense driver runs
     one shuffle per epoch over an identical (mesh, n, rounds) signature;

@@ -45,7 +45,11 @@ from pos_evolution_tpu.serve.admission import (
 )
 from pos_evolution_tpu.serve.chaos import ServeChaos, SlowLorisSwarm
 from pos_evolution_tpu.serve.client import ClientResult, ServeClient
-from pos_evolution_tpu.serve.loadgen import LoadGenerator, arrival_times
+from pos_evolution_tpu.serve.loadgen import (
+    LoadGenerator,
+    arrival_times,
+    discover_targets,
+)
 from pos_evolution_tpu.serve.protocol import (
     ProtocolError,
     recv_frame,
@@ -73,6 +77,7 @@ __all__ = [
     "TIER_BULK",
     "TIER_INTERACTIVE",
     "arrival_times",
+    "discover_targets",
     "recv_frame",
     "send_frame",
 ]

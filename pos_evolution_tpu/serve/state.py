@@ -54,6 +54,13 @@ class ServeView:
             "update_root": (self.update_root.hex()
                             if self.update_root else None),
             "das_roots": [r.hex() for r in self.sidecars],
+            # grid geometry per served root, so a REMOTE load generator
+            # can discover its bulk targets from this one endpoint
+            # (serve/loadgen.discover_targets) instead of in-process
+            # introspection (ISSUE 13 / ROADMAP item 3 remainder)
+            "n_cells": int(self.n_cells),
+            "das_blobs": {r.hex(): len(cars)
+                          for r, cars in self.sidecars.items()},
         }
 
     def finality_summary(self) -> dict:

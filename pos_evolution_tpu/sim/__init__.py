@@ -9,14 +9,32 @@ from pos_evolution_tpu.sim.adversary import (
     SplitVoter,
     Withholder,
 )
+from pos_evolution_tpu.sim.dense_adversary import (
+    DenseAdversaryStrategy,
+    DenseBalancer,
+    DenseEquivocator,
+    DenseSplitVoter,
+    DenseWithholder,
+    VoteBatch,
+)
 from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+from pos_evolution_tpu.sim.dense_monitors import (
+    DenseAccountableSafetyMonitor,
+    DenseFinalityLivenessMonitor,
+    DenseForkChoiceParityMonitor,
+    DenseMonitor,
+    default_dense_monitors,
+)
 from pos_evolution_tpu.sim.driver import Simulation, ViewGroup
 from pos_evolution_tpu.sim.faults import (
     CrashWindow,
+    DenseCrashWindow,
+    DenseFaultPlan,
     FaultPlan,
     chaos_plan,
     lossy_plan,
     stateless_unit,
+    stateless_unit_array,
 )
 from pos_evolution_tpu.sim.monitors import (
     AccountableSafetyMonitor,

@@ -1,4 +1,4 @@
-"""Mainnet-scale end-to-end dense simulation on a device mesh (ISSUE 9).
+"""Mainnet-scale end-to-end dense simulation on a device mesh (ISSUES 9, 13).
 
 The spec-level ``sim/driver.py`` carries per-message Python objects —
 the right tool for adversarial/faulted protocol audits, and the wrong
@@ -25,21 +25,43 @@ run as ``shard_map`` kernels over the ``(pods, shard)`` mesh:
   ``shard_map`` with two-axis psum; justification bits and the 4-case
   finalization rule drive real finality.
 
+**Robustness at this scale (ISSUE 13):** the spec driver's scenario
+machinery folds in as data on the same sweeps —
+
+- a ``DenseFaultPlan`` (sim/faults.py) turns message loss, delivery
+  delay, GST windows and crash blackouts into per-(slot, view,
+  validator) masks ANDed INSIDE the masked vote pass
+  (``parallel/sharded.vote_apply_for``): faulted is literally
+  unfaulted-with-masks, so an all-pass plan is bit-identical to no
+  plan, on every mesh shape;
+- vectorized adversary strategies (sim/dense_adversary.py) act through
+  three hooks per slot, emitting masked ``VoteBatch``\\ es and extra
+  block-tree entries; their traffic goes through the same fault-masked
+  apply and is observed at origination by
+- the dense monitors (sim/dense_monitors.py), which read the gathered
+  tallies and classify accountable faults vs protocol violations
+  exactly as ``sim/monitors.py``;
+- ``n_groups=2`` splits the network into per-view message tables /
+  flag columns / FFG state over ONE shared block tree with per-view
+  visibility masks — the partitioned (SplitVoter) and delay-partitioned
+  (Balancer) networks of the attack reproductions, at 10^6 validators.
+
 Everything is integer math, so the sharded run is **bit-identical** to
 the single-device one (``mesh=None``) on every mesh shape — pinned in
-tests/test_sharded_e2e.py together with the host-walk oracle
-(``resident_head_equals_spec_walk``: the device head must equal the
-vectorized NumPy walk ``ops/forkchoice.head_host`` over the gathered
-message table, subsampled every ``check_walk_every`` slots).
+tests/test_sharded_e2e.py and tests/test_dense_chaos.py together with
+the host-walk oracle (the device head must equal the vectorized NumPy
+walk over the gathered message table).
 
 Checkpoint/resume gathers the sharded columns to host (`.npz` + JSON
-meta) and re-shards on the mesh active at resume time — resuming on a
-*different* mesh shape (or a single device) is bit-identical by the
-same kernel contracts.
+meta, including every view's state and the full chaos configuration +
+mutable adversary/monitor state) and re-shards on the mesh active at
+resume time — resuming on a *different* mesh shape (or a single device)
+mid-attack is bit-identical by the same kernel contracts.
 
 ``scripts/multichip_demo.py`` drives this at 1M validators for
-``MULTICHIP_r09.json``; ``bench_all.py`` times a small configuration as
-the ``bench_shard`` history emission.
+``MULTICHIP_r09.json``; ``scripts/dense_chaos_demo.py`` runs the
+adversarial acceptance episodes for ``CHAOS_DENSE_r13.json``;
+``scripts/chaos_fuzz.py --dense N`` fuzzes compositions.
 """
 
 from __future__ import annotations
@@ -55,6 +77,9 @@ from pos_evolution_tpu.config import Config, mainnet_config
 
 __all__ = ["DenseSimulation"]
 
+GWEI = 10**9
+_GENESIS_EFF = 32 * GWEI
+
 
 def _hash(*parts) -> bytes:
     h = hashlib.sha256()
@@ -68,8 +93,28 @@ from pos_evolution_tpu.ops.variant_tally import (  # noqa: E402
 )
 
 
+class _View:
+    """One view group's mutable state: its own message table, flag
+    columns (inside the registry), FFG scalars and block visibility.
+    ``n_groups=1`` runs exactly one of these — the pre-ISSUE-13 driver."""
+
+    __slots__ = ("registry", "msg_block", "msg_epoch", "bits", "prev_just",
+                 "cur_just", "finalized", "epoch_start_idx", "vis_host",
+                 "vis_d", "pending")
+
+    def __init__(self):
+        self.bits = np.zeros(4, dtype=bool)
+        self.prev_just = (0, 0)   # (epoch, block index)
+        self.cur_just = (0, 0)
+        self.finalized = (0, 0)
+        self.epoch_start_idx: dict[int, int] = {0: 0}
+        self.pending: list = []   # delayed VoteBatches for the next slot
+
+
 class DenseSimulation:
-    """Honest synchronous multi-epoch run, entirely at the array level.
+    """Multi-epoch run, entirely at the array level — honest and
+    synchronous by default; adversarial, faulted and partitioned when
+    given a chaos composition.
 
     ``mesh=None`` runs the identical loop on a single device (the
     differential twin). ``n_validators`` must divide by ``mesh.size``
@@ -79,7 +124,9 @@ class DenseSimulation:
     def __init__(self, n_validators: int, cfg: Config | None = None,
                  mesh=None, seed: int = 0, shuffle_rounds: int = 10,
                  verify_aggregates: bool = True, capacity: int = 256,
-                 check_walk_every: int = 16, autocheckpoint=None):
+                 check_walk_every: int = 16, autocheckpoint=None,
+                 n_groups: int = 1, fault_plan=None, adversaries=(),
+                 monitors=(), telemetry=None):
         import jax.numpy as jnp
         self.cfg = cfg or mainnet_config()
         self.n = int(n_validators)
@@ -95,16 +142,39 @@ class DenseSimulation:
                 f"count {mesh.size}")
         self._npad = self.n  # registry rows incl. inert padding (== n here)
 
+        # --- chaos composition (ISSUE 13) ----------------------------------
+        self.n_groups = int(n_groups)
+        assert self.n_groups in (1, 2), "1 or 2 view groups"
+        self.fault_plan = fault_plan
+        if self.n_groups > 1:
+            assert fault_plan is not None and fault_plan.partition, \
+                "multi-view runs need a partitioned DenseFaultPlan"
+        self.adversaries = list(adversaries)
+        self.monitors = list(monitors)
+        self.telemetry = telemetry
+        self.monitor_violations: list[dict] = []
+        # honest duty split: view group per validator (parity keeps the
+        # shuffled committees near-balanced between the halves)
+        self.group_of = (np.arange(self.n, dtype=np.int64)
+                         % self.n_groups).astype(np.int8)
+        self.controlled_any = np.zeros(self.n, dtype=bool)
+        for adv in self.adversaries:
+            idx = adv.controlled[adv.controlled < self.n]
+            self.controlled_any[idx] = True
+        self._eff_genesis = np.full(self.n, _GENESIS_EFF, dtype=np.int64)
+        self.total_stake = int(self.n) * _GENESIS_EFF
+        self._originated: list = []      # this slot's (view, batch) taps
+        self._pending_vis: list = []     # (block_idx, view, at_slot)
+
         # --- registry: sharded-resident from genesis -----------------------
-        gwei = 10**9
         far = np.int64(2**62)  # FAR_FUTURE_I64
 
         def fill_const(v, dtype):
             return lambda lo, hi: np.full(hi - lo, v, dtype)
 
         col_fills = {
-            "effective_balance": (32 * gwei, np.int64),
-            "balance": (32 * gwei, np.int64),
+            "effective_balance": (_GENESIS_EFF, np.int64),
+            "balance": (_GENESIS_EFF, np.int64),
             "activation_epoch": (0, np.int64),
             "exit_epoch": (far, np.int64),
             "withdrawable_epoch": (far, np.int64),
@@ -114,29 +184,31 @@ class DenseSimulation:
             "inactivity_scores": (0, np.int64),
         }
         from pos_evolution_tpu.ops.epoch import DenseRegistry
-        if mesh is not None:
-            # never materialized unsharded: each device fills its slice,
-            # placed per the partition rules (registry/* and messages/*)
-            from pos_evolution_tpu.parallel.partition import (
-                build_sharded,
-                spec_for,
-            )
-            self.registry = DenseRegistry(**{
-                f: build_sharded(mesh, spec_for(f"registry/{f}"), (self.n,),
-                                 dt, fill_const(v, dt))
-                for f, (v, dt) in col_fills.items()})
-            self.msg_block = build_sharded(
-                mesh, spec_for("messages/msg_block"), (self.n,),
-                np.int32, fill_const(-1, np.int32))
-            self.msg_epoch = build_sharded(
-                mesh, spec_for("messages/msg_epoch"), (self.n,),
-                np.int64, fill_const(0, np.int64))
-        else:
-            self.registry = DenseRegistry(**{
-                f: jnp.full(self.n, v, dtype=dt)
-                for f, (v, dt) in col_fills.items()})
-            self.msg_block = jnp.full(self.n, -1, dtype=jnp.int32)
-            self.msg_epoch = jnp.zeros(self.n, dtype=jnp.int64)
+        self.views = [_View() for _ in range(self.n_groups)]
+        for view in self.views:
+            if mesh is not None:
+                # never materialized unsharded: each device fills its
+                # slice, placed per the partition rules
+                from pos_evolution_tpu.parallel.partition import (
+                    build_sharded,
+                    spec_for,
+                )
+                view.registry = DenseRegistry(**{
+                    f: build_sharded(mesh, spec_for(f"registry/{f}"),
+                                     (self.n,), dt, fill_const(v, dt))
+                    for f, (v, dt) in col_fills.items()})
+                view.msg_block = build_sharded(
+                    mesh, spec_for("messages/msg_block"), (self.n,),
+                    np.int32, fill_const(-1, np.int32))
+                view.msg_epoch = build_sharded(
+                    mesh, spec_for("messages/msg_epoch"), (self.n,),
+                    np.int64, fill_const(0, np.int64))
+            else:
+                view.registry = DenseRegistry(**{
+                    f: jnp.full(self.n, v, dtype=dt)
+                    for f, (v, dt) in col_fills.items()})
+                view.msg_block = jnp.full(self.n, -1, dtype=jnp.int32)
+                view.msg_epoch = jnp.zeros(self.n, dtype=jnp.int64)
 
         # --- replicated O(B) block tree ------------------------------------
         self.capacity = _next_pow2(capacity)
@@ -148,19 +220,19 @@ class DenseSimulation:
         self._rank_d = jnp.zeros(self.capacity, dtype=jnp.int32)
         self._real_d = jnp.zeros(self.capacity, dtype=bool)
         self._viable_d = jnp.ones(self.capacity, dtype=bool)
+        for view in self.views:
+            view.vis_host = np.zeros(self.capacity, dtype=bool)
+            view.vis_d = jnp.zeros(self.capacity, dtype=bool)
 
-        # --- FFG scalars ----------------------------------------------------
+        # --- run scalars ----------------------------------------------------
         self.slot = 0
-        self.bits = np.zeros(4, dtype=bool)
-        self.prev_just = (0, 0)   # (epoch, block index)
-        self.cur_just = (0, 0)
-        self.finalized = (0, 0)
-        self.epoch_start_idx: dict[int, int] = {0: 0}
         self.metrics: list[dict] = []
         self.aggregates_verified = 0
         self.walk_checks: list[bool] = []
+        self.view_heads: list[bytes] = [b""] * self.n_groups
         self._epoch_ready = -1
         self._perm_host: np.ndarray | None = None
+        self._assigned_host: np.ndarray | None = None
 
         # synthetic per-validator pubkeys -> replicated signature midstates
         # (the pk table is replicated by design, SURVEY's config #3 note)
@@ -170,6 +242,21 @@ class DenseSimulation:
             rng.integers(0, 256, (self.n, 48)).astype(np.uint8))
 
         self._append_block(_hash(b"genesis", self.seed), -1, 0)
+
+        for adv in self.adversaries:
+            adv.bind(self)
+        for mon in self.monitors:
+            mon.bind(self)
+        self._emit("run_start", n_validators=self.n,
+                   n_groups=self.n_groups, dense=True,
+                   mesh=self._mesh_shape())
+        if self.adversaries or self.monitors:
+            self._emit("monitor_attach",
+                       monitors=[m.describe() for m in self.monitors],
+                       adversaries=[a.describe()
+                                    for a in self.adversaries],
+                       faults=(self.fault_plan.describe()
+                               if self.fault_plan else None))
 
         # Run supervision (resilience/, ISSUE 10, DESIGN.md §18): the
         # dense driver's async capture is the gather-then-compress
@@ -181,9 +268,41 @@ class DenseSimulation:
         if autocheckpoint is not None:
             self.attach_autocheckpoint(autocheckpoint)
 
+    # -- back-compat accessors (view 0 is the run when n_groups == 1) ----------
+
+    registry = property(lambda s: s.views[0].registry,
+                        lambda s, v: setattr(s.views[0], "registry", v))
+    msg_block = property(lambda s: s.views[0].msg_block,
+                         lambda s, v: setattr(s.views[0], "msg_block", v))
+    msg_epoch = property(lambda s: s.views[0].msg_epoch,
+                         lambda s, v: setattr(s.views[0], "msg_epoch", v))
+    bits = property(lambda s: s.views[0].bits,
+                    lambda s, v: setattr(s.views[0], "bits", v))
+    prev_just = property(lambda s: s.views[0].prev_just,
+                         lambda s, v: setattr(s.views[0], "prev_just", v))
+    cur_just = property(lambda s: s.views[0].cur_just,
+                        lambda s, v: setattr(s.views[0], "cur_just", v))
+    finalized = property(lambda s: s.views[0].finalized,
+                         lambda s, v: setattr(s.views[0], "finalized", v))
+    epoch_start_idx = property(
+        lambda s: s.views[0].epoch_start_idx,
+        lambda s, v: setattr(s.views[0], "epoch_start_idx", v))
+
+    def _mesh_shape(self):
+        return (None if self.mesh is None else
+                {a: int(x) for a, x in zip(self.mesh.axis_names,
+                                           self.mesh.devices.shape)})
+
+    def _emit(self, type_: str, **fields) -> None:
+        if self.telemetry is not None:
+            self.telemetry.bus.emit(type_, **fields)
+
     # -- block tree ------------------------------------------------------------
 
-    def _append_block(self, root: bytes, parent: int, slot: int) -> int:
+    def _append_block(self, root: bytes, parent: int, slot: int,
+                      visible_to=None) -> int:
+        """``visible_to``: None = every view, () = private (withheld),
+        or an iterable of view ids (partitioned proposals)."""
         import jax.numpy as jnp
         i = len(self.roots)
         if i >= self.capacity:
@@ -194,6 +313,13 @@ class DenseSimulation:
         self._parent_d = self._parent_d.at[i].set(parent)
         self._slot_d = self._slot_d.at[i].set(slot)
         self._real_d = self._real_d.at[i].set(True)
+        vis = (range(self.n_groups) if visible_to is None
+               else tuple(visible_to))
+        for g, view in enumerate(self.views):
+            see = g in vis
+            view.vis_host[i] = see
+            if see:
+                view.vis_d = view.vis_d.at[i].set(True)
         order = np.argsort(np.argsort(np.array(self.roots, dtype=object)))
         rank = np.zeros(self.capacity, np.int32)
         rank[: len(self.roots)] = order
@@ -210,12 +336,35 @@ class DenseSimulation:
         slot[:b] = self.block_slots
         real = np.zeros(new_capacity, bool)
         real[:b] = True
+        old_capacity = self.capacity
         self.capacity = new_capacity
         self._parent_d = jnp.asarray(parent)
         self._slot_d = jnp.asarray(slot)
         self._rank_d = jnp.zeros(new_capacity, jnp.int32)
         self._real_d = jnp.asarray(real)
         self._viable_d = jnp.ones(new_capacity, bool)
+        for view in self.views:
+            vis = np.zeros(new_capacity, dtype=bool)
+            vis[:old_capacity] = view.vis_host
+            view.vis_host = vis
+            view.vis_d = jnp.asarray(vis)
+
+    def adversary_block(self, parent: int, slot: int, tag=(),
+                        visible: bool = True) -> int:
+        """Adversary-built block (equivocating sibling / private-chain
+        extension): deterministic root from the identity, appended with
+        full or zero visibility."""
+        root = _hash(b"ablock", self.seed, slot, self.roots[parent], *tag)
+        return self._append_block(root, parent, slot,
+                                  visible_to=None if visible else ())
+
+    def reveal_blocks(self, indices) -> None:
+        """Flip withheld blocks visible in every view (the release)."""
+        import jax.numpy as jnp  # noqa: F401
+        for view in self.views:
+            for i in indices:
+                view.vis_host[i] = True
+                view.vis_d = view.vis_d.at[i].set(True)
 
     # -- committees ------------------------------------------------------------
 
@@ -239,8 +388,13 @@ class DenseSimulation:
         self._perm_host = perm_host
         self._inv_perm = np.argsort(perm_host).astype(np.int64)
         assigned = perm_host * self.S // self.n
-        self._assigned = self._place_validator_col(assigned.astype(np.int64))
+        self._assigned_host = assigned.astype(np.int64)
         self._epoch_ready = epoch
+
+    def committee_mask(self, slot: int) -> np.ndarray:
+        """bool[N]: this slot's committee members (host side — the
+        origination masks and fault compositions are host numpy)."""
+        return self._assigned_host == (slot % self.S)
 
     def _place_validator_col(self, a: np.ndarray,
                              name: str = "messages/assigned"):
@@ -258,34 +412,37 @@ class DenseSimulation:
 
     # -- fork choice -----------------------------------------------------------
 
-    def _head(self) -> int:
+    def _head(self, g: int = 0) -> int:
         import jax.numpy as jnp
 
         from pos_evolution_tpu.ops.forkchoice import (
             head_from_buckets,
             rebuild_buckets,
         )
+        view = self.views[g]
         if self.mesh is not None:
             from pos_evolution_tpu.parallel.sharded import vote_weights_for
             buckets = vote_weights_for(self.mesh, self.capacity)(
-                self.msg_block, self.registry.effective_balance)
+                view.msg_block, view.registry.effective_balance)
         else:
-            buckets = rebuild_buckets(self.msg_block,
-                                      self.registry.effective_balance,
+            buckets = rebuild_buckets(view.msg_block,
+                                      view.registry.effective_balance,
                                       self.capacity)
         head_idx, _ = head_from_buckets(
-            self._parent_d, self._real_d, self._rank_d, self._viable_d,
-            jnp.int32(self.cur_just[1]), buckets, jnp.int32(-1),
-            jnp.int64(0), self.capacity)
+            self._parent_d, self._real_d & view.vis_d, self._rank_d,
+            self._viable_d, jnp.int32(view.cur_just[1]), buckets,
+            jnp.int32(-1), jnp.int64(0), self.capacity)
         return int(head_idx)
 
-    def head_host_walk(self) -> bytes:
-        """The spec-walk oracle: gather the message table, accumulate
-        vote weights and subtree sums in NumPy, descend greedily — the
-        ``resident_head_equals_spec_walk`` pin of MULTICHIP_r09."""
+    def head_host_walk(self, g: int = 0) -> bytes:
+        """The spec-walk oracle: gather the view's message table,
+        accumulate vote weights and subtree sums in NumPy, descend
+        greedily — the ``resident_head_equals_spec_walk`` pin of
+        MULTICHIP_r09, per view, withheld blocks masked out."""
         from pos_evolution_tpu.ops.forkchoice import head_host
-        msg = np.asarray(self.msg_block)[: self.n]
-        eff = np.asarray(self.registry.effective_balance)[: self.n]
+        view = self.views[g]
+        msg = np.asarray(view.msg_block)[: self.n]
+        eff = np.asarray(view.registry.effective_balance)[: self.n]
         valid = msg >= 0
         vw = np.zeros(self.capacity + 1, np.int64)
         np.add.at(vw, np.where(valid, msg, self.capacity),
@@ -296,34 +453,133 @@ class DenseSimulation:
         real = np.zeros(self.capacity, bool)
         real[:b] = True
         rank = np.asarray(self._rank_d)
-        idx = head_host(parent, real, rank, np.ones(self.capacity, bool),
-                        self.cur_just[1], vw[: self.capacity], -1, 0)
+        idx = head_host(parent, real & view.vis_host, rank,
+                        np.ones(self.capacity, bool), view.cur_just[1],
+                        vw[: self.capacity], -1, 0)
         return self.roots[idx]
+
+    # -- monitors' gathered-tally helpers --------------------------------------
+
+    def stake_of(self, mask: np.ndarray) -> int:
+        """Genesis-stake tally of a validator mask — the monitors'
+        evidence pricing. On a mesh the mask is placed sharded and the
+        tally runs as the two-axis psum kernel
+        (``parallel/sharded.masked_stake_for``); the single-device path
+        is the host twin. Bit-identical (int64)."""
+        if self.mesh is not None:
+            from pos_evolution_tpu.parallel.sharded import masked_stake_for
+            placed = self._place_validator_col(np.asarray(mask, dtype=bool),
+                                               "messages/evidence")
+            eff = self._place_validator_col(self._eff_genesis,
+                                            "messages/stake")
+            return int(masked_stake_for(self.mesh)(placed, eff))
+        from pos_evolution_tpu.ops.epoch import masked_stake_host
+        return masked_stake_host(mask, self._eff_genesis)
+
+    def _descends(self, idx: int, ancestor: int) -> bool:
+        cur = idx
+        while cur >= 0:
+            if cur == ancestor:
+                return True
+            cur = self.parents[cur]
+        return False
+
+    def _target_matches(self, g: int, block_idx: int, epoch: int) -> bool:
+        """The spec's flag rule at array level: a vote earns the view's
+        timely-target participation flag only when its target chain
+        carries the view's checkpoint for that epoch (process_attestation
+        requires att.data.target == the state's current checkpoint; a
+        vote for the OTHER partition's chain must not count toward this
+        view's justification)."""
+        boundary = self.views[g].epoch_start_idx.get(epoch)
+        if boundary is None:
+            return False
+        return self._descends(block_idx, boundary)
 
     # -- votes -----------------------------------------------------------------
 
-    def _cast_votes(self, slot_in_epoch: int, block_idx: int,
-                    epoch: int) -> None:
+    def _apply_batch(self, g: int, mask_np: np.ndarray, block_idx: int,
+                     epoch: int, flag_on: bool) -> None:
+        """One masked vote landing on view ``g``'s sharded columns —
+        the shard_map kernel on a mesh, its jitted elementwise twin on
+        a single device (identical math)."""
         import jax.numpy as jnp
-        global _VOTE_KERNEL
-        if _VOTE_KERNEL is None:
-            import jax
+        view = self.views[g]
+        mask_col = self._place_validator_col(
+            np.ascontiguousarray(mask_np, dtype=bool), "messages/allow")
+        if self.mesh is not None:
+            from pos_evolution_tpu.parallel.sharded import vote_apply_for
+            kern = vote_apply_for(self.mesh)
+        else:
+            kern = _vote_kernel()
+        mb, me, cf = kern(view.msg_block, view.msg_epoch,
+                          view.registry.cur_flags, mask_col,
+                          jnp.int32(block_idx), jnp.int64(epoch),
+                          jnp.bool_(flag_on))
+        view.msg_block, view.msg_epoch = mb, me
+        view.registry = view.registry._replace(cur_flags=cf)
 
-            def kern(msg_block, msg_epoch, cur_flags, assigned, t, idx, ep):
-                mask = assigned == t
-                return (jnp.where(mask, idx, msg_block),
-                        jnp.where(mask, ep, msg_epoch),
-                        jnp.where(mask, cur_flags | np.uint8(7), cur_flags))
-            _VOTE_KERNEL = jax.jit(kern)
-        self.msg_block, self.msg_epoch, cur = _VOTE_KERNEL(
-            self.msg_block, self.msg_epoch, self.registry.cur_flags,
-            self._assigned, jnp.int64(slot_in_epoch),
-            jnp.int32(block_idx), jnp.int64(epoch))
-        self.registry = self.registry._replace(cur_flags=cur)
+    def _fault_masks(self, slot: int, g: int):
+        """(dropped, delayed, crashed) bool[N] for one (slot, view)."""
+        if self.fault_plan is None:
+            z = np.zeros(self.n, dtype=bool)
+            return z, z, z
+        dropped, delayed = self.fault_plan.delivery_masks(slot, g, self.n)
+        crashed = self.fault_plan.crashed_mask(slot, self.n)
+        return dropped, delayed, crashed
+
+    def _deliver_batch(self, g: int, batch, slot: int,
+                       epoch_now: int) -> np.ndarray:
+        """Route one VoteBatch into view ``g`` through the fault masks;
+        the non-delivered delayed slice re-queues for the next slot.
+        Returns the mask that actually landed."""
+        from pos_evolution_tpu.sim.dense_adversary import VoteBatch
+        mask = batch.mask
+        if batch.faultable:
+            dropped, delayed, crashed = self._fault_masks(slot, g)
+            land = mask & ~crashed & ~dropped & ~delayed
+            late = mask & ~crashed & delayed
+            if late.any():
+                self.views[g].pending.append(
+                    VoteBatch(late, batch.block, batch.epoch, views=(g,),
+                              flag=batch.flag, faultable=False))
+            n_d, n_l = int((mask & dropped).sum()), int(late.sum())
+            if n_d or n_l:
+                self._emit("dense_fault", slot=slot, view=g,
+                           dropped=n_d, delayed=n_l)
+        else:
+            land = mask
+        if not land.any():
+            return land
+        if batch.flag is not None:
+            flag_on = bool(batch.flag)
+        else:
+            # a vote delayed across an epoch boundary still updates the
+            # LMD table but no longer earns the (rotated) current-epoch
+            # participation flag — deterministic and conservative
+            flag_on = (batch.epoch == epoch_now
+                       and self._target_matches(g, batch.block, batch.epoch))
+        self._apply_batch(g, land, batch.block, batch.epoch, flag_on)
+        return land
+
+    def apply_votes_now(self, batches, slot: int) -> None:
+        """Immediate application for release hooks (before_propose):
+        the batches go through the same fault masks and the same
+        origination tap as everything else."""
+        epoch_now = slot // self.S
+        for batch in batches:
+            for g in range(self.n_groups):
+                if batch.for_view(g):
+                    self._originated.append((g, batch))
+                    self._deliver_batch(g, batch, slot, epoch_now)
 
     # -- aggregation verify ----------------------------------------------------
 
-    def _verify_slot(self, slot_in_epoch: int, block_root: bytes) -> None:
+    def _verify_slot(self, slot_in_epoch: int, block_root: bytes,
+                     landed: np.ndarray) -> None:
+        """Committee aggregates over the validators whose vote for this
+        block actually landed (drops shrink the aggregate; identical to
+        the pre-ISSUE-13 sweep when ``landed`` covers the committee)."""
         import jax.numpy as jnp
 
         from pos_evolution_tpu.ops.aggregation import messages_to_words
@@ -337,7 +593,7 @@ class DenseSimulation:
         for c in range(a_real):
             member = attesters[c::a_real]
             committees[c, : member.size] = member
-            bits[c, : member.size] = True
+            bits[c, : member.size] = landed[member]
         msg = messages_to_words(
             np.frombuffer(block_root, dtype=np.uint8)[None, :].repeat(
                 a_real, axis=0))
@@ -379,10 +635,10 @@ class DenseSimulation:
 
     # -- epoch boundary --------------------------------------------------------
 
-    def _epoch_boundary(self, entering_epoch: int) -> None:
-        """Spec-mirrored epoch processing when entering ``entering_epoch``
-        (``current_epoch`` = the epoch just completed, exactly like
-        ``process_epoch`` running at slot E*S - 1)."""
+    def _epoch_boundary(self, view: _View, entering_epoch: int) -> None:
+        """Spec-mirrored epoch processing for one view when entering
+        ``entering_epoch`` (``current_epoch`` = the epoch just
+        completed, exactly like ``process_epoch`` at slot E*S - 1)."""
         import jax.numpy as jnp
         cur_e = entering_epoch - 1
         if self.mesh is not None:
@@ -393,54 +649,162 @@ class DenseSimulation:
         else:
             from pos_evolution_tpu.ops.epoch import process_epoch_dense
             step = lambda *a: process_epoch_dense(*a, self.cfg)  # noqa: E731
-        out = step(self.registry, jnp.int64(cur_e),
-                   jnp.int64(self.finalized[0]), jnp.asarray(self.bits),
-                   jnp.int64(self.prev_just[0]), jnp.int64(self.cur_just[0]),
-                   jnp.int64(0))
-        self.registry = out.registry
+        out = step(view.registry, jnp.int64(cur_e),
+                   jnp.int64(view.finalized[0]), jnp.asarray(view.bits),
+                   jnp.int64(view.prev_just[0]),
+                   jnp.int64(view.cur_just[0]), jnp.int64(0))
+        view.registry = out.registry
         if cur_e > 1:
-            old_prev, old_cur = self.prev_just, self.cur_just
-            self.prev_just = self.cur_just
+            old_prev, old_cur = view.prev_just, view.cur_just
+            view.prev_just = view.cur_just
             if bool(out.justify_prev):
-                self.cur_just = (cur_e - 1, self.epoch_start_idx[cur_e - 1])
+                view.cur_just = (cur_e - 1,
+                                 view.epoch_start_idx[cur_e - 1])
             if bool(out.justify_cur):
-                self.cur_just = (cur_e, self.epoch_start_idx[cur_e])
-            self.bits = np.asarray(out.new_justification_bits)
+                view.cur_just = (cur_e, view.epoch_start_idx[cur_e])
+            view.bits = np.asarray(out.new_justification_bits)
             fin = int(out.finalize_epoch)
             if fin >= 0:
                 # later finalization cases use the old CURRENT justified
                 # checkpoint and win in the spec — check it first
                 if fin == old_cur[0]:
-                    self.finalized = old_cur
+                    view.finalized = old_cur
                 elif fin == old_prev[0]:
-                    self.finalized = old_prev
+                    view.finalized = old_prev
 
     # -- main loop -------------------------------------------------------------
 
+    def _cross_views(self, g: int):
+        """Where (and when) view ``g``'s traffic reaches other views:
+        [] under a full partition, the other view one slot late under
+        the delay partition, immediately otherwise."""
+        if self.n_groups == 1:
+            return []
+        mode = self.fault_plan.partition if self.fault_plan else None
+        if mode == "full":
+            return []
+        delay = 1 if mode == "delay" else 0
+        return [(h, delay) for h in range(self.n_groups) if h != g]
+
     def run_slot(self) -> None:
+        from pos_evolution_tpu.sim.dense_adversary import VoteBatch
         s = self.slot + 1
         epoch = s // self.S
         if s % self.S == 0 and s > 0:
-            self._epoch_boundary(epoch)
+            for view in self.views:
+                self._epoch_boundary(view, epoch)
         if self._epoch_ready < epoch:
             self._start_epoch(epoch)
-        head = self._head()
-        root = _hash(b"block", self.seed, s, self.roots[head])
-        idx = self._append_block(root, head, s)
-        if s % self.S == 0:
-            self.epoch_start_idx[epoch] = idx
-        self._cast_votes(s % self.S, idx, epoch)
+        self._originated = []
+        # delayed cross-view block visibility lands at slot start
+        still = []
+        for idx, g, at_slot in self._pending_vis:
+            if at_slot <= s:
+                view = self.views[g]
+                view.vis_host[idx] = True
+                view.vis_d = view.vis_d.at[idx].set(True)
+            else:
+                still.append((idx, g, at_slot))
+        self._pending_vis = still
+
+        for adv in self.adversaries:
+            adv.before_propose(self, s)
+
+        # --- per-view proposals -------------------------------------------
+        new_idx: list[int] = []
+        for g in range(self.n_groups):
+            head = self._head(g)
+            if self.n_groups == 1:
+                root = _hash(b"block", self.seed, s, self.roots[head])
+            else:
+                root = _hash(b"block", self.seed, s, self.roots[head], g)
+            visible_to = None
+            cross = self._cross_views(g)
+            if self.n_groups > 1:
+                visible_to = [g] + [h for h, d in cross if d == 0]
+            idx = self._append_block(root, head, s, visible_to=visible_to)
+            for h, d in cross:
+                if d > 0:
+                    self._pending_vis.append((idx, h, s + d))
+            if s % self.S == 0:
+                self.views[g].epoch_start_idx[epoch] = idx
+            new_idx.append(idx)
+
+        for adv in self.adversaries:
+            adv.on_proposals(self, s, new_idx)
+
+        # --- votes: pending (delayed) first, then honest, then adversarial
+        landed_own = [np.zeros(self.n, dtype=bool)
+                      for _ in range(self.n_groups)]
+        for g, view in enumerate(self.views):
+            pending, view.pending = view.pending, []
+            for batch in pending:
+                self._originated.append((g, batch))
+                land = self._deliver_batch(g, batch, s, epoch)
+                if batch.block == new_idx[g]:
+                    landed_own[g] |= land
+        committee = self.committee_mask(s)
+        for g in range(self.n_groups):
+            duty = committee & (self.group_of == g) & ~self.controlled_any
+            batch = VoteBatch(duty, new_idx[g], epoch, views=(g,))
+            self._originated.append((g, batch))
+            landed_own[g] |= self._deliver_batch(g, batch, s, epoch)
+            for h, delay in self._cross_views(g):
+                cross = VoteBatch(duty.copy(), new_idx[g], epoch,
+                                  views=(h,))
+                if delay == 0:
+                    self._originated.append((h, cross))
+                    self._deliver_batch(h, cross, s, epoch)
+                else:
+                    self.views[h].pending.append(cross)
+        for adv in self.adversaries:
+            for batch in adv.vote_batches(self, s, new_idx):
+                for g in range(self.n_groups):
+                    if batch.for_view(g):
+                        self._originated.append((g, batch))
+                        land = self._deliver_batch(g, batch, s, epoch)
+                        if batch.block == new_idx[g]:
+                            landed_own[g] |= land
+
         if self.verify_aggregates:
-            self._verify_slot(s % self.S, root)
+            for g in range(self.n_groups):
+                if landed_own[g].any():
+                    self._verify_slot(s % self.S, self.roots[new_idx[g]],
+                                      landed_own[g])
+
         self.slot = s
+        self.view_heads = [self.roots[new_idx[g]]
+                           for g in range(self.n_groups)]
+
+        # --- monitors over the gathered tallies ---------------------------
+        for mon in self.monitors:
+            mon.on_votes(self, s, self._originated)
+        for mon in self.monitors:
+            for v in mon.on_slot_end(self, s):
+                v.setdefault("slot", s)
+                self.monitor_violations.append(v)
+                self._emit("monitor", **v)
+
         if self.check_walk_every and s % self.check_walk_every == 0:
-            self.walk_checks.append(self.head_host_walk() == root)
-        self.metrics.append({
-            "slot": s, "head_root": root.hex()[:16],
-            "justified_epoch": self.cur_just[0],
-            "finalized_epoch": self.finalized[0],
+            # device head vs independent host walk (not the proposed
+            # block: an adversary can legitimately move the head)
+            self.walk_checks.append(self.head_host_walk(0) ==
+                                    self.roots[self._head(0)])
+        m = {
+            "slot": s, "head_root": self.view_heads[0].hex()[:16],
+            "justified_epoch": self.views[0].cur_just[0],
+            "finalized_epoch": self.views[0].finalized[0],
             "n_blocks": len(self.roots),
-        })
+        }
+        if self.n_groups > 1:
+            m["views"] = [{"head_root": self.view_heads[g].hex()[:16],
+                           "justified_epoch": self.views[g].cur_just[0],
+                           "finalized_epoch": self.views[g].finalized[0]}
+                          for g in range(self.n_groups)]
+        self.metrics.append(m)
+        self._emit("slot", slot=s, head_slot=s,
+                   justified_epoch=self.views[0].cur_just[0],
+                   finalized_epoch=self.views[0].finalized[0])
         if self.supervision is not None:
             self.supervision.tick(self, s, self._checkpoint_async_capture)
 
@@ -455,23 +819,36 @@ class DenseSimulation:
     # -- results ---------------------------------------------------------------
 
     def summary(self) -> dict:
-        self.walk_checks.append(self.head_host_walk() == self.roots[-1])
-        return {
+        # final parity pin: host walk vs a fresh DEVICE head query — not
+        # roots[-1], which under an adversary is whatever block was
+        # appended last (an equivocating sibling, a private extension)
+        head = self.roots[self._head(0)]
+        self.walk_checks.append(self.head_host_walk(0) == head)
+        out = {
             "n_validators": self.n,
-            "mesh": (None if self.mesh is None else
-                     {a: int(s) for a, s in zip(self.mesh.axis_names,
-                                                self.mesh.devices.shape)}),
+            "mesh": self._mesh_shape(),
             "slots": self.slot,
             "epochs": self.slot // self.S,
             "n_blocks": len(self.roots),
-            "justified_epoch": self.cur_just[0],
-            "finalized_epoch": self.finalized[0],
-            "finality_reached": self.finalized[0] > 0,
+            "justified_epoch": self.views[0].cur_just[0],
+            "finalized_epoch": self.views[0].finalized[0],
+            "finality_reached": self.views[0].finalized[0] > 0,
             "aggregates_verified": self.aggregates_verified,
             "resident_head_equals_spec_walk": all(self.walk_checks),
             "walk_checks": len(self.walk_checks),
-            "head_root": self.roots[-1].hex()[:16],
+            "head_root": head.hex()[:16],
         }
+        if self.n_groups > 1:
+            out["n_groups"] = self.n_groups
+            out["views"] = [{"justified_epoch": v.cur_just[0],
+                             "finalized_epoch": v.finalized[0],
+                             "head_root": self.view_heads[g].hex()[:16]}
+                            for g, v in enumerate(self.views)]
+        if self.monitors or self.adversaries:
+            out["monitor_violations"] = len(self.monitor_violations)
+            out["violation_kinds"] = sorted(
+                {v["kind"] for v in self.monitor_violations})
+        return out
 
     # -- checkpoint / resume (gather -> host -> re-shard) ----------------------
 
@@ -480,9 +857,11 @@ class DenseSimulation:
         (mesh shape, sharding) is deliberately NOT part of the format:
         ``resume`` re-places columns on whatever mesh it is given —
         checkpoint on 2x4, resume on 4x2/1x8/single-device, bit-identical
-        (tests/test_sharded_e2e.py pins the round trip). ``path``
-        additionally lands the bytes on disk atomically
-        (``utils/snapshot.atomic_write_bytes``)."""
+        (tests/test_sharded_e2e.py pins the round trip; the chaos
+        composition and every adversary's/monitor's mutable state ride
+        along, so a resume MID-ATTACK replays the identical episode —
+        tests/test_dense_chaos.py). ``path`` additionally lands the
+        bytes on disk atomically."""
         data = self._checkpoint_serialize(*self._checkpoint_capture())
         if path is not None:
             from pos_evolution_tpu.utils.snapshot import atomic_write_bytes
@@ -493,41 +872,81 @@ class DenseSimulation:
         """The device-synchronous half: JSON-able meta plus host copies
         of every sharded column (``parallel/sharded.host_gather``).
         Cheap relative to compression — this is all that runs on the
-        epoch loop's critical path in async autocheckpoint mode."""
+        epoch loop's critical path in async autocheckpoint mode.
+        Every mutable collection is COPIED, never referenced: in async
+        mode the writer thread serializes while the loop keeps mutating."""
+        from pos_evolution_tpu.parallel.sharded import host_gather
+        views_meta = []
+        cols: dict[str, np.ndarray] = {}
+        for g, view in enumerate(self.views):
+            prefix = "" if g == 0 else f"g{g}_"
+            vc = host_gather({f: getattr(view.registry, f)
+                              for f in view.registry._fields})
+            for f, a in vc.items():
+                cols[prefix + f] = a[: self.n]
+            cols[prefix + "msg_block"] = np.asarray(view.msg_block)[: self.n]
+            cols[prefix + "msg_epoch"] = np.asarray(view.msg_epoch)[: self.n]
+            pend_meta = []
+            for j, b in enumerate(view.pending):
+                cols[f"v{g}_pend{j}_idx"] = \
+                    np.flatnonzero(b.mask).astype(np.int64)
+                pend_meta.append({"block": int(b.block),
+                                  "epoch": int(b.epoch),
+                                  "flag": b.flag,
+                                  "faultable": bool(b.faultable)})
+            views_meta.append({
+                "bits": [bool(x) for x in view.bits],
+                "prev_just": list(view.prev_just),
+                "cur_just": list(view.cur_just),
+                "finalized": list(view.finalized),
+                "epoch_start_idx": {str(k): v for k, v
+                                    in view.epoch_start_idx.items()},
+                "vis": [bool(x) for x in
+                        view.vis_host[: len(self.roots)]],
+                "pending": pend_meta,
+            })
+        chaos = None
+        if self.fault_plan or self.adversaries or self.monitors:
+            for i, adv in enumerate(self.adversaries):
+                for name, arr in adv.state_arrays().items():
+                    cols[f"adv{i}_{name}"] = np.asarray(arr)
+            for i, mon in enumerate(self.monitors):
+                for name, arr in mon.state_arrays().items():
+                    cols[f"mon{i}_{name}"] = np.asarray(arr)
+            chaos = {
+                "faults": (self.fault_plan.describe()
+                           if self.fault_plan else None),
+                "adversaries": [{"config": a.describe(),
+                                 "state": a.state_meta()}
+                                for a in self.adversaries],
+                "monitors": [{"config": m.describe(),
+                              "state": m.state_meta()}
+                             for m in self.monitors],
+            }
         meta = {
-            "version": 1, "n": self.n, "seed": self.seed,
+            "version": 2, "n": self.n, "seed": self.seed,
             "shuffle_rounds": self.shuffle_rounds,
             "verify_aggregates": self.verify_aggregates,
             "capacity": self.capacity,
             "check_walk_every": self.check_walk_every,
+            "n_groups": self.n_groups,
             "cfg": {k: (["__bytes__", v.hex()] if isinstance(v, bytes) else v)
                     for k, v in dataclasses.asdict(self.cfg).items()},
             "slot": self.slot,
-            "bits": [bool(b) for b in self.bits],
-            "prev_just": list(self.prev_just),
-            "cur_just": list(self.cur_just),
-            "finalized": list(self.finalized),
-            "epoch_start_idx": {str(k): v
-                                for k, v in self.epoch_start_idx.items()},
-            # every mutable collection is COPIED here, not referenced:
-            # in async mode the writer thread serializes this meta while
-            # the loop keeps appending blocks — a live reference would
-            # tear the snapshot (roots of length B beside parents of
-            # length B+1, caught by the tier-1 suite under load)
+            "views": views_meta,
+            "pending_vis": [list(t) for t in self._pending_vis],
             "roots": [r.hex() for r in self.roots],
             "parents": list(self.parents),
             "block_slots": list(self.block_slots),
             "aggregates_verified": self.aggregates_verified,
             "walk_checks": [bool(b) for b in self.walk_checks],
-            "metrics": list(self.metrics),
+            "view_heads": [h.hex() for h in self.view_heads],
+            "metrics": [dict(m) for m in self.metrics],
             "epoch_ready": self._epoch_ready,
+            "chaos": chaos,
+            "monitor_violations": [dict(v)
+                                   for v in self.monitor_violations],
         }
-        from pos_evolution_tpu.parallel.sharded import host_gather
-        cols = host_gather({f: getattr(self.registry, f)
-                            for f in self.registry._fields})
-        cols = {f: a[: self.n] for f, a in cols.items()}
-        cols["msg_block"] = np.asarray(self.msg_block)[: self.n]
-        cols["msg_epoch"] = np.asarray(self.msg_epoch)[: self.n]
         if self._perm_host is not None:
             cols["perm"] = self._perm_host
         return meta, cols
@@ -551,31 +970,52 @@ class DenseSimulation:
         return lambda: self._checkpoint_serialize(meta, cols)
 
     @classmethod
-    def resume(cls, data: bytes, mesh=None) -> "DenseSimulation":
+    def resume(cls, data: bytes, mesh=None,
+               telemetry=None) -> "DenseSimulation":
+        from pos_evolution_tpu.sim.dense_adversary import (
+            VoteBatch,
+            dense_adversary_from_config,
+        )
+        from pos_evolution_tpu.sim.dense_monitors import (
+            dense_monitor_from_config,
+        )
+        from pos_evolution_tpu.sim.faults import DenseFaultPlan
         buf = io.BytesIO(data)
         (n_head,) = np.frombuffer(buf.read(8), dtype=np.uint64)
         meta = json.loads(buf.read(int(n_head)).decode())
-        assert meta["version"] == 1
+        assert meta["version"] in (1, 2), meta["version"]
+        v1 = meta["version"] == 1
         cfg = Config(**{
             k: (bytes.fromhex(v[1])
                 if isinstance(v, list) and len(v) == 2 and v[0] == "__bytes__"
                 else v)
             for k, v in meta["cfg"].items()})
+        chaos = None if v1 else meta.get("chaos")
+        fault_plan = adversaries = monitors = None
+        if chaos is not None:
+            fault_plan = DenseFaultPlan.from_config(chaos.get("faults"))
+            adversaries = [dense_adversary_from_config(a["config"])
+                           for a in chaos.get("adversaries", [])]
+            monitors = [dense_monitor_from_config(m["config"])
+                        for m in chaos.get("monitors", [])]
         sim = cls(meta["n"], cfg=cfg, mesh=mesh, seed=meta["seed"],
                   shuffle_rounds=meta["shuffle_rounds"],
                   verify_aggregates=meta["verify_aggregates"],
                   capacity=meta["capacity"],
-                  check_walk_every=meta["check_walk_every"])
+                  check_walk_every=meta["check_walk_every"],
+                  n_groups=meta.get("n_groups", 1),
+                  fault_plan=fault_plan,
+                  adversaries=adversaries or (),
+                  monitors=monitors or (), telemetry=telemetry)
+        views_meta = ([{
+            "bits": meta["bits"], "prev_just": meta["prev_just"],
+            "cur_just": meta["cur_just"], "finalized": meta["finalized"],
+            "epoch_start_idx": meta["epoch_start_idx"], "vis": None,
+            "pending": [],
+        }] if v1 else meta["views"])
         with np.load(buf) as z:
             from pos_evolution_tpu.ops.epoch import DenseRegistry
-            sim.registry = DenseRegistry(**{
-                f: sim._place_validator_col(z[f], f"registry/{f}")
-                for f in DenseRegistry._fields})
-            sim.msg_block = sim._place_validator_col(z["msg_block"],
-                                                     "messages/msg_block")
-            sim.msg_epoch = sim._place_validator_col(z["msg_epoch"],
-                                                     "messages/msg_epoch")
-            perm = z["perm"] if "perm" in z.files else None
+            arrays = {k: z[k] for k in z.files}
         sim.roots = [bytes.fromhex(r) for r in meta["roots"]]
         sim.parents = list(meta["parents"])
         sim.block_slots = list(meta["block_slots"])
@@ -594,23 +1034,64 @@ class DenseSimulation:
         sim._slot_d = jnp.asarray(slot)
         sim._rank_d = jnp.asarray(rank)
         sim._real_d = jnp.asarray(real)
+        for g, (view, vm) in enumerate(zip(sim.views, views_meta)):
+            prefix = "" if g == 0 else f"g{g}_"
+            view.registry = DenseRegistry(**{
+                f: sim._place_validator_col(arrays[prefix + f],
+                                            f"registry/{f}")
+                for f in DenseRegistry._fields})
+            view.msg_block = sim._place_validator_col(
+                arrays[prefix + "msg_block"], "messages/msg_block")
+            view.msg_epoch = sim._place_validator_col(
+                arrays[prefix + "msg_epoch"], "messages/msg_epoch")
+            view.bits = np.asarray(vm["bits"], dtype=bool)
+            view.prev_just = tuple(vm["prev_just"])
+            view.cur_just = tuple(vm["cur_just"])
+            view.finalized = tuple(vm["finalized"])
+            view.epoch_start_idx = {int(k): v for k, v
+                                    in vm["epoch_start_idx"].items()}
+            vis = np.zeros(sim.capacity, dtype=bool)
+            if vm["vis"] is None:
+                vis[:b] = True
+            else:
+                vis[:b] = np.asarray(vm["vis"], dtype=bool)
+            view.vis_host = vis
+            view.vis_d = jnp.asarray(vis)
+            view.pending = []
+            for j, pm in enumerate(vm.get("pending", [])):
+                mask = np.zeros(sim.n, dtype=bool)
+                mask[arrays[f"v{g}_pend{j}_idx"]] = True
+                view.pending.append(VoteBatch(
+                    mask, int(pm["block"]), int(pm["epoch"]), views=(g,),
+                    flag=pm.get("flag"),
+                    faultable=bool(pm.get("faultable", False))))
+        sim._pending_vis = [tuple(t) for t in meta.get("pending_vis", [])]
         sim.slot = meta["slot"]
-        sim.bits = np.asarray(meta["bits"], dtype=bool)
-        sim.prev_just = tuple(meta["prev_just"])
-        sim.cur_just = tuple(meta["cur_just"])
-        sim.finalized = tuple(meta["finalized"])
-        sim.epoch_start_idx = {int(k): v
-                               for k, v in meta["epoch_start_idx"].items()}
         sim.aggregates_verified = meta["aggregates_verified"]
         sim.walk_checks = list(meta["walk_checks"])
+        sim.view_heads = [bytes.fromhex(h)
+                          for h in meta.get("view_heads",
+                                            [""] * sim.n_groups)]
         sim.metrics = list(meta["metrics"])
         sim._epoch_ready = meta["epoch_ready"]
+        sim.monitor_violations = list(meta.get("monitor_violations", []))
+        if chaos is not None:
+            for i, (adv, am) in enumerate(zip(sim.adversaries,
+                                              chaos.get("adversaries", []))):
+                adv.restore_state(am.get("state", {}), {
+                    k[len(f"adv{i}_"):]: v for k, v in arrays.items()
+                    if k.startswith(f"adv{i}_")})
+            for i, (mon, mm) in enumerate(zip(sim.monitors,
+                                              chaos.get("monitors", []))):
+                mon.restore_state(mm.get("state", {}), {
+                    k[len(f"mon{i}_"):]: v for k, v in arrays.items()
+                    if k.startswith(f"mon{i}_")})
+        perm = arrays.get("perm")
         if perm is not None and sim._epoch_ready >= 0:
             sim._perm_host = perm.astype(np.int64)
             sim._inv_perm = np.argsort(sim._perm_host).astype(np.int64)
-            assigned = sim._perm_host * sim.S // sim.n
-            sim._assigned = sim._place_validator_col(
-                assigned.astype(np.int64))
+            sim._assigned_host = (sim._perm_host * sim.S
+                                  // sim.n).astype(np.int64)
         return sim
 
     # -- run supervision (resilience/, ISSUE 10) -------------------------------
@@ -660,6 +1141,23 @@ class DenseSimulation:
 
 
 _VOTE_KERNEL = None
+
+
+def _vote_kernel():
+    """Single-device twin of ``parallel/sharded.vote_apply_for``:
+    identical elementwise math, one jitted executable per process."""
+    global _VOTE_KERNEL
+    if _VOTE_KERNEL is None:
+        import jax
+        import jax.numpy as jnp
+
+        def kern(msg_block, msg_epoch, cur_flags, mask, idx, ep, flag_on):
+            return (jnp.where(mask, idx, msg_block),
+                    jnp.where(mask, ep, msg_epoch),
+                    jnp.where(mask & flag_on,
+                              cur_flags | np.uint8(7), cur_flags))
+        _VOTE_KERNEL = jax.jit(kern)
+    return _VOTE_KERNEL
 
 
 def _make_aggregates(pk_states, committees, bits, msg_words):

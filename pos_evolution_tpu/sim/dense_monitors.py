@@ -1,0 +1,311 @@
+"""Online property monitors for the dense driver (ISSUE 13).
+
+The spec monitors (``sim/monitors.py``) audit per-object stores: they
+wire-tap every attestation, feed a ``Slasher``, and walk checkpoint
+ancestry through the block archive. At 10^6 validators the same audit
+runs on **gathered tallies**: the monitors read the per-slot origination
+masks (bool[N] vote batches, BEFORE the fault masks — evidence of a
+violation can be observed by someone even when some recipients never
+see the message), accumulate the implicated double-voter set as one
+boolean column, and price it with the masked-stake tally kernel
+(``parallel/sharded.masked_stake_for`` on a mesh, its host twin on a
+single device — bit-identical either way).
+
+Classification is EXACTLY the spec monitors' rule:
+
+- ``DenseAccountableSafetyMonitor``: on conflicting finalized (or
+  same-epoch justified) checkpoints across views, evidence covering
+  >= 1/3 of genesis stake is the Casper FFG theorem holding — an
+  ``accountable_fault``, attributable to the attackers; anything less
+  is a genuine ``protocol_violation`` (the dense doctor forges exactly
+  this: conflicting finality with an empty evidence column).
+- ``DenseFinalityLivenessMonitor``: post-GST (and past every crash
+  window), with < 1/3 controlled, the best finalized epoch across
+  views must trail the current epoch by at most ``bound_epochs``;
+  loudly disarmed when the preconditions cannot hold (>= 1/3
+  controlled, faults with no GST, a fully partitioned network).
+- ``DenseForkChoiceParityMonitor``: the sharded device head must equal
+  the vectorized host spec-walk on every view — the
+  ``resident_head_equals_spec_walk`` pin promoted to a continuous
+  attack-time audit that yields violation dicts instead of a bool.
+
+Violations land on ``DenseSimulation.monitor_violations`` and as
+``monitor`` telemetry events, so ``scripts/run_report.py``'s property
+audit and ``scripts/chaos_fuzz.py``'s repro bundles work unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "DenseMonitor", "DenseAccountableSafetyMonitor",
+    "DenseFinalityLivenessMonitor", "DenseForkChoiceParityMonitor",
+    "default_dense_monitors", "dense_monitor_from_config",
+]
+
+
+class DenseMonitor:
+    """Base monitor: observes origination masks, checks once per slot."""
+
+    name = "monitor"
+
+    def bind(self, sim) -> None:
+        self.sim = sim
+
+    def describe(self) -> dict:
+        return {"kind": type(self).__name__}
+
+    def on_votes(self, sim, slot: int, originated: list) -> None:
+        """``originated``: [(view, VoteBatch), ...] — pre-fault masks."""
+
+    def on_slot_end(self, sim, slot: int) -> list[dict]:
+        return []
+
+    # checkpoint support (mirrors the adversary contract)
+    def state_meta(self) -> dict:
+        return {}
+
+    def state_arrays(self) -> dict:
+        return {}
+
+    def restore_state(self, meta: dict, arrays: dict) -> None:
+        pass
+
+
+class DenseAccountableSafetyMonitor(DenseMonitor):
+    """Safety auditor over the double-vote evidence column."""
+
+    name = "accountable_safety"
+
+    def bind(self, sim) -> None:
+        super().bind(sim)
+        self.implicated = np.zeros(sim.n, dtype=bool)
+        self._reported: set = set()
+
+    # -- observation -----------------------------------------------------------
+
+    def on_votes(self, sim, slot: int, originated: list) -> None:
+        """The FFG double-vote rule, vectorized: two origination masks
+        with the same target epoch and different target blocks overlap
+        only on equivocators — their intersection joins the evidence
+        column. O(batches^2) mask ANDs per slot with batches <= a
+        handful, each AND one O(N) vector op."""
+        for i in range(len(originated)):
+            for j in range(i + 1, len(originated)):
+                (_, a), (_, b) = originated[i], originated[j]
+                if a.epoch == b.epoch and a.block != b.block:
+                    both = a.mask & b.mask
+                    if both.any():
+                        self.implicated |= both
+
+    # -- per-slot check --------------------------------------------------------
+
+    def _conflicting(self, sim, ca: tuple, cb: tuple) -> bool:
+        (ea, ia), (eb, ib) = ca, cb
+        if ea == 0 or eb == 0:
+            return False        # genesis conflicts with nothing
+        if ea == eb:
+            return ia != ib
+        lo, hi = (ia, ib) if ea < eb else (ib, ia)
+        # ancestry over the shared block tree — the driver's own walk
+        return not sim._descends(hi, lo)
+
+    def on_slot_end(self, sim, slot: int) -> list[dict]:
+        out = []
+        views = sim.views
+        for i in range(len(views)):
+            for j in range(i + 1, len(views)):
+                vi, vj = views[i], views[j]
+                pairs = [("finalized", vi.finalized, vj.finalized),
+                         ("justified", vi.cur_just, vj.cur_just)]
+                for label, ca, cb in pairs:
+                    # conflicting *justified* checkpoints are slashable
+                    # only at the SAME epoch (2/3 + 2/3 overlap) —
+                    # exactly the spec monitor's rule
+                    if label == "justified" and ca[0] != cb[0]:
+                        continue
+                    if not self._conflicting(sim, ca, cb):
+                        continue
+                    key = (label, i, j, ca[0], ca[1], cb[0], cb[1])
+                    if key in self._reported:
+                        continue
+                    self._reported.add(key)
+                    stake = sim.stake_of(self.implicated)
+                    total = sim.total_stake
+                    accountable = 3 * stake >= total
+                    out.append({
+                        "monitor": self.name,
+                        "kind": ("accountable_fault" if accountable
+                                 else "protocol_violation"),
+                        "checkpoint": label,
+                        "groups": [i, j],
+                        "epochs": [int(ca[0]), int(cb[0])],
+                        "roots": [sim.roots[ca[1]].hex()[:16],
+                                  sim.roots[cb[1]].hex()[:16]],
+                        "evidence_size": int(self.implicated.sum()),
+                        "slashable_stake": int(stake),
+                        "total_stake": int(total),
+                        "detail": (
+                            f"conflicting {label} checkpoints between "
+                            f"views {i}/{j}; double-vote evidence covers "
+                            f"{stake}/{total} stake"
+                            + ("" if accountable else
+                               " — BELOW the 1/3 accountable-safety"
+                               " bound")),
+                    })
+        return out
+
+    def state_meta(self) -> dict:
+        return {"reported": [list(k) for k in sorted(self._reported)]}
+
+    def state_arrays(self) -> dict:
+        return {"implicated": self.implicated}
+
+    def restore_state(self, meta: dict, arrays: dict) -> None:
+        self.implicated = np.asarray(arrays["implicated"], dtype=bool).copy()
+        self._reported = {tuple(k) for k in meta.get("reported", [])}
+
+
+class DenseFinalityLivenessMonitor(DenseMonitor):
+    """Plausible-liveness auditor; disarmed (loudly, in ``describe``)
+    when the theorem's preconditions cannot hold."""
+
+    name = "finality_liveness"
+
+    def __init__(self, bound_epochs: int = 4,
+                 armed_after_epoch: int | None = None):
+        self.bound_epochs = int(bound_epochs)
+        self.armed_after_epoch = armed_after_epoch
+        self.disarmed_reason: str | None = None
+        self._worst_lag = 0
+
+    def describe(self) -> dict:
+        return {"kind": type(self).__name__,
+                "bound_epochs": self.bound_epochs,
+                "armed_after_epoch": self.armed_after_epoch,
+                "disarmed": self.disarmed_reason}
+
+    def bind(self, sim) -> None:
+        super().bind(sim)
+        n_controlled = int(sim.controlled_any.sum())
+        if 3 * n_controlled >= sim.n:
+            self.disarmed_reason = (f"{n_controlled}/{sim.n} controlled "
+                                    f">= 1/3: liveness not guaranteed")
+            return
+        plan = sim.fault_plan
+        if self.armed_after_epoch is not None:
+            return
+        armed = 0
+        if plan is not None:
+            if plan.partition == "full":
+                self.disarmed_reason = \
+                    "fully partitioned network: no synchrony to rely on"
+                return
+            if (plan.drop_p or plan.delay_p) and plan.gst_slot is None:
+                self.disarmed_reason = \
+                    "message faults with no GST: no synchrony to rely on"
+                return
+            if plan.gst_slot is not None:
+                armed = max(armed, -(-int(plan.gst_slot) // sim.S))
+            for w in plan.crashes:
+                armed = max(armed, -(-w.rejoin_slot // sim.S))
+        self.armed_after_epoch = armed
+
+    def on_slot_end(self, sim, slot: int) -> list[dict]:
+        if self.disarmed_reason is not None:
+            return []
+        epoch = slot // sim.S
+        if epoch < (self.armed_after_epoch or 0) + self.bound_epochs:
+            return []
+        best = max(v.finalized[0] for v in sim.views)
+        lag = epoch - best
+        if lag <= self.bound_epochs or lag <= self._worst_lag:
+            return []   # report once per lag level, not per stalled slot
+        self._worst_lag = lag
+        return [{
+            "monitor": self.name,
+            "kind": "liveness_violation",
+            "epoch": int(epoch),
+            "best_finalized_epoch": int(best),
+            "lag_epochs": int(lag),
+            "bound_epochs": self.bound_epochs,
+            "armed_after_epoch": self.armed_after_epoch,
+            "detail": (f"finality lag {lag} epochs > bound "
+                       f"{self.bound_epochs} at epoch {epoch} "
+                       f"(post-GST, < 1/3 controlled)"),
+        }]
+
+    def state_meta(self) -> dict:
+        return {"worst_lag": self._worst_lag,
+                "armed_after_epoch": self.armed_after_epoch,
+                "disarmed": self.disarmed_reason}
+
+    def restore_state(self, meta: dict, arrays: dict) -> None:
+        self._worst_lag = int(meta.get("worst_lag", 0))
+        self.armed_after_epoch = meta.get("armed_after_epoch")
+        self.disarmed_reason = meta.get("disarmed")
+
+
+class DenseForkChoiceParityMonitor(DenseMonitor):
+    """Device/host-walk head parity per view, under attack traffic."""
+
+    name = "forkchoice_parity"
+
+    def __init__(self, every: int = 1):
+        self.every = int(every)
+
+    def describe(self) -> dict:
+        return {"kind": type(self).__name__, "every": self.every}
+
+    def on_slot_end(self, sim, slot: int) -> list[dict]:
+        if self.every <= 0 or slot % self.every != 0:
+            return []
+        out = []
+        for g in range(sim.n_groups):
+            # a fresh POST-vote device head query (the proposed block is
+            # not the head when an attack reorgs mid-slot) vs the
+            # independent host walk over the gathered table
+            device = sim.roots[sim._head(g)]
+            walk = sim.head_host_walk(g)
+            if device != walk:
+                out.append({
+                    "monitor": self.name,
+                    "kind": "parity_violation",
+                    "group": g,
+                    "slot": int(slot),
+                    "device_head": device.hex()[:16],
+                    "spec_head": walk.hex()[:16],
+                    "detail": (f"view {g} device head diverged from the "
+                               f"host spec-walk at slot {slot}"),
+                })
+        return out
+
+
+def default_dense_monitors(bound_epochs: int = 4,
+                           parity_every: int = 1) -> list[DenseMonitor]:
+    """The full dense audit stack (dense chaos fuzzing default)."""
+    return [DenseAccountableSafetyMonitor(),
+            DenseFinalityLivenessMonitor(bound_epochs=bound_epochs),
+            DenseForkChoiceParityMonitor(every=parity_every)]
+
+
+_MONITORS = {
+    "DenseAccountableSafetyMonitor": DenseAccountableSafetyMonitor,
+    "DenseFinalityLivenessMonitor": DenseFinalityLivenessMonitor,
+    "DenseForkChoiceParityMonitor": DenseForkChoiceParityMonitor,
+}
+
+
+def dense_monitor_from_config(d: dict) -> DenseMonitor:
+    """Rebuild a monitor from its ``describe()`` dict."""
+    kind = d["kind"]
+    cls = _MONITORS.get(kind)
+    if cls is None:
+        raise ValueError(f"unknown dense monitor kind {kind!r}")
+    if kind == "DenseFinalityLivenessMonitor":
+        return cls(bound_epochs=d.get("bound_epochs", 4),
+                   armed_after_epoch=d.get("armed_after_epoch"))
+    if kind == "DenseForkChoiceParityMonitor":
+        return cls(every=d.get("every", 1))
+    return cls()

@@ -31,6 +31,8 @@ import hashlib
 import struct
 from dataclasses import dataclass, field
 
+import numpy as np
+
 # integer tags for message kinds (stable fault-decision identity)
 _KIND_TAG = {"block": 0, "attestation": 1, "slashing": 2}
 
@@ -47,6 +49,42 @@ def stateless_unit(seed: int, *key: int) -> float:
         struct.pack(f"<{len(key) + 1}q", seed, *key),
         digest_size=8).digest()
     return int.from_bytes(h, "little") / 2.0**64
+
+
+def stateless_word(seed: int, *key: int) -> int:
+    """The raw 64-bit word behind ``stateless_unit`` — the full-entropy
+    form used to key *vectorized* draws (``stateless_unit_array``): one
+    blake2b of the identity seeds a whole axis worth of decisions."""
+    h = hashlib.blake2b(
+        struct.pack(f"<{len(key) + 1}q", seed, *key),
+        digest_size=8).digest()
+    return int.from_bytes(h, "little")
+
+
+# splitmix64 constants (Steele et al.) — the per-index expansion of one
+# stateless_word over a validator axis. Pure uint64 numpy arithmetic:
+# identical bytes on every backend and every mesh shape (the masks are
+# computed replicated on host and only then placed on devices).
+_SM_GAMMA = np.uint64(0x9E3779B97F4A7C15)
+_SM_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_SM_M2 = np.uint64(0x94D049BB133111EB)
+
+
+def stateless_unit_array(seed: int, *key: int, n: int) -> np.ndarray:
+    """Vectorized ``stateless_unit``: uniform [0, 1) per index 0..n-1,
+    derived by expanding one ``stateless_word(seed, *key)`` with a
+    splitmix64 finalizer over the index axis. No RNG cursor, no
+    call-order dependence — the dense drivers' per-(slot, validator)
+    fault and adversary decisions are a pure function of the identity,
+    byte-stable across checkpoint/resume, mesh shapes, and backends
+    (pinned in tests/test_dense_chaos.py)."""
+    base = np.uint64(stateless_word(seed, *key))
+    with np.errstate(over="ignore"):
+        z = base + np.arange(1, n + 1, dtype=np.uint64) * _SM_GAMMA
+        z = (z ^ (z >> np.uint64(30))) * _SM_M1
+        z = (z ^ (z >> np.uint64(27))) * _SM_M2
+        z = z ^ (z >> np.uint64(31))
+    return z.astype(np.float64) / 2.0**64
 
 
 @dataclass(frozen=True)
@@ -197,3 +235,117 @@ def chaos_plan(seed: int = 0, drop_p: float = 0.05, duplicate_p: float = 0.05,
     """Drops + duplicates + reorders + optional crash windows."""
     return FaultPlan(seed=seed, drop_p=drop_p, duplicate_p=duplicate_p,
                      reorder_p=reorder_p, gst=gst, crashes=tuple(crashes))
+
+
+# --- dense (array-level) fault plans ------------------------------------------
+#
+# The spec FaultPlan above decides fates per MESSAGE, which is the right
+# granularity for the per-object driver and hopeless at 10^6 validators.
+# The dense form (ISSUE 13) is the same adversary expressed as masks over
+# the validator axis: per (slot, view, validator) drop/delay decisions
+# from ``stateless_unit_array``, index-range crash blackouts as pure
+# functions of the slot, and the view partition as data. The masks are
+# ANDed into the sharded vote pass (sim/dense_driver.py), with
+# padded-inert semantics: an all-pass mask is bit-identical to no mask.
+
+# stateless_unit_array decision domains (dense plans)
+_D_DENSE_DROP, _D_DENSE_DELAY = 20, 21
+
+
+@dataclass(frozen=True)
+class DenseCrashWindow:
+    """Validators [lo, hi) are down for slots [crash_slot, rejoin_slot):
+    they cast nothing (their in-flight votes are the masks that never
+    apply) and resume duty at ``rejoin_slot``. A pure function of the
+    slot — no crash state to checkpoint, exactly like ``CrashWindow``."""
+
+    lo: int
+    hi: int
+    crash_slot: int
+    rejoin_slot: int
+
+    def __post_init__(self):
+        assert self.lo < self.hi, "empty validator range"
+        assert self.crash_slot < self.rejoin_slot, "empty crash window"
+
+
+@dataclass(frozen=True)
+class DenseFaultPlan:
+    """Composable fault masks for the dense driver.
+
+    - ``drop_p`` / ``delay_p``: per-(slot, view, validator) stateless
+      draws; a dropped vote never lands, a delayed one lands at the next
+      slot (before that slot's fresh votes, so LMD latest-wins holds);
+    - ``gst_slot``: message faults switch off from this slot on (the
+      partial-synchrony window of pos-evolution.md:197-199);
+    - ``crashes``: index-range blackouts (``DenseCrashWindow``);
+    - ``partition``: cross-view delivery for multi-view runs — ``None``
+      (single view), ``"full"`` (views never exchange traffic: the
+      SplitVoter network), or ``"delay"`` (cross-view blocks and votes
+      land one slot late: the Balancer network).
+    """
+
+    seed: int = 0
+    drop_p: float = 0.0
+    delay_p: float = 0.0
+    gst_slot: int | None = None
+    crashes: tuple = ()
+    partition: str | None = None
+
+    def __post_init__(self):
+        assert self.partition in (None, "full", "delay"), self.partition
+
+    def active(self, slot: int) -> bool:
+        """Message faults apply only before GST."""
+        return self.gst_slot is None or slot < self.gst_slot
+
+    def delivery_masks(self, slot: int, view: int,
+                       n: int) -> tuple[np.ndarray, np.ndarray]:
+        """(dropped, delayed) bool[n] for one (slot, view): disjoint —
+        a vote is dropped, delayed, or delivered. All-False past GST."""
+        if not self.active(slot) or (self.drop_p <= 0 and self.delay_p <= 0):
+            z = np.zeros(n, dtype=bool)
+            return z, z
+        dropped = np.zeros(n, dtype=bool)
+        delayed = np.zeros(n, dtype=bool)
+        if self.drop_p > 0:
+            u = stateless_unit_array(self.seed, _D_DENSE_DROP, slot, view,
+                                     n=n)
+            dropped = u < self.drop_p
+        if self.delay_p > 0:
+            u = stateless_unit_array(self.seed, _D_DENSE_DELAY, slot, view,
+                                     n=n)
+            delayed = (u < self.delay_p) & ~dropped
+        return dropped, delayed
+
+    def crashed_mask(self, slot: int, n: int) -> np.ndarray:
+        """bool[n]: validators blacked out at ``slot``."""
+        out = np.zeros(n, dtype=bool)
+        for w in self.crashes:
+            if w.crash_slot <= slot < w.rejoin_slot:
+                out[w.lo:min(w.hi, n)] = True
+        return out
+
+    def describe(self) -> dict:
+        """Config fingerprint for dense checkpoints and repro bundles."""
+        return {
+            "kind": type(self).__name__, "seed": self.seed,
+            "drop_p": self.drop_p, "delay_p": self.delay_p,
+            "gst_slot": self.gst_slot, "partition": self.partition,
+            "crashes": [{"lo": w.lo, "hi": w.hi,
+                         "crash_slot": w.crash_slot,
+                         "rejoin_slot": w.rejoin_slot}
+                        for w in self.crashes],
+        }
+
+    @classmethod
+    def from_config(cls, d: dict | None) -> "DenseFaultPlan | None":
+        if d is None:
+            return None
+        return cls(seed=d.get("seed", 0), drop_p=d.get("drop_p", 0.0),
+                   delay_p=d.get("delay_p", 0.0),
+                   gst_slot=d.get("gst_slot"),
+                   partition=d.get("partition"),
+                   crashes=tuple(DenseCrashWindow(
+                       w["lo"], w["hi"], w["crash_slot"], w["rejoin_slot"])
+                       for w in d.get("crashes", ())))

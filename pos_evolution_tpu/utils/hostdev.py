@@ -1,0 +1,33 @@
+"""Virtual host-device re-exec for sharded demo/CLI entry points.
+
+XLA fixes the CPU device count at jax import time
+(``--xla_force_host_platform_device_count``), so a script that wants an
+N-device virtual mesh must set the flag BEFORE importing jax — which
+means restarting itself once with the right environment. Three scripts
+grew identical copies of this dance (multichip_demo, dense_chaos_demo,
+chaos_fuzz --dense); this is the one shared implementation, with the
+child-guard env var as the only per-caller knob.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["reexec_with_host_devices"]
+
+
+def reexec_with_host_devices(n_devices: int, guard_env: str) -> None:
+    """Re-exec the current process pinned to CPU with ``n_devices``
+    virtual host devices, unless ``guard_env`` marks us as the child
+    already. Never returns in the parent (``os.execve`` replaces it)."""
+    if os.environ.get(guard_env) == "1":
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags
+                 + f" --xla_force_host_platform_device_count={n_devices}"
+                 ).strip()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=flags)
+    env[guard_env] = "1"
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)

@@ -62,13 +62,14 @@ SCHEMA = 1
 
 # stateless_unit decision domains for episode composition
 _D_FAULTS, _D_CRASH, _D_STRAT, _D_PARAM = 10, 11, 12, 13
+_D_DENSE, _D_SERVE = 14, 15
 
 
 # -- episode composition (pure function of seed + episode index) ---------------
 
 def episode_config(seed: int, episode: int, n_validators: int = 64,
                    n_slots: int = 24, doctor: bool = False,
-                   variant: str = "gasper") -> dict:
+                   variant: str = "gasper", serve: bool = False) -> dict:
     """Derive one episode's full composition from (seed, episode) alone
     (the protocol variant is part of the composition: every episode
     replays under the variant that produced it)."""
@@ -155,6 +156,22 @@ def episode_config(seed: int, episode: int, n_validators: int = 64,
             })
             cursor += k
     cfg["adversaries"] = adversaries
+    if serve:
+        # serve x chaos composition (ISSUE 13 satellite / ROADMAP item 3
+        # remainder): the episode carries a live socket front + an
+        # open-loop load generator with REMOTE target discovery, so
+        # adversarial chain conditions and serving overload compose;
+        # the SLO/goodput outcome joins the episode verdict
+        patterns = ("uniform", "bursty", "hotspot")
+        cfg["serve"] = {
+            "arrivals": 800 + int(u(_D_SERVE, 0) * 800),
+            "rate": 300.0 + round(u(_D_SERVE, 1) * 300.0, 1),
+            "pattern": patterns[int(u(_D_SERVE, 2) * len(patterns))
+                                % len(patterns)],
+            "bulk_fraction": 0.6,
+            "workers": 2,
+            "slo_ms": 250.0,
+        }
     if doctor:
         # strictly after every crash window's rejoin (rejoin <= n_slots-3
         # by construction above): a rejoin checkpoint-syncs a fresh store
@@ -277,6 +294,12 @@ def run_episode(cfg: dict, events_path: str | None = None,
     monitors = build_monitors(cfg)
     schedule = build_schedule(cfg)
     variant = variant_from_config(cfg.get("variant"))
+    serve_cfg = cfg.get("serve") if resume_from is None else None
+    serve_state = front = loader = None
+    serve_out = None
+    if serve_cfg is not None:
+        from pos_evolution_tpu.serve import ServingState
+        serve_state = ServingState()
     try:
         if resume_from is not None:
             sim = Simulation.resume(resume_from, schedule=schedule,
@@ -287,11 +310,16 @@ def run_episode(cfg: dict, events_path: str | None = None,
         else:
             sim = Simulation(cfg["n_validators"], schedule=schedule,
                              telemetry=telemetry, adversaries=adversaries,
-                             monitors=monitors, variant=variant)
+                             monitors=monitors, variant=variant,
+                             das=True if serve_cfg else None,
+                             serve=serve_state)
             checkpoint = sim.checkpoint()
         if bundle_dir is not None:
             atomic_write_bytes(os.path.join(bundle_dir, "checkpoint.bin"),
                                checkpoint)
+        if serve_cfg is not None:
+            front, loader = _start_serve(sim, serve_state, serve_cfg,
+                                         telemetry)
         doctor = cfg.get("doctor")
         while sim.slot <= cfg["n_slots"]:
             sim.run_slot()
@@ -303,27 +331,409 @@ def run_episode(cfg: dict, events_path: str | None = None,
                 # AccountableSafetyMonitor must catch under EVERY variant
                 if not sim.variant.doctor(sim, doctor["slot"]):
                     _doctor_stores(sim, doctor["epoch"])
+        if front is not None:
+            serve_out = _finish_serve(front, loader, serve_cfg, telemetry)
     finally:
+        if front is not None:
+            front.stop()
         # a crashed episode must not leak the JSONL handle (the partial
         # log itself is the caller's to keep or remove)
         if telemetry is not None:
             telemetry.close()
-    return {
+    out = {
         "violations": sim.monitor_violations,
         "finalized": [sim.finalized_epoch(g)
                       for g in range(len(sim.groups))],
         "checkpoint": checkpoint,
     }
+    if serve_out is not None:
+        out["serve"] = serve_out
+    return out
+
+
+def _start_serve(sim, serve_state, serve_cfg, telemetry):
+    """Attach the socket front + remote-discovery open-loop loadgen to a
+    running episode: the generator learns its targets from the front's
+    own head/finality RPCs (``serve/loadgen.discover_targets``) — it
+    drives a front it did not build, under whatever chain conditions the
+    episode's adversaries and faults produce."""
+    import threading
+
+    from pos_evolution_tpu.serve import LoadGenerator, ServeFront
+    from pos_evolution_tpu.telemetry.registry import MetricsRegistry
+    front = ServeFront(serve_state, scheme=sim.das.scheme,
+                       registry=MetricsRegistry(),
+                       workers=serve_cfg.get("workers", 2))
+    addr = front.start()
+    lg = LoadGenerator(
+        addr, serve_cfg["arrivals"], serve_cfg["rate"],
+        pattern=serve_cfg.get("pattern", "uniform"),
+        seed=serve_cfg.get("seed", 0),
+        bulk_fraction=serve_cfg.get("bulk_fraction", 0.6),
+        client_threads=24, discover=True)
+    thread = threading.Thread(target=lg.run, name="chaos-serve-load",
+                              daemon=True)
+    if telemetry is not None:
+        telemetry.bus.emit("serve_attach", workers=front.workers,
+                           pattern=lg.pattern, arrivals=lg.n,
+                           rate=lg.rate, chaos="episode")
+    thread.start()
+    return front, (lg, thread)
+
+
+def _finish_serve(front, loader, serve_cfg, telemetry):
+    """Join the loadgen, collect the SLO/goodput verdict for the
+    episode. Wrong proofs are a hard failure; latency/goodput are
+    recorded (CI wall-clock is noisy — the SLO verdict is part of the
+    episode record, the verification count is the gate)."""
+    lg, thread = loader
+    thread.join(timeout=120.0)
+    load = lg.summary()
+    server = front.summary()
+    inter = load["tiers"]["interactive"]
+    slo_ms = serve_cfg.get("slo_ms", 250.0)
+    verdict = {
+        "arrivals": load["arrivals"],
+        "interactive_goodput_pct": inter["goodput_pct"],
+        "bulk_goodput_pct": load["tiers"]["bulk"]["goodput_pct"],
+        "interactive_p99_ms": inter["p99_ms"],
+        "slo_ms": slo_ms,
+        "slo_ok": (inter["p99_ms"] is not None
+                   and inter["p99_ms"] <= slo_ms),
+        "verified_proofs": load["verified_proofs"],
+        "verify_failures": load["verify_failures"],
+        "remote_discovery": load.get("remote_discovery"),
+        "shed_by_reason": server.get("shed_by_reason"),
+    }
+    if telemetry is not None:
+        telemetry.bus.emit("serve_summary", server=server, load=load,
+                           slo_ms=slo_ms, slo_ok=verdict["slo_ok"])
+    return verdict
+
+
+# -- dense episodes (ISSUE 13: chaos at mainnet scale) -------------------------
+
+_DENSE_SCENARIOS = ("equivocator_faulted", "withholder", "splitvoter",
+                    "balancer")
+
+
+def episode_config_dense(seed: int, episode: int, n_validators: int = 576,
+                         n_epochs: int = 4, slots_per_epoch: int = 8,
+                         mesh: str | None = None, doctor: bool = False,
+                         scenario: str | None = None) -> dict:
+    """One DENSE episode's composition from (seed, episode) alone: a
+    scenario (which vectorized strategy + network shape), a seeded
+    ``DenseFaultPlan``, and the expectation the verdict is judged
+    against. ``n_validators`` should divide by 24 (mesh divisibility x
+    the exactly-1/3 SplitVoter split)."""
+    u = lambda dom, k: stateless_unit(seed, dom, episode, k)  # noqa: E731
+    n = int(n_validators)
+    n_slots = n_epochs * slots_per_epoch
+    if scenario is None:
+        r = u(_D_DENSE, 0)
+        scenario = _DENSE_SCENARIOS[min(int(r * 4), 3)]
+    if doctor:
+        scenario = "doctor"
+    two_view = scenario in ("splitvoter", "balancer", "doctor")
+    faults: dict = {"seed": int(seed) * 1_000_003 + episode}
+    adversaries: list = []
+    expect: dict = {"clean": True}
+    if scenario == "equivocator_faulted":
+        gst = max(2, n_slots // 3)
+        faults.update(drop_p=round(u(_D_DENSE, 1) * 0.12, 4),
+                      delay_p=round(u(_D_DENSE, 2) * 0.10, 4),
+                      gst_slot=gst)
+        if u(_D_DENSE, 3) < 0.5:
+            lo = int(u(_D_DENSE, 4) * (n // 2))
+            hi = min(n, lo + max(n // 16, 1))
+            faults["crashes"] = [{"lo": lo, "hi": hi, "crash_slot": 2,
+                                  "rejoin_slot": 2 + slots_per_epoch}]
+        k = max(n // 16, 4) + int(u(_D_DENSE, 5) * (n // 8))
+        adversaries.append({"kind": "DenseEquivocator",
+                            "controlled": [[0, min(k, n // 3 - 1)]],
+                            "p_fork": round(0.3 + u(_D_DENSE, 6) * 0.4, 4),
+                            "seed": int(seed) * 7_919 + episode})
+    elif scenario == "withholder":
+        fork = 2 + int(u(_D_DENSE, 1) * slots_per_epoch)
+        span = 2 + int(u(_D_DENSE, 2) * 3)
+        k = max(n // 16, 4) + int(u(_D_DENSE, 3) * (n // 8))
+        adversaries.append({"kind": "DenseWithholder",
+                            "controlled": [[0, min(k, n // 3 - 1)]],
+                            "fork_slot": fork,
+                            "release_slot": min(fork + span, n_slots - 2)})
+    elif scenario == "splitvoter":
+        faults["partition"] = "full"
+        adversaries.append({"kind": "DenseSplitVoter",
+                            "controlled": [[0, n // 3]]})
+        # the attack MUST reproduce: double finality, accountable,
+        # evidence pinned at exactly 1/3 of stake
+        expect = {"clean": False, "accountable_double_finality": True,
+                  "exact_third": True}
+    elif scenario == "balancer":
+        faults["partition"] = "delay"
+        # strictly below 1/3 so the liveness monitor stays armed
+        adversaries.append({"kind": "DenseBalancer",
+                            "controlled": [[0, (n * 5) // 16]]})
+        expect = {"clean": False, "liveness_stall": True}
+    else:   # doctor: honest partitioned pair + forged double finality
+        faults["partition"] = "full"
+        expect = {"clean": False, "protocol_violation": True}
+    return {
+        "schema": SCHEMA, "dense": True,
+        "seed": int(seed), "episode": int(episode),
+        "n_validators": n, "n_epochs": int(n_epochs),
+        "slots_per_epoch": int(slots_per_epoch),
+        "n_groups": 2 if two_view else 1,
+        "mesh": mesh, "scenario": scenario,
+        "faults": faults, "adversaries": adversaries,
+        "monitors": {"bound_epochs": 2 if scenario == "balancer" else 4,
+                     "parity_every": 2},
+        "expect": expect,
+        "doctor": ({"slot": n_slots - 2} if doctor else None),
+    }
+
+
+def _dense_mesh(spec: str | None):
+    if not spec:
+        return None
+    import jax
+
+    from pos_evolution_tpu.parallel.sharded import make_mesh
+    pods, shard = (int(x) for x in spec.lower().split("x"))
+    if len(jax.devices()) < pods * shard:
+        # the run is bit-identical on any layout, so falling back is
+        # semantically safe — but the operator asked for the SHARDED
+        # code path, so say that it was not exercised
+        print(f"chaos_fuzz: mesh {spec} needs {pods * shard} devices, "
+              f"only {len(jax.devices())} present — running this "
+              f"episode single-device (bit-identical results, sharded "
+              f"path NOT exercised)", file=sys.stderr)
+        return None
+    return make_mesh(pods * shard, pods)
+
+
+def _doctor_dense(sim) -> None:
+    """Forge conflicting finalized checkpoints into the two dense views
+    with NO double-vote evidence behind them: the
+    ``DenseAccountableSafetyMonitor`` must classify the break as a
+    ``protocol_violation`` (the CI negative at the dense tier)."""
+    epoch = sim.slot // sim.S
+    tips = [i for i in range(len(sim.roots))
+            if sim.block_slots[i] == sim.slot]
+    assert len(tips) >= 2, "dense doctor needs the two views' tip blocks"
+    sim.views[0].finalized = (epoch, tips[0])
+    sim.views[1].finalized = (epoch, tips[1])
+
+
+def run_dense_episode(cfg: dict, events_path: str | None = None,
+                      resume_from: bytes | None = None,
+                      bundle_dir: str | None = None) -> dict:
+    """Run one dense episode; same bundle/replay shape as
+    ``run_episode``. ``resume_from`` replays from the bundle's
+    episode-start checkpoint via ``DenseSimulation.resume`` — the
+    checkpoint carries the full chaos composition + adversary/monitor
+    state in-band, and the run is bit-identical on ANY mesh layout, so
+    a 2x4 bundle replays exactly on a single device."""
+    from pos_evolution_tpu.config import mainnet_config
+    from pos_evolution_tpu.sim.dense_adversary import (
+        dense_adversary_from_config,
+    )
+    from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+    from pos_evolution_tpu.sim.dense_monitors import default_dense_monitors
+    from pos_evolution_tpu.sim.faults import DenseFaultPlan
+    from pos_evolution_tpu.telemetry import Telemetry
+    from pos_evolution_tpu.utils.snapshot import atomic_write_bytes
+
+    if bundle_dir is not None:
+        os.makedirs(bundle_dir, exist_ok=True)
+        atomic_write_bytes(
+            os.path.join(bundle_dir, "config.json"),
+            (json.dumps(cfg, indent=1, sort_keys=True) + "\n").encode())
+        if events_path is None:
+            events_path = os.path.join(bundle_dir, "events.jsonl")
+    telemetry = (Telemetry.to_file(events_path)
+                 if events_path is not None else None)
+    mesh = _dense_mesh(cfg.get("mesh"))
+    n_slots = cfg["n_epochs"] * cfg["slots_per_epoch"]
+    try:
+        if resume_from is not None:
+            sim = DenseSimulation.resume(resume_from, mesh=mesh,
+                                         telemetry=telemetry)
+            checkpoint = resume_from
+        else:
+            cfg_obj = mainnet_config().replace(
+                slots_per_epoch=cfg["slots_per_epoch"],
+                max_committees_per_slot=4)
+            m = cfg.get("monitors", {})
+            sim = DenseSimulation(
+                cfg["n_validators"], cfg=cfg_obj, mesh=mesh,
+                seed=cfg["seed"] * 101 + cfg["episode"],
+                shuffle_rounds=6, verify_aggregates=False,
+                check_walk_every=0,
+                n_groups=cfg.get("n_groups", 1),
+                fault_plan=DenseFaultPlan.from_config(cfg.get("faults")),
+                adversaries=[dense_adversary_from_config(a)
+                             for a in cfg.get("adversaries", ())],
+                monitors=default_dense_monitors(
+                    bound_epochs=m.get("bound_epochs", 4),
+                    parity_every=m.get("parity_every", 2)),
+                telemetry=telemetry)
+            checkpoint = sim.checkpoint()
+        if bundle_dir is not None:
+            atomic_write_bytes(os.path.join(bundle_dir, "checkpoint.bin"),
+                               checkpoint)
+        doctor = cfg.get("doctor")
+        while sim.slot < n_slots:
+            sim.run_slot()
+            if doctor is not None and sim.slot == doctor["slot"]:
+                _doctor_dense(sim)
+    finally:
+        if telemetry is not None:
+            telemetry.close()
+    summary = sim.summary()
+    result = {
+        "violations": sim.monitor_violations,
+        "finalized": [v.finalized[0] for v in sim.views],
+        "checkpoint": checkpoint,
+        "summary": summary,
+    }
+    result.update(_dense_expectations(cfg, result))
+    return result
+
+
+def _dense_expectations(cfg: dict, result: dict) -> dict:
+    """Judge an episode against its scenario's expectation: unexpected
+    violations fail it, and so does a scripted attack that did NOT
+    reproduce (a SplitVoter run without accountable double finality
+    verified nothing)."""
+    expect = cfg.get("expect", {"clean": True})
+    violations = result["violations"]
+    explained_kinds = {"accountable_fault"}
+    if expect.get("liveness_stall"):
+        explained_kinds.add("liveness_violation")
+    if expect.get("protocol_violation"):
+        explained_kinds.add("protocol_violation")
+    unexpected = [v for v in violations
+                  if v.get("kind") not in explained_kinds]
+    missed = []
+    if expect.get("accountable_double_finality"):
+        fin = [v for v in violations
+               if v.get("kind") == "accountable_fault"
+               and v.get("checkpoint") == "finalized"]
+        if not fin:
+            missed.append("accountable_double_finality")
+        elif expect.get("exact_third") and not any(
+                3 * v["slashable_stake"] == v["total_stake"] for v in fin):
+            missed.append("evidence_exactly_one_third")
+    if expect.get("liveness_stall"):
+        if not any(v.get("kind") == "liveness_violation"
+                   for v in violations):
+            missed.append("liveness_stall")
+        if any(g["justified_epoch"] > 0
+               for g in result["summary"].get("views", [])):
+            missed.append("justification_not_stalled")
+    if expect.get("protocol_violation") and not any(
+            v.get("kind") == "protocol_violation" for v in violations):
+        missed.append("protocol_violation_not_tripped")
+    if expect.get("clean") and not result["summary"]["finality_reached"]:
+        missed.append("finality_not_reached")
+    return {"unexpected": unexpected, "missed": missed}
+
+
+def fuzz_dense(episodes: int, seed: int, n_validators: int, n_epochs: int,
+               out_dir: str, mesh: str | None = None, doctor: bool = False,
+               step_timeout: float | None = None,
+               history: str | None = None) -> dict:
+    """The dense episode matrix: every episode is a sharded adversarial
+    run with the full dense monitor stack; bundles are replayable via
+    ``--replay`` exactly like spec bundles."""
+    import time as _time
+
+    from pos_evolution_tpu.utils.watchdog import Watchdog
+    os.makedirs(out_dir, exist_ok=True)
+    wd = Watchdog(path=os.path.join(out_dir, "chaos_partial.json"),
+                  tag="chaos_fuzz_dense", timeout_s=step_timeout)
+    summary = {"mode": "dense", "episodes": 0, "violating": 0,
+               "bundles": [], "incidents": 0, "accountable": 0,
+               "scenarios": {}}
+    t0 = _time.time()
+    n_blocks = n_slots_total = n_violations = 0
+    for ep in range(episodes):
+        cfg = episode_config_dense(seed, ep, n_validators, n_epochs,
+                                   mesh=mesh, doctor=doctor)
+        inflight = os.path.join(out_dir, f"inflight_ep{ep}")
+        result = wd.step(f"dense_episode_{ep}", run_dense_episode, cfg,
+                         bundle_dir=inflight)
+        summary["episodes"] += 1
+        sc = cfg["scenario"]
+        summary["scenarios"][sc] = summary["scenarios"].get(sc, 0) + 1
+        if result is None:
+            summary["incidents"] += 1
+            summary.setdefault("inflight", []).append(inflight)
+            print(f"dense episode {ep} ({sc}): DIED mid-run — partial "
+                  f"bundle kept at {inflight} (replay with "
+                  f"--resume-bundle)")
+            continue
+        n_blocks += result["summary"]["n_blocks"]
+        n_slots_total += result["summary"]["slots"]
+        n_violations += len(result["violations"])
+        bad = result["unexpected"] or result["missed"]
+        if result["violations"] or bad:
+            bundle = write_bundle(out_dir, cfg, result, do_shrink=bool(bad),
+                                  inflight_dir=inflight)
+            summary["bundles"].append(bundle)
+        if bad:
+            summary["violating"] += 1
+            print(f"dense episode {ep} ({sc}): "
+                  f"{len(result['unexpected'])} unexpected violation(s), "
+                  f"missed={result['missed']} -> {bundle}")
+        elif result["violations"]:
+            summary["accountable"] += 1
+            print(f"dense episode {ep} ({sc}): "
+                  f"{len(result['violations'])} expected/accountable "
+                  f"verdict(s), evidence bundled -> {bundle}")
+        else:
+            shutil.rmtree(inflight, ignore_errors=True)
+            print(f"dense episode {ep} ({sc}): clean "
+                  f"(finalized={result['finalized']})")
+    summary["run_s"] = round(_time.time() - t0, 3)
+    if history:
+        from pos_evolution_tpu.profiling import history as hist
+        emission = {
+            "metric": "dense_chaos",
+            "run_s": summary["run_s"],
+            "counts": {
+                "episodes": summary["episodes"],
+                "slots": n_slots_total,
+                "blocks": n_blocks,
+                "violations": n_violations,
+                "violating_episodes": summary["violating"],
+            },
+        }
+        hist.append_entry(history, emission, kind="bench_dense_chaos")
+        summary["history"] = history
+    return summary
+
+
+def _run_any(cfg: dict, **kw) -> dict:
+    """Dispatch an episode config to the spec or dense runner (the
+    shrink pass and bundle replay are shape-agnostic)."""
+    if cfg.get("dense"):
+        return run_dense_episode(cfg, **kw)
+    return run_episode(cfg, **kw)
 
 
 # -- shrink --------------------------------------------------------------------
 
 def _components(cfg: dict) -> list[tuple[str, object]]:
-    """Every independently removable piece of a composition."""
+    """Every independently removable piece of a composition (spec and
+    dense configs share the shape; dense adds ``delay_p``)."""
     out = [("adversary", i) for i in range(len(cfg["adversaries"]))]
-    out += [("fault", k) for k in ("drop_p", "duplicate_p", "reorder_p")
-            if cfg["faults"][k] > 0]
-    out += [("crash", i) for i in range(len(cfg["faults"]["crashes"]))]
+    out += [("fault", k)
+            for k in ("drop_p", "duplicate_p", "reorder_p", "delay_p")
+            if cfg["faults"].get(k, 0) > 0]
+    out += [("crash", i)
+            for i in range(len(cfg["faults"].get("crashes", ())))]
     return out
 
 
@@ -357,7 +767,7 @@ def shrink(cfg: dict, reference_violation: dict) -> tuple[dict, list[dict]]:
         progress = False
         for comp in _components(current):
             candidate = _without(current, comp)
-            result = run_episode(candidate)
+            result = _run_any(candidate)
             ok = _same_violation(result["violations"], reference_violation)
             log.append({"removed": list(comp), "still_violates": ok,
                         "n_components": len(_components(candidate))})
@@ -434,7 +844,7 @@ def replay_bundle(bundle: str) -> dict:
     if os.path.exists(vpath):
         with open(vpath) as fh:
             recorded = json.load(fh)
-    result = run_episode(cfg, resume_from=checkpoint)
+    result = _run_any(cfg, resume_from=checkpoint)
     key = lambda v: (v["slot"], v["monitor"], v["kind"])  # noqa: E731
     match = (None if recorded is None else
              sorted(map(key, result["violations"]))
@@ -449,7 +859,7 @@ def replay_bundle(bundle: str) -> dict:
 def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
          out_dir: str, doctor: bool = False, do_shrink: bool = True,
          step_timeout: float | None = None, episode_indices=None,
-         variant: str = "gasper") -> dict:
+         variant: str = "gasper", serve: bool = False) -> dict:
     from pos_evolution_tpu.utils.watchdog import Watchdog
     os.makedirs(out_dir, exist_ok=True)
     wd = Watchdog(path=os.path.join(out_dir, "chaos_partial.json"),
@@ -460,7 +870,7 @@ def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
                else episode_indices)
     for ep in indices:
         cfg = episode_config(seed, ep, n_validators, n_slots, doctor=doctor,
-                             variant=variant)
+                             variant=variant, serve=serve)
         # incremental flush (ISSUE 10): config + start checkpoint +
         # streamed events land in an inflight dir BEFORE the run, so a
         # crashed/killed episode leaves a --resume-bundle artifact
@@ -482,15 +892,41 @@ def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
         # sweep; anything else is an unexplained violation and does.
         unexplained = [v for v in result["violations"]
                        if v.get("kind") != "accountable_fault"]
-        if result["violations"]:
+        serve_out = result.get("serve")
+        serve_failed = False
+        if serve_out is not None:
+            # the serve x chaos verdict: a WRONG proof is a hard
+            # failure (overload may shed, never corrupt); the SLO
+            # outcome rides the episode record. The serve outcome
+            # stays OUT of result["violations"]: replay resumes the
+            # CHAIN from the checkpoint without re-serving, so a
+            # synthetic violation there could never replay (it lands
+            # in the bundle as serve.json instead).
+            serve_failed = serve_out["verify_failures"] > 0
+            summary.setdefault("serve", []).append(
+                {"episode": ep, **{k: serve_out[k] for k in
+                 ("interactive_goodput_pct", "interactive_p99_ms",
+                  "slo_ok", "verified_proofs", "verify_failures")}})
+        if result["violations"] or serve_failed:
             bundle = write_bundle(out_dir, cfg, result,
                                   do_shrink=do_shrink and bool(unexplained),
                                   inflight_dir=inflight)
             summary["bundles"].append(bundle)
-        if unexplained:
+            if serve_out is not None:
+                from pos_evolution_tpu.utils.snapshot import (
+                    atomic_write_bytes,
+                )
+                atomic_write_bytes(
+                    os.path.join(bundle, "serve.json"),
+                    (json.dumps(serve_out, indent=1, sort_keys=True)
+                     + "\n").encode())
+        if unexplained or serve_failed:
             summary["violating"] += 1
-            print(f"episode {ep}: {len(unexplained)} unexplained "
-                  f"violation(s) -> {bundle}")
+            reasons = [f"{len(unexplained)} unexplained violation(s)"]
+            if serve_failed:
+                reasons.append(f"{serve_out['verify_failures']} served "
+                               f"proofs failed verification")
+            print(f"episode {ep}: {' + '.join(reasons)} -> {bundle}")
         elif result["violations"]:
             summary["accountable"] += 1
             print(f"episode {ep}: {len(result['violations'])} accountable "
@@ -522,14 +958,58 @@ def main(argv=None) -> int:
                     help="protocol variant to fuzz under (DESIGN.md §16); "
                          "'all' sweeps every variant into per-variant "
                          "subdirectories")
+    ap.add_argument("--serve", action="store_true",
+                    help="attach a live ServeFront + remote-discovery "
+                         "open-loop loadgen to every episode; the "
+                         "SLO/goodput outcome joins the verdict and a "
+                         "wrong served proof fails the episode")
+    ap.add_argument("--dense", type=int, default=0, metavar="N",
+                    help="run N DENSE episodes instead (ISSUE 13): "
+                         "mainnet-scale DenseSimulation runs with "
+                         "vectorized adversaries, DenseFaultPlan masks "
+                         "and the dense monitor stack")
+    ap.add_argument("--dense-validators", type=int, default=576,
+                    help="validators per dense episode (divisible by 24)")
+    ap.add_argument("--dense-epochs", type=int, default=4,
+                    help="epochs per dense episode (>= 4: the first "
+                         "finalization lands entering epoch 4)")
+    ap.add_argument("--mesh", default=None, metavar="PxS",
+                    help="run dense episodes sharded on a virtual mesh "
+                         "(re-execs with forced host device count)")
+    ap.add_argument("--history", default=None,
+                    help="append a kind=bench_dense_chaos emission to "
+                         "this bench history (gate with perf_gate.py)")
     ap.add_argument("--replay", metavar="BUNDLE",
-                    help="replay a repro bundle and verify the violation")
+                    help="replay a repro bundle (spec or dense) and "
+                         "verify the violation")
     ap.add_argument("--resume-bundle", metavar="BUNDLE",
                     help="resume a PARTIAL (inflight) bundle left by a "
                          "crashed episode: run it to completion from its "
                          "flushed config + checkpoint; verifies the "
                          "violations only when the bundle recorded some")
     args = ap.parse_args(argv)
+    if args.dense and args.mesh:
+        from pos_evolution_tpu.utils.hostdev import reexec_with_host_devices
+        pods, shard = (int(x) for x in args.mesh.lower().split("x"))
+        reexec_with_host_devices(pods * shard, "POS_CHAOS_CHILD")
+
+    if args.dense:
+        summary = fuzz_dense(args.dense, args.seed, args.dense_validators,
+                             args.dense_epochs, args.out, mesh=args.mesh,
+                             doctor=args.doctor,
+                             step_timeout=args.step_timeout,
+                             history=args.history)
+        print(json.dumps({k: summary[k] for k in
+                          ("mode", "episodes", "violating", "accountable",
+                           "incidents", "scenarios", "run_s")}, indent=1))
+        if args.doctor:
+            # the forged double finality MUST trip protocol_violation —
+            # which the doctor scenario records as an EXPECTED verdict,
+            # so success = zero unexpected/missed episodes
+            return 0 if (summary["violating"] == 0
+                         and summary["incidents"] == 0
+                         and summary["accountable"] > 0) else 1
+        return 1 if (summary["violating"] or summary["incidents"]) else 0
 
     with use_config(minimal_config()):
         if args.replay or args.resume_bundle:
@@ -551,10 +1031,14 @@ def main(argv=None) -> int:
             summary = fuzz(args.episodes, args.seed, args.validators,
                            args.slots, out_dir, doctor=args.doctor,
                            do_shrink=not args.no_shrink,
-                           step_timeout=args.step_timeout, variant=name)
-            print(json.dumps({k: summary[k] for k in
-                              ("variant", "episodes", "violating",
-                               "accountable", "incidents")}, indent=1))
+                           step_timeout=args.step_timeout, variant=name,
+                           serve=args.serve)
+            keys = ["variant", "episodes", "violating", "accountable",
+                    "incidents"]
+            row = {k: summary[k] for k in keys}
+            if "serve" in summary:
+                row["serve"] = summary["serve"]
+            print(json.dumps(row, indent=1))
             if args.doctor:
                 # the doctored run MUST trip a safety monitor, per variant
                 rc |= 0 if summary["violating"] > 0 else 1

@@ -32,16 +32,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _reexec_with_devices(n_devices: int) -> None:
-    if os.environ.get("POS_MULTICHIP_CHILD") == "1":
-        return
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        flags = (flags
-                 + f" --xla_force_host_platform_device_count={n_devices}"
-                 ).strip()
-    env = dict(os.environ, POS_MULTICHIP_CHILD="1", JAX_PLATFORMS="cpu",
-               XLA_FLAGS=flags)
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    from pos_evolution_tpu.utils.hostdev import reexec_with_host_devices
+    reexec_with_host_devices(n_devices, "POS_MULTICHIP_CHILD")
 
 
 def main():

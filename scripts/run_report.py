@@ -139,6 +139,16 @@ def build_report(events: list[dict], top_ops: dict | None = None,
     for e in by_type.get("fault", []):
         row = fault_counts.setdefault(e["action"], {})
         row[e["kind"]] = row.get(e["kind"], 0) + 1
+    # dense-driver fault masks (ISSUE 13): per-(slot, view) aggregates,
+    # not per-message events — fold into totals
+    dense_faults = by_type.get("dense_fault", [])
+    dense_fault_totals = None
+    if dense_faults:
+        dense_fault_totals = {
+            "events": len(dense_faults),
+            "dropped_votes": sum(e.get("dropped", 0) for e in dense_faults),
+            "delayed_votes": sum(e.get("delayed", 0) for e in dense_faults),
+        }
     gossip_spans = {e["span"] for e in by_type.get("gossip", [])
                     if e.get("span")}
     delivered_parents = {e.get("parent") for e in by_type.get("deliver", [])}
@@ -404,7 +414,7 @@ def build_report(events: list[dict], top_ops: dict | None = None,
         "n_events": len(events),
         "run": {k: run_start.get(k) for k in
                 ("n_validators", "n_groups", "accelerated_forkchoice",
-                 "debug") if k in run_start},
+                 "debug", "dense", "mesh") if k in run_start},
         "finality": {
             "timeline": timeline,
             "advances": advances,
@@ -413,7 +423,9 @@ def build_report(events: list[dict], top_ops: dict | None = None,
             "final_finalized_epoch":
                 timeline[-1]["finalized_epoch"] if timeline else None,
         },
-        "faults": {"counts": fault_counts, "effects": effects},
+        "faults": {"counts": fault_counts, "effects": effects,
+                   **({"dense_totals": dense_fault_totals}
+                      if dense_fault_totals else {})},
         "property_audit": audit,
         "handlers": handlers,
         "light_clients": {str(k): v for k, v in sorted(lc.items())},

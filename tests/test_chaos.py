@@ -63,6 +63,20 @@ class TestChaosSmoke:
         assert not [f for f in os.listdir(tmp_path)
                     if f.endswith(".events.jsonl")]
 
+    def test_serve_episode_composes_traffic_with_chaos(self, tmp_path):
+        """ISSUE 13 satellite: a --serve episode attaches a live
+        ServeFront + remote-discovery open-loop loadgen to the faulted
+        adversarial run; the verdict carries the SLO/goodput outcome
+        and every served proof verified."""
+        cfg = chaos_fuzz.episode_config(2, 0, 32, 10, serve=True)
+        cfg["serve"].update(arrivals=250, rate=400.0)
+        result = chaos_fuzz.run_episode(cfg)
+        serve = result["serve"]
+        assert serve["verify_failures"] == 0
+        assert serve["verified_proofs"] > 0
+        assert serve["remote_discovery"]["discoveries"] >= 1
+        assert "slo_ok" in serve and "interactive_goodput_pct" in serve
+
     @pytest.mark.slow
     def test_fuzz_sweep_clean(self, tmp_path):
         """Wider sweep over compositions (the real fuzzing workload),

@@ -1,0 +1,411 @@
+"""ISSUE 13: adversarial robustness at mainnet scale in the dense
+driver — vectorized fault masks (drop/delay/crash/partition) inside the
+sharded vote pass, the four masked-transform adversary strategies, the
+dense monitor stack classifying accountable faults vs protocol
+violations, bit-identity of faulted+adversarial runs across mesh shapes
+and vs the single-device twin, checkpoint -> resume onto a different
+mesh MID-ATTACK, and the dense chaos-fuzz episode matrix with
+replayable bundles + the doctored forged-double-finality negative."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+GWEI = 10**9
+
+
+def _mesh(pods, shard):
+    from pos_evolution_tpu.parallel.sharded import make_mesh
+    return make_mesh(pods * shard, pods)
+
+
+def _cfg(slots_per_epoch=8):
+    from pos_evolution_tpu.config import mainnet_config
+    return mainnet_config().replace(slots_per_epoch=slots_per_epoch,
+                                    max_committees_per_slot=4)
+
+
+def _monitors(**kw):
+    from pos_evolution_tpu.sim.dense_monitors import default_dense_monitors
+    return default_dense_monitors(**kw)
+
+
+# --- stateless vectorized draws ------------------------------------------------
+
+
+class TestStatelessUnitArray:
+    def test_deterministic_and_uniform(self):
+        from pos_evolution_tpu.sim.faults import (
+            stateless_unit_array,
+            stateless_word,
+        )
+        a = stateless_unit_array(7, 20, 3, 0, n=4096)
+        b = stateless_unit_array(7, 20, 3, 0, n=4096)
+        assert np.array_equal(a, b)
+        assert a.dtype == np.float64
+        assert (a >= 0).all() and (a < 1).all()
+        assert 0.45 < a.mean() < 0.55          # roughly uniform
+        # different identity -> different draws; same word -> same seed
+        c = stateless_unit_array(7, 20, 4, 0, n=4096)
+        assert not np.array_equal(a, c)
+        assert stateless_word(7, 20, 3, 0) == stateless_word(7, 20, 3, 0)
+
+    def test_prefix_stable_in_n(self):
+        """Growing the axis never perturbs earlier indices (the mask for
+        validator v is a pure function of the identity and v)."""
+        from pos_evolution_tpu.sim.faults import stateless_unit_array
+        a = stateless_unit_array(3, 20, 9, 1, n=128)
+        b = stateless_unit_array(3, 20, 9, 1, n=1024)
+        assert np.array_equal(a, b[:128])
+
+
+class TestDenseFaultPlan:
+    def test_masks_disjoint_gst_and_crash(self):
+        from pos_evolution_tpu.sim.faults import (
+            DenseCrashWindow,
+            DenseFaultPlan,
+        )
+        plan = DenseFaultPlan(seed=5, drop_p=0.2, delay_p=0.2, gst_slot=10,
+                              crashes=(DenseCrashWindow(8, 24, 3, 7),))
+        dropped, delayed = plan.delivery_masks(4, 0, 256)
+        assert dropped.any() and delayed.any()
+        assert not (dropped & delayed).any()     # disjoint fates
+        d2, l2 = plan.delivery_masks(10, 0, 256)  # at/after GST: off
+        assert not d2.any() and not l2.any()
+        crashed = plan.crashed_mask(5, 256)
+        assert crashed[8:24].all() and not crashed[:8].any() \
+            and not crashed[24:].any()
+        assert not plan.crashed_mask(7, 256).any()   # rejoined
+
+    def test_describe_round_trip(self):
+        from pos_evolution_tpu.sim.faults import (
+            DenseCrashWindow,
+            DenseFaultPlan,
+        )
+        plan = DenseFaultPlan(seed=5, drop_p=0.1, delay_p=0.05,
+                              gst_slot=12, partition="full",
+                              crashes=(DenseCrashWindow(0, 8, 2, 5),))
+        clone = DenseFaultPlan.from_config(
+            json.loads(json.dumps(plan.describe())))
+        assert clone == plan
+
+
+class TestMaskedStakeTally:
+    def test_host_equals_sharded_kernel(self):
+        from pos_evolution_tpu.ops.epoch import masked_stake_host
+        from pos_evolution_tpu.parallel.partition import (
+            shard_leaf,
+            spec_for,
+        )
+        from pos_evolution_tpu.parallel.sharded import masked_stake_for
+        rng = np.random.default_rng(3)
+        mask = rng.random(512) < 0.3
+        eff = rng.integers(1, 64, 512).astype(np.int64) * GWEI
+        host = masked_stake_host(mask, eff)
+        for shape in [(1, 8), (2, 4), (4, 2)]:
+            mesh = _mesh(*shape)
+            got = int(masked_stake_for(mesh)(
+                shard_leaf(mesh, spec_for("messages/evidence"), mask),
+                shard_leaf(mesh, spec_for("messages/stake"), eff)))
+            assert got == host, shape
+
+
+# --- faulted == unfaulted-with-masks, across every layout ----------------------
+
+
+class TestFaultedDeterminism:
+    def _chaos_sim(self, mesh, n=384, seed=21):
+        from pos_evolution_tpu.sim.dense_adversary import DenseEquivocator
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        from pos_evolution_tpu.sim.faults import (
+            DenseCrashWindow,
+            DenseFaultPlan,
+        )
+        plan = DenseFaultPlan(seed=seed, drop_p=0.1, delay_p=0.08,
+                              gst_slot=10,
+                              crashes=(DenseCrashWindow(300, 340, 3, 9),))
+        return DenseSimulation(
+            n, cfg=_cfg(), mesh=mesh, seed=seed, shuffle_rounds=6,
+            check_walk_every=0, fault_plan=plan,
+            adversaries=[DenseEquivocator(controlled=range(24), seed=2)],
+            monitors=_monitors(parity_every=4))
+
+    def test_all_pass_plan_is_bit_identical_to_no_plan(self):
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        from pos_evolution_tpu.sim.faults import DenseFaultPlan
+        base = DenseSimulation(256, cfg=_cfg(), mesh=None, seed=11,
+                               shuffle_rounds=6, check_walk_every=8)
+        base.run_epochs(3)
+        masked = DenseSimulation(256, cfg=_cfg(), mesh=None, seed=11,
+                                 shuffle_rounds=6, check_walk_every=8,
+                                 fault_plan=DenseFaultPlan(seed=9))
+        masked.run_epochs(3)
+        assert base.metrics == masked.metrics
+
+    def test_bit_identical_across_mesh_shapes_and_single_device(self):
+        """The ISSUE 13 determinism satellite: a seeded
+        faulted+adversarial dense run is bit-identical on 1x8 / 2x4 /
+        4x2 and vs the single-device twin."""
+        runs = []
+        for mesh in (None, _mesh(1, 8), _mesh(2, 4), _mesh(4, 2)):
+            sim = self._chaos_sim(mesh)
+            sim.run_epochs(3)
+            runs.append((sim.metrics,
+                         [(v["monitor"], v["kind"], v["slot"])
+                          for v in sim.monitor_violations],
+                         [int(x) for x in
+                          np.flatnonzero(sim.monitors[0].implicated)]))
+        for other in runs[1:]:
+            assert other == runs[0]
+
+    def test_checkpoint_resume_mid_attack_on_different_mesh(self):
+        """The other determinism satellite: checkpoint -> resume onto a
+        DIFFERENT mesh mid-attack matches the uninterrupted run,
+        including monitor state and the fault-mask stream."""
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        ref = self._chaos_sim(_mesh(2, 4))
+        ref.run_epochs(3)
+        half = self._chaos_sim(_mesh(2, 4))
+        half.run_epochs(1)
+        data = half.checkpoint()
+        for target in (_mesh(4, 2), None):
+            resumed = DenseSimulation.resume(data, mesh=target)
+            resumed.run_epochs(3)
+            assert resumed.metrics == ref.metrics
+            assert [(v["monitor"], v["kind"], v["slot"])
+                    for v in resumed.monitor_violations] == \
+                   [(v["monitor"], v["kind"], v["slot"])
+                    for v in ref.monitor_violations]
+            assert np.array_equal(resumed.monitors[0].implicated,
+                                  ref.monitors[0].implicated)
+
+
+# --- the strategies -------------------------------------------------------------
+
+
+class TestDenseStrategies:
+    def test_equivocator_faulted_episode_is_clean_with_evidence(self):
+        sim = TestFaultedDeterminism()._chaos_sim(None)
+        sim.run_epochs(4)
+        assert sim.monitor_violations == []
+        s = sim.summary()
+        assert s["finality_reached"]
+        # the double votes were observed and implicated at origination
+        assert sim.monitors[0].implicated.sum() > 0
+        assert sim.monitors[0].implicated[:24].sum() == \
+            sim.monitors[0].implicated.sum()   # only controlled implicated
+
+    def test_withholder_honest_majority_reorg_fails_clean(self):
+        from pos_evolution_tpu.sim.dense_adversary import DenseWithholder
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        adv = DenseWithholder(controlled=range(20), fork_slot=3,
+                              release_slot=6)
+        sim = DenseSimulation(256, cfg=_cfg(), mesh=None, seed=13,
+                              shuffle_rounds=6, check_walk_every=0,
+                              adversaries=[adv],
+                              monitors=_monitors(parity_every=2))
+        sim.run_epochs(4)
+        assert sim.monitor_violations == []
+        assert sim.summary()["finality_reached"]
+        assert adv.priv and adv.released
+        # the private chain was grown invisibly, revealed, and LOST
+        priv_roots = {sim.roots[i] for i in adv.priv}
+        assert sim.roots[sim._head(0)] not in priv_roots
+        for i in adv.priv:
+            assert sim.views[0].vis_host[i]     # revealed at release
+
+    def test_withholder_private_blocks_invisible_before_release(self):
+        from pos_evolution_tpu.sim.dense_adversary import DenseWithholder
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        adv = DenseWithholder(controlled=range(20), fork_slot=3,
+                              release_slot=10)
+        sim = DenseSimulation(256, cfg=_cfg(), mesh=None, seed=13,
+                              shuffle_rounds=6, check_walk_every=0,
+                              adversaries=[adv])
+        while sim.slot < 8:
+            sim.run_slot()
+        assert adv.priv and not adv.released
+        for i in adv.priv:
+            assert not sim.views[0].vis_host[i]
+        assert adv.bank      # committee votes banked, not broadcast
+
+    def test_splitvoter_double_finality_accountable_exactly_one_third(self):
+        from pos_evolution_tpu.sim.dense_adversary import DenseSplitVoter
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        from pos_evolution_tpu.sim.faults import DenseFaultPlan
+        n = 384
+        sim = DenseSimulation(
+            n, cfg=_cfg(), mesh=None, seed=7, shuffle_rounds=6,
+            verify_aggregates=False, check_walk_every=0, n_groups=2,
+            fault_plan=DenseFaultPlan(partition="full"),
+            adversaries=[DenseSplitVoter(controlled=range(n // 3))],
+            monitors=_monitors(parity_every=4))
+        sim.run_epochs(5)
+        fins = [v for v in sim.monitor_violations
+                if v["checkpoint"] == "finalized"]
+        assert fins, sim.monitor_violations
+        v = fins[0]
+        assert v["kind"] == "accountable_fault"
+        # the theorem's bound, pinned EXACTLY: evidence = the controlled
+        # third, at genesis stake
+        assert v["slashable_stake"] * 3 == v["total_stake"]
+        assert v["evidence_size"] == n // 3
+        # both views really finalized conflicting checkpoints
+        assert all(view.finalized[0] > 0 for view in sim.views)
+        assert sim.views[0].finalized != sim.views[1].finalized
+        # liveness is loudly disarmed on a partitioned network
+        liveness = sim.monitors[1]
+        assert liveness.disarmed_reason is not None
+
+    def test_balancer_stalls_justification_liveness_flagged(self):
+        from pos_evolution_tpu.sim.dense_adversary import DenseBalancer
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        from pos_evolution_tpu.sim.faults import DenseFaultPlan
+        n = 384
+        bal = DenseBalancer(controlled=range((n * 5) // 16))
+        sim = DenseSimulation(
+            n, cfg=_cfg(), mesh=None, seed=17, shuffle_rounds=6,
+            verify_aggregates=False, check_walk_every=0, n_groups=2,
+            fault_plan=DenseFaultPlan(partition="delay"),
+            adversaries=[bal],
+            monitors=_monitors(bound_epochs=2, parity_every=4))
+        sim.run_epochs(6)
+        assert all(v.cur_just[0] == 0 for v in sim.views)
+        kinds = {v["kind"] for v in sim.monitor_violations}
+        assert kinds == {"liveness_violation"}
+        assert bal.infeasible_slots == []    # the :1330 precondition held
+
+
+# --- the monitors' negative -----------------------------------------------------
+
+
+class TestDoctoredDenseNegative:
+    def test_forged_double_finality_trips_protocol_violation(self):
+        """Conflicting finalized checkpoints with an EMPTY evidence
+        column must be classified protocol_violation — the dense CI
+        negative (a safety break the evidence cannot explain fails
+        loudly)."""
+        from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+        from pos_evolution_tpu.sim.faults import DenseFaultPlan
+        sim = DenseSimulation(
+            384, cfg=_cfg(), mesh=None, seed=5, shuffle_rounds=6,
+            verify_aggregates=False, check_walk_every=0, n_groups=2,
+            fault_plan=DenseFaultPlan(partition="full"),
+            monitors=_monitors(parity_every=4))
+        sim.run_epochs(2)
+        tips = [i for i in range(len(sim.roots))
+                if sim.block_slots[i] == sim.slot]
+        sim.views[0].finalized = (1, tips[0])
+        sim.views[1].finalized = (1, tips[1])
+        sim.run_slot()
+        kinds = [v["kind"] for v in sim.monitor_violations
+                 if v.get("checkpoint") == "finalized"]
+        assert "protocol_violation" in kinds, sim.monitor_violations
+
+
+# --- chaos_fuzz --dense ---------------------------------------------------------
+
+
+class TestDenseChaosFuzz:
+    def test_episode_config_pure_function(self):
+        from chaos_fuzz import episode_config_dense
+        a = episode_config_dense(9, 2, 384, 4)
+        b = episode_config_dense(9, 2, 384, 4)
+        assert a == b
+        assert a["dense"] is True
+        json.dumps(a)   # bundle-serializable
+
+    def test_fuzz_matrix_bundles_and_replay(self, tmp_path):
+        """Two fixed-seed dense episodes run clean-or-explained; a
+        violating/explained bundle replays to the identical verdicts
+        through DenseSimulation.resume."""
+        from chaos_fuzz import fuzz_dense, replay_bundle
+        out = str(tmp_path / "dense")
+        summary = fuzz_dense(2, 3, 384, 4, out)
+        assert summary["episodes"] == 2
+        assert summary["violating"] == 0
+        assert summary["incidents"] == 0
+        for bundle in summary["bundles"]:
+            assert os.path.exists(os.path.join(bundle, "config.json"))
+            assert os.path.exists(os.path.join(bundle, "checkpoint.bin"))
+            assert os.path.exists(os.path.join(bundle, "events.jsonl"))
+            rep = replay_bundle(bundle)
+            assert rep["match"] is True, rep
+
+    def test_doctor_trips_and_records(self, tmp_path):
+        from chaos_fuzz import episode_config_dense, run_dense_episode
+        cfg = episode_config_dense(5, 0, 384, 2, doctor=True)
+        result = run_dense_episode(cfg)
+        assert any(v["kind"] == "protocol_violation"
+                   for v in result["violations"])
+        assert result["unexpected"] == [] and result["missed"] == []
+
+    def test_doctor_missed_fails_loudly(self):
+        """If the forgery does NOT trip (here: simulated by judging a
+        clean run against the doctor expectation), the episode is
+        flagged missed — the negative cannot silently pass."""
+        from chaos_fuzz import _dense_expectations
+        out = _dense_expectations(
+            {"expect": {"clean": False, "protocol_violation": True}},
+            {"violations": [],
+             "summary": {"finality_reached": True, "views": []}})
+        assert "protocol_violation_not_tripped" in out["missed"]
+
+    def test_bench_dense_chaos_gate_doctored_slow_fails(self, tmp_path):
+        """The history emission passes the perf gate against itself and
+        a doctored-slow (x10) emission FAILS it."""
+        import subprocess
+        from pos_evolution_tpu.profiling import history
+        emission = {"metric": "dense_chaos", "run_s": 4.2,
+                    "counts": {"episodes": 2, "slots": 64, "blocks": 120,
+                               "violations": 3, "violating_episodes": 0}}
+        hist = str(tmp_path / "hist.jsonl")
+        for _ in range(3):
+            history.append_entry(hist, emission, kind="bench_dense_chaos")
+        cand = str(tmp_path / "cand.json")
+        json.dump(emission, open(cand, "w"))
+        slow = dict(emission, run_s=emission["run_s"] * 10)
+        slow_p = str(tmp_path / "slow.json")
+        json.dump(slow, open(slow_p, "w"))
+        gate = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "perf_gate.py")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        ok = subprocess.run(
+            [sys.executable, gate, "--candidate", cand, "--history", hist,
+             "--kind", "bench_dense_chaos", "--strict-timing"], env=env)
+        assert ok.returncode == 0
+        bad = subprocess.run(
+            [sys.executable, gate, "--candidate", slow_p, "--history",
+             hist, "--kind", "bench_dense_chaos", "--strict-timing"],
+            env=env)
+        assert bad.returncode == 1
+
+
+# --- property audit report over dense events ------------------------------------
+
+
+class TestDenseRunReport:
+    def test_property_audit_renders_dense_monitor_events(self, tmp_path):
+        from chaos_fuzz import episode_config_dense, run_dense_episode
+        from run_report import build_report, to_markdown
+        events = str(tmp_path / "events.jsonl")
+        cfg = episode_config_dense(7, 0, 384, 5, scenario="splitvoter")
+        run_dense_episode(cfg, events_path=events)
+        rows = [json.loads(line) for line in open(events)]
+        report = build_report(rows)
+        audit = report["property_audit"]
+        assert audit["violations"], audit
+        assert any(v["kind"] == "accountable_fault"
+                   for v in audit["violations"])
+        assert audit["monitors"] and audit["adversaries"]
+        md = to_markdown(report)
+        assert "Property audit" in md
+        assert "accountable_fault" in md
