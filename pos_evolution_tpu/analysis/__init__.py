@@ -1,0 +1,67 @@
+"""Consensus-grade static analysis: the repo's recurring review findings
+as a mechanical, CI-gated pass (DESIGN.md §21).
+
+The paper's security claims (accountable safety at exactly-1/3 evidence,
+liveness after GST) only hold if the implementation stays deterministic,
+race-free, and recompile-stable — and the repo's review history shows
+those properties regress in the same few ways every PR:
+
+- a fresh ``@jax.jit`` closure built per call, silently recompiling on
+  every invocation (PR 7 review fix: 3.3x demo slowdown);
+- unlocked read-modify-writes on shared counters that the perf gate then
+  gates on (PR 12 review fixes in ``telemetry/registry.py`` and
+  ``serve/admission.py``);
+- wall-clock / RNG-cursor nondeterminism leaking into seeded stateless
+  paths that must be byte-stable across backends, mesh shapes, and
+  resume (PR 13's ``stateless_unit_array`` contract).
+
+This package turns each reviewed-out bug class into an AST rule with a
+stable ``PEV###`` code, plus a lockset-based thread-safety analyzer over
+the multithreaded tiers. Everything is pure stdlib ``ast`` — the pass
+imports nothing from the analyzed tree and needs no jax/numpy, so CI can
+run it before any heavy job.
+
+Entry points::
+
+    python -m pos_evolution_tpu.analysis --strict   # gate the tree
+    python -m pos_evolution_tpu.analysis --doctor   # self-test negative
+    python scripts/lint_deep.py                     # same, from scripts/
+
+Rule index (full rationale per rule in its docstring):
+
+==========  ==================================================================
+PEV001      fresh ``jax.jit``/``shard_map``/``pjit`` closure per call
+PEV002      nondeterminism reachable from seeded stateless paths
+PEV003      host-device sync inside per-slot hot loops
+PEV004      ``donate_argnums`` without the off-CPU guard
+PEV005      except-and-continue that swallows errors in daemon loops
+PEV006      mutable default args / lowercase module mutables
+PEV101      unlocked read-modify-write on a shared instance attribute
+PEV102      inconsistent locking discipline on a shared instance attribute
+==========  ==================================================================
+"""
+
+from .core import (  # noqa: F401
+    Baseline,
+    Finding,
+    Rule,
+    all_rules,
+    parse_suppressions,
+    register_rule,
+)
+from .engine import AnalysisConfig, analyze_paths, analyze_source  # noqa: F401
+from .report import render_json, render_text  # noqa: F401
+
+__all__ = [
+    "AnalysisConfig",
+    "Baseline",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "parse_suppressions",
+    "register_rule",
+    "render_json",
+    "render_text",
+]

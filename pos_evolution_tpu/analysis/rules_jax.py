@@ -1,0 +1,279 @@
+"""JAX compilation-discipline rules: PEV001, PEV003, PEV004.
+
+These mechanize three review findings that each cost real wall-clock:
+
+- **PEV001** — PR 7's ``reconstruct_check_device``: a fresh ``@jax.jit``
+  closure built per call hits the compile cache never (each closure is a
+  new Python callable), so every invocation recompiles. The demo went
+  24.8s -> 7.6s when the jit was hoisted to a module singleton. The
+  codebase's two blessed idioms are module-level construction and the
+  memoized ``*_for`` builder (``parallel/sharded.epoch_step_for``).
+- **PEV003** — a ``.item()`` / ``device_get`` / ``float(jnp...)`` inside
+  a per-slot hot loop forces a device->host sync per iteration, serializing
+  the dispatch pipeline the sharded driver lives on.
+- **PEV004** — ``donate_argnums`` is a no-op that *warns per call* on
+  XLA:CPU; the codebase standardizes on guarding donation off-CPU
+  (``ops/transition._sweep_fn``, ``epoch_step_for(donate=...)``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, register_rule
+
+# callables whose *call* constructs a compiled-function closure
+_JIT_NAMES = frozenset({
+    "jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+})
+_JIT_BARE_NAMES = frozenset(n.rsplit(".", 1)[-1] for n in _JIT_NAMES)
+_MEMO_SUFFIXES = ("_for",)
+_CACHE_DECORATORS = frozenset({
+    "lru_cache", "cache", "functools.lru_cache", "functools.cache",
+    "cached_property", "functools.cached_property",
+})
+
+
+def _names_of(ctx, node) -> set:
+    """Raw and alias-resolved spellings — matching both defeats
+    ``from jax import jit as J`` style aliasing."""
+    return {ctx.dotted(node), ctx.resolved(node)} - {""}
+
+
+def _is_jit_constructor(ctx, node: ast.AST) -> bool:
+    """True for ``jax.jit(...)``, ``shard_map(...)``, bare ``@jax.jit``
+    decorator references, and ``partial(jax.jit, ...)`` forms."""
+    if isinstance(node, ast.Call):
+        names = _names_of(ctx, node.func)
+        if names & _JIT_NAMES:
+            return True
+        if names & {"partial", "functools.partial"} and node.args:
+            return bool(_names_of(ctx, node.args[0]) & _JIT_NAMES)
+        return False
+    return bool(_names_of(ctx, node) & _JIT_NAMES)
+
+
+def _in_decorators(fn, node) -> bool:
+    return any(node is d or any(node is sub for sub in ast.walk(d))
+               for d in fn.decorator_list)
+
+
+def _func_chain(ctx, node):
+    """Enclosing (non-lambda) function defs, innermost first. A node
+    inside a def's decorator list executes in the ENCLOSING scope —
+    ``@jax.jit`` on a module-level def is the module-level idiom, not a
+    per-call construction — so that def is excluded from its own
+    decorators' chain."""
+    chain = []
+    for a in ctx.ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if not chain and _in_decorators(a, node):
+                continue
+            chain.append(a)
+    return chain
+
+
+def _has_cache_decorator(fn) -> bool:
+    from .engine import ModuleContext
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if ModuleContext.dotted(target) in _CACHE_DECORATORS:
+            return True
+    return False
+
+
+def _declares_singleton_global(fn) -> bool:
+    """The ``ops/transition._device`` idiom: ``global _DEVICE`` + write —
+    the function IS the memo for a module singleton."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global) and any(
+                n.startswith("_") for n in node.names):
+            return True
+    return False
+
+
+def _memo_exempt_chain(ctx, chain) -> bool:
+    for fn in chain:
+        if fn.name.endswith(_MEMO_SUFFIXES):
+            return True
+        if _has_cache_decorator(fn) or _declares_singleton_global(fn):
+            return True
+    return False
+
+
+def _references_only_memoized(ctx, fn_name: str, own_def) -> bool:
+    """Exemption for the helper-builder idiom: ``_sharded_epoch_core``
+    constructs the jit but is only ever *called* from inside a ``*_for``
+    memo (or handed to ``_cached``). Every in-module reference outside the
+    def itself must sit in a memoized context; zero references = not
+    exempt (the caller is outside our view — make it a baseline entry)."""
+    own_nodes = {id(n) for n in ast.walk(own_def)}
+    refs = [n for n in ctx.walk(ast.Name)
+            if n.id == fn_name and isinstance(n.ctx, ast.Load)
+            and id(n) not in own_nodes]
+    if not refs:
+        return False
+    for ref in refs:
+        chain = _func_chain(ctx, ref)
+        if _memo_exempt_chain(ctx, chain):
+            continue
+        in_cached_call = any(
+            isinstance(a, ast.Call) and ctx.dotted(a.func).endswith("_cached")
+            for a in ctx.ancestors(ref))
+        if not in_cached_call:
+            return False
+    return True
+
+
+@register_rule
+class FreshJitClosureRule(Rule):
+    """PEV001: ``jax.jit`` / ``shard_map`` / ``pjit`` closure constructed
+    inside a function or loop body without memoization."""
+
+    code = "PEV001"
+    name = "fresh-jit-closure"
+    rationale = ("a closure built per call is a new callable every time: "
+                 "XLA's compile cache keys on it and recompiles on every "
+                 "invocation (PR 7: 3.3x demo slowdown)")
+
+    def run(self, ctx):
+        seen = set()
+        for node in ctx.walk((ast.Call, ast.Attribute, ast.Name)):
+            if not _is_jit_constructor(ctx, node):
+                continue
+            # a bare Name/Attribute only matters as a decorator reference
+            if not isinstance(node, ast.Call):
+                parent = ctx.parent(node)
+                if not (isinstance(parent, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))
+                        and node in parent.decorator_list):
+                    continue
+            # skip the inner jax.jit of partial(...) double-hits: the
+            # Call case already reports the partial itself
+            parent = ctx.parent(node)
+            if (isinstance(parent, ast.Call) and node in parent.args
+                    and _is_jit_constructor(ctx, parent)):
+                continue
+            chain = _func_chain(ctx, node)
+            # a compat shim DEFINING one of the constructor names (the
+            # pre-0.6 `def shard_map(f, **kw): return _experimental(...)`
+            # wrapper) is a pass-through: its CALLERS are the audit sites
+            if chain and any(fn.name in _JIT_BARE_NAMES for fn in chain):
+                continue
+            if not chain:
+                if ctx.enclosing_loop(node, stop_at_function=False) is None:
+                    continue  # module level, outside any loop: the idiom
+                outer = None
+            else:
+                outer = chain[-1]
+            if chain and _memo_exempt_chain(ctx, chain):
+                continue
+            if outer is not None and _references_only_memoized(
+                    ctx, outer.name, outer):
+                continue
+            # one finding per decorated def, not one per stacked decorator
+            decorated = next(
+                (a for a in ctx.ancestors(node)
+                 if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 and _in_decorators(a, node)), None)
+            key = ("deco", id(decorated)) if decorated is not None \
+                else ("line", node.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            where = chain[0].name if chain else "module loop"
+            yield self.finding(
+                ctx, node,
+                f"fresh jit/shard_map closure constructed in '{where}' — "
+                f"hoist to module level or route through a memoized "
+                f"'*_for' builder (recompiles per call otherwise)")
+
+
+_SYNC_CALLS = frozenset({"jax.device_get", "jax.block_until_ready"})
+_TRACED_HINTS = frozenset({"jnp", "lax", "jsp"})
+
+
+def _mentions_traced(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in _TRACED_HINTS
+               for n in ast.walk(node))
+
+
+@register_rule
+class HostSyncInHotLoopRule(Rule):
+    """PEV003: host-device synchronization inside a per-slot hot loop."""
+
+    code = "PEV003"
+    name = "host-sync-in-hot-loop"
+    rationale = ("`.item()`/`device_get`/`float(jnp...)` inside a hot loop "
+                 "blocks on the device every iteration — the async dispatch "
+                 "pipeline the sharded driver depends on collapses to "
+                 "lockstep round-trips")
+
+    def run(self, ctx):
+        if not ctx.in_hot_module():
+            return
+        for node in ctx.walk(ast.Call):
+            if ctx.enclosing_loop(node) is None:
+                continue
+            name = ctx.dotted(node.func)
+            names = _names_of(ctx, node.func)
+            hit = None
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                hit = ".item() sync"
+            elif names & _SYNC_CALLS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"):
+                hit = f"{name or 'block_until_ready'} sync"
+            elif name in ("float", "int", "bool") and node.args \
+                    and _mentions_traced(node.args[0]):
+                hit = f"{name}() on a traced/device expression"
+            elif names & {"np.asarray", "numpy.asarray"} and node.args \
+                    and _mentions_traced(node.args[0]):
+                hit = "np.asarray of a device array"
+            if hit:
+                yield self.finding(
+                    ctx, node,
+                    f"{hit} inside a hot loop — pull the value once "
+                    f"outside the loop or keep the reduction on device")
+
+
+@register_rule
+class UnguardedDonationRule(Rule):
+    """PEV004: ``donate_argnums`` without the off-CPU guard."""
+
+    code = "PEV004"
+    name = "unguarded-donation"
+    rationale = ("XLA:CPU does not implement buffer donation and warns on "
+                 "every call; the codebase standardizes on guarding "
+                 "donation off-CPU (transition._sweep_fn, "
+                 "epoch_step_for(donate=...))")
+
+    def run(self, ctx):
+        # a real default_backend USE in code, not a docstring mention
+        module_guarded = any(
+            (isinstance(n, ast.Attribute) and n.attr == "default_backend")
+            or (isinstance(n, ast.Name) and n.id == "default_backend")
+            for n in ctx.walk((ast.Attribute, ast.Name)))
+        for node in ctx.walk(ast.Call):
+            kw = next((k for k in node.keywords
+                       if k.arg == "donate_argnums"), None)
+            if kw is None:
+                continue
+            if isinstance(kw.value, ast.IfExp):
+                continue  # `(0,) if donate else ()` — the guard is inline
+            if isinstance(kw.value, ast.Tuple) and not kw.value.elts:
+                continue  # explicit no-donation
+            chain = _func_chain(ctx, node)
+            if any(a.arg == "donate"
+                   for fn in chain
+                   for a in (fn.args.args + fn.args.kwonlyargs)):
+                continue  # caller decides, like epoch_step_for(donate=...)
+            if module_guarded:
+                continue  # module selects donated vs plain by backend
+            yield self.finding(
+                ctx, node,
+                "donate_argnums without an off-CPU guard — gate on "
+                "jax.default_backend() or take a `donate` flag the "
+                "backend-aware caller sets")

@@ -91,6 +91,9 @@ class CheckpointManager:
         self._stats = {"saves": 0, "bytes": 0, "blocked_s": 0.0,
                        "background_s": 0.0, "gc_removed": 0,
                        "quarantined": 0}
+        # the writer thread (background_s/saves/bytes) and the caller
+        # thread (blocked_s, stats() reads) share this dict
+        self._stats_lock = threading.Lock()
         self._queue: queue.Queue | None = None
         self._worker: threading.Thread | None = None
         self._worker_error: BaseException | None = None
@@ -152,7 +155,8 @@ class CheckpointManager:
             if wait:
                 self._queue.join()
                 self._raise_worker_error()
-        self._stats["blocked_s"] += time.perf_counter() - t0
+        with self._stats_lock:
+            self._stats["blocked_s"] += time.perf_counter() - t0
 
     def _drain_loop(self) -> None:
         while True:
@@ -167,7 +171,8 @@ class CheckpointManager:
             except BaseException as e:  # surfaced on the next save/drain
                 self._worker_error = e
             finally:
-                self._stats["background_s"] += time.perf_counter() - t0
+                with self._stats_lock:
+                    self._stats["background_s"] += time.perf_counter() - t0
                 self._queue.task_done()
 
     def _raise_worker_error(self) -> None:
@@ -210,8 +215,9 @@ class CheckpointManager:
             os.close(dfd)
         if displaced is not None:
             shutil.rmtree(displaced, ignore_errors=True)
-        self._stats["saves"] += 1
-        self._stats["bytes"] += total
+        with self._stats_lock:
+            self._stats["saves"] += 1
+            self._stats["bytes"] += total
         self.gc()
 
     def drain(self) -> None:
@@ -318,7 +324,8 @@ class CheckpointManager:
                          (reason or "unspecified").encode())
         except OSError:
             pass  # the move is the record; the note is best-effort
-        self._stats["quarantined"] += 1
+        with self._stats_lock:
+            self._stats["quarantined"] += 1
         from pos_evolution_tpu.telemetry import emit_global
         emit_global("checkpoint_quarantined", step=step,
                     reason=(reason or "")[:300], path=dst)
@@ -333,11 +340,13 @@ class CheckpointManager:
         for step in steps[:max(len(steps) - self.retain, 0)]:
             shutil.rmtree(self._step_dir(step), ignore_errors=True)
             removed += 1
-        self._stats["gc_removed"] += removed
+        with self._stats_lock:
+            self._stats["gc_removed"] += removed
         return removed
 
     def stats(self) -> dict:
-        s = dict(self._stats)
+        with self._stats_lock:
+            s = dict(self._stats)
         s["blocked_s"] = round(s["blocked_s"], 6)
         s["background_s"] = round(s["background_s"], 6)
         return s

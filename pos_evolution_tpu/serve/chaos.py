@@ -86,7 +86,8 @@ class ServeChaos:
             planned.append({"kind": "worker_stall_armed", "worker": w,
                             "at_s": round(lo - start, 3),
                             "stall_s": stall_s})
-        self.log.extend(planned)
+        with self._lock:
+            self.log.extend(planned)
         return planned
 
     def stall_s(self, worker_id: int) -> float:
@@ -108,8 +109,10 @@ class ServeChaos:
             n = self._stall_n
             self._stall_n += 1
         if _unit(self.seed, "stall", worker_id, n) < self.stall_prob:
-            self.log.append({"kind": "worker_stall", "worker": worker_id,
-                             "stall_s": self._stall_s})
+            with self._lock:
+                self.log.append({"kind": "worker_stall",
+                                 "worker": worker_id,
+                                 "stall_s": self._stall_s})
             return self._stall_s
         return 0.0
 
@@ -121,15 +124,17 @@ class ServeChaos:
         if self.wipe_prob > 0 and _unit(self.seed, "wipe",
                                         version) < self.wipe_prob:
             front.das.proof_cache.clear()
-            self.log.append({"kind": "cache_wipe", "version": version,
-                             "slot": int(view.slot)})
+            with self._lock:
+                self.log.append({"kind": "cache_wipe", "version": version,
+                                 "slot": int(view.slot)})
 
     # -- backing-store faults --------------------------------------------------
 
     def fail_backing_for(self, seconds: float) -> None:
         self._backing_fault_until = self.clock() + float(seconds)
-        self.log.append({"kind": "backing_fault_window",
-                         "seconds": float(seconds)})
+        with self._lock:
+            self.log.append({"kind": "backing_fault_window",
+                             "seconds": float(seconds)})
 
     def maybe_backing_fault(self) -> None:
         until = self._backing_fault_until
@@ -147,15 +152,18 @@ class ServeChaos:
         for k in range(n_bursts):
             lo = _unit(self.seed, "burst", k) * (duration_s - width)
             out.append((lo, lo + width, mult))
-        self.log.append({"kind": "burst_windows", "windows": out})
+        with self._lock:
+            self.log.append({"kind": "burst_windows", "windows": out})
         return tuple(out)
 
     def summary(self) -> dict:
+        with self._lock:
+            log = list(self.log)
         kinds: dict[str, int] = {}
-        for e in self.log:
+        for e in log:
             kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
         return {"seed": self.seed, "injections": kinds,
-                "log_tail": self.log[-10:]}
+                "log_tail": log[-10:]}
 
 
 class SlowLorisSwarm:
@@ -204,7 +212,9 @@ class SlowLorisSwarm:
             t = threading.Thread(target=self._loris, args=(k,),
                                  name=f"slow-loris-{k}", daemon=True)
             t.start()
-            self._threads.append(t)
+            # start() runs once on the owning thread; the loris threads
+            # never touch _threads
+            self._threads.append(t)  # pev: ignore[PEV101]
 
     def stop(self) -> None:
         self._stop.set()

@@ -83,7 +83,9 @@ class _Channel:
         while self.alive:
             try:
                 resp = recv_frame(self.sock)
-            except (ProtocolError, OSError):
+            # not a swallow: the None sentinel drops through to the
+            # connection-lost path below, which fails every waiter loudly
+            except (ProtocolError, OSError):  # pev: ignore[PEV005]
                 resp = None
             if resp is None:
                 self.alive = False

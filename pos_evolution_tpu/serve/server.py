@@ -171,12 +171,14 @@ class ServeFront:
         acceptor = threading.Thread(target=self._accept_loop,
                                     name="serve-accept", daemon=True)
         acceptor.start()
-        self._threads.append(acceptor)
+        # start() runs once on the owning thread before any worker exists;
+        # _threads is never touched from the spawned threads
+        self._threads.append(acceptor)  # pev: ignore[PEV101]
         for w in range(self.workers):
             t = threading.Thread(target=self._worker_loop, args=(w,),
                                  name=f"serve-worker-{w}", daemon=True)
             t.start()
-            self._threads.append(t)
+            self._threads.append(t)  # pev: ignore[PEV101]
         return self.host, self.port
 
     def stop(self) -> None:
@@ -247,7 +249,8 @@ class ServeFront:
                 chunk = conn.sock.recv(65536)
             except socket.timeout:
                 if buf:
-                    self.slow_loris_closed += 1
+                    with self._conn_lock:  # N readers share this counter
+                        self.slow_loris_closed += 1
                     self._count("serve_slow_loris_closed_total",
                                 "connections dropped mid-frame")
                     conn.close()
@@ -346,7 +349,8 @@ class ServeFront:
         if self.chaos is not None:
             stall = self.chaos.stall_s(worker_id)
             if stall > 0:
-                self.chaos_stalls += 1
+                with self._conn_lock:  # N workers share this counter
+                    self.chaos_stalls += 1
                 self._count("serve_chaos_stalls_total",
                             "chaos-injected worker stalls")
                 time.sleep(stall)

@@ -87,7 +87,11 @@ class Gauge(_Metric):
     kind = "gauge"
 
     def set(self, value: float, **labels) -> None:
-        self.series[_label_key(labels)] = value
+        # last-write-wins is the gauge semantic, but the first touch of a
+        # key races dict insertion against concurrent inc() resizes —
+        # same discipline as every other series update
+        with self._lock:
+            self.series[_label_key(labels)] = value
 
     def inc(self, amount: float = 1, **labels) -> None:
         key = _label_key(labels)

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Deep static analysis from scripts/ — thin wrapper over
+``python -m pos_evolution_tpu.analysis`` (see DESIGN.md §21).
+
+Typical invocations::
+
+    python scripts/lint_deep.py --strict            # the CI gate
+    python scripts/lint_deep.py --doctor            # self-test (rc 1 = ok)
+    python scripts/lint_deep.py tests --rules PEV002,PEV006 --strict
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from pos_evolution_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

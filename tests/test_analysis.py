@@ -1,0 +1,877 @@
+"""Tests for the static-analysis subsystem (DESIGN.md §21).
+
+Every historical bug class the pass mechanizes is reproduced here as a
+paired fixture: the shipped-and-reviewed-out bug must FLAG, the fixed
+version must NOT. Plus: suppression and baseline semantics, the lockset
+analyzer on a miniature two-thread class, JSON reporter schema
+stability, doctor exit codes, and the acceptance pin that the shipped
+tree is clean against the checked-in baseline.
+"""
+
+import json
+import os
+
+import pytest
+
+from pos_evolution_tpu.analysis import (
+    AnalysisConfig,
+    Baseline,
+    analyze_source,
+)
+from pos_evolution_tpu.analysis.__main__ import gate, main
+from pos_evolution_tpu.analysis.core import parse_suppressions
+from pos_evolution_tpu.analysis.doctor import (
+    DOCTOR_FINDINGS,
+    DOCTOR_MISMATCH,
+    DOCTOR_OK_NONE,
+    EXPECTED,
+    run_doctor,
+)
+from pos_evolution_tpu.analysis.report import (
+    FINDING_KEYS,
+    SCHEMA_KEYS,
+    render_json,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(source, relpath="mod.py", config=None, **cfg_kw):
+    if config is None:
+        config = AnalysisConfig(**cfg_kw)
+    result = analyze_source(source, relpath, config)
+    assert result.parse_error is None, result.parse_error
+    return [f.code for f in result.findings]
+
+
+def _hot(relpath="mod.py"):
+    return AnalysisConfig(hot_modules=(relpath,))
+
+
+def _strict_scope(relpath="mod.py"):
+    return AnalysisConfig(stateless_strict=(relpath,),
+                          stateless_decision=())
+
+
+def _threaded(relpath="mod.py"):
+    return AnalysisConfig(threaded_modules=(relpath,))
+
+
+# --- PEV001: the PR 7 fresh-closure class -------------------------------------
+
+PR7_BUG = """\
+import jax
+
+def reconstruct_check_device(cells, mask):
+    @jax.jit
+    def _check(c, m):
+        return (c * m).sum()
+    return _check(cells, mask)
+"""
+
+PR7_FIXED = """\
+import jax
+
+@jax.jit
+def _reconstruct_check(c, m):
+    return (c * m).sum()
+
+def reconstruct_check_device(cells, mask):
+    return _reconstruct_check(cells, mask)
+"""
+
+
+class TestFreshJitClosure:
+    def test_pr7_per_call_closure_flags(self):
+        assert _codes(PR7_BUG) == ["PEV001"]
+
+    def test_pr7_module_singleton_fix_is_clean(self):
+        assert _codes(PR7_FIXED) == []
+
+    def test_memoized_for_builder_is_exempt(self):
+        src = """\
+import jax
+
+_CACHE = {}
+
+def _cached(key, build):
+    if key not in _CACHE:
+        _CACHE[key] = build()
+    return _CACHE[key]
+
+def epoch_step_for(mesh):
+    return _cached(("epoch", mesh), lambda: jax.jit(lambda r: r + 1))
+"""
+        assert _codes(src) == []
+
+    def test_helper_core_called_only_from_for_builder_is_exempt(self):
+        src = """\
+import jax
+
+_CACHE = {}
+
+def _cached(key, build):
+    if key not in _CACHE:
+        _CACHE[key] = build()
+    return _CACHE[key]
+
+def _epoch_core(mesh):
+    def step(reg):
+        return reg
+    return jax.jit(step)
+
+def epoch_step_for(mesh):
+    return _cached(("epoch", mesh), lambda: _epoch_core(mesh))
+"""
+        assert _codes(src) == []
+
+    def test_module_singleton_global_memo_is_exempt(self):
+        # the ops/transition._device idiom
+        src = """\
+import jax
+
+_DEVICE = None
+
+def _device():
+    global _DEVICE
+    if _DEVICE is None:
+        _DEVICE = {"jit": jax.jit(lambda x: x)}
+    return _DEVICE
+"""
+        assert _codes(src) == []
+
+    def test_stacked_decorators_report_once(self):
+        src = """\
+import jax
+from functools import partial
+from jax.experimental.shard_map import shard_map
+
+def dry_run_builder(mesh):
+    @jax.jit
+    @partial(shard_map, mesh=mesh)
+    def step(reg):
+        return reg
+    return step
+"""
+        assert _codes(src) == ["PEV001"]
+
+    def test_compat_shim_defining_the_constructor_name_is_exempt(self):
+        # parallel/sharded.py's pre-0.6 wrapper: def shard_map(...) that
+        # forwards to the experimental spelling — callers get audited
+        src = """\
+from jax.experimental.shard_map import shard_map as _experimental
+
+def shard_map(f, **kwargs):
+    if "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _experimental(f, **kwargs)
+"""
+        assert _codes(src) == []
+
+    def test_aliased_jit_import_still_flags(self):
+        src = ("from jax import jit as J\n\n"
+               "def per_call(xs):\n"
+               "    return J(lambda v: v * 2)(xs)\n")
+        assert _codes(src) == ["PEV001"]
+
+    def test_module_level_decorated_def_is_the_idiom(self):
+        src = """\
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("capacity",))
+def head_and_weights(store, capacity):
+    return store
+"""
+        assert _codes(src) == []
+
+
+# --- PEV002: the PR 13 determinism contract -----------------------------------
+
+PR13_BUG = """\
+import time
+
+def should_drop(seed, slot):
+    return time.time() % 1.0 < 0.1
+"""
+
+PR13_FIXED = """\
+import hashlib
+import struct
+
+def stateless_unit(seed, *key):
+    h = hashlib.blake2b(
+        struct.pack(f"<{len(key) + 1}q", seed, *key), digest_size=8).digest()
+    return int.from_bytes(h, "little") / 2.0**64
+
+def should_drop(seed, slot):
+    return stateless_unit(seed, slot) < 0.1
+"""
+
+
+class TestNondeterminism:
+    def test_pr13_wall_clock_in_stateless_path_flags(self):
+        assert _codes(PR13_BUG, config=_strict_scope()) == ["PEV002"]
+
+    def test_pr13_stateless_unit_fix_is_clean(self):
+        assert _codes(PR13_FIXED, config=_strict_scope()) == []
+
+    def test_out_of_scope_module_is_not_held_to_the_contract(self):
+        assert _codes(PR13_BUG, config=AnalysisConfig(
+            stateless_strict=(), stateless_decision=())) == []
+
+    def test_global_rng_cursor_flags_even_in_decision_scope(self):
+        src = "import random\n\ndef jitter():\n    return random.random()\n"
+        cfg = AnalysisConfig(stateless_strict=(),
+                             stateless_decision=("mod.py",))
+        assert _codes(src, config=cfg) == ["PEV002"]
+
+    def test_wall_clock_allowed_in_decision_scope(self):
+        # the drivers time telemetry spans legitimately
+        cfg = AnalysisConfig(stateless_strict=(),
+                             stateless_decision=("mod.py",))
+        assert _codes(PR13_BUG, config=cfg) == []
+
+    def test_keyed_jax_random_is_deterministic_and_clean(self):
+        # jax.random is functional — explicit keys, no global cursor
+        src = ("import jax\n\n"
+               "def draw(key):\n"
+               "    k1, k2 = jax.random.split(key, 2)\n"
+               "    return jax.random.uniform(k1)\n")
+        assert _codes(src, config=_strict_scope()) == []
+
+    def test_unseeded_default_rng_flags_seeded_does_not(self):
+        bad = "import numpy as np\n\ndef draw():\n    return np.random.default_rng()\n"
+        good = ("import numpy as np\n\ndef draw(seed):\n"
+                "    return np.random.default_rng(seed)\n")
+        assert _codes(bad, config=_strict_scope()) == ["PEV002"]
+        assert _codes(good, config=_strict_scope()) == []
+
+    def test_aliased_import_cannot_evade_the_contract(self):
+        src = ("import time as _t\n\n"
+               "def should_drop(seed, slot):\n"
+               "    return _t.time() % 1.0 < 0.1\n")
+        assert _codes(src, config=_strict_scope()) == ["PEV002"]
+
+    def test_from_import_alias_cannot_evade(self):
+        src = ("from time import time as now\n\n"
+               "def should_drop(seed, slot):\n"
+               "    return now() % 1.0 < 0.1\n")
+        assert _codes(src, config=_strict_scope()) == ["PEV002"]
+
+    def test_set_iteration_flags_in_strict_scope(self):
+        src = ("def order(xs):\n"
+               "    out = []\n"
+               "    for x in set(xs):\n"
+               "        out.append(x)\n"
+               "    return out\n")
+        assert _codes(src, config=_strict_scope()) == ["PEV002"]
+        assert _codes(src.replace("set(xs)", "sorted(set(xs))"),
+                      config=_strict_scope()) == []
+
+
+# --- PEV003: host sync in hot loops -------------------------------------------
+
+class TestHostSync:
+    def test_item_in_hot_loop_flags(self):
+        src = ("def drain(batches):\n"
+               "    total = 0.0\n"
+               "    for b in batches:\n"
+               "        total += b.item()\n"
+               "    return total\n")
+        assert _codes(src, config=_hot()) == ["PEV003"]
+
+    def test_item_outside_loop_is_fine(self):
+        src = ("def total_of(x):\n"
+               "    return x.item()\n")
+        assert _codes(src, config=_hot()) == []
+
+    def test_float_of_traced_expr_in_loop_flags(self):
+        src = ("import jax.numpy as jnp\n"
+               "def drain(batches):\n"
+               "    out = []\n"
+               "    for b in batches:\n"
+               "        out.append(float(jnp.sum(b)))\n"
+               "    return out\n")
+        assert _codes(src, config=_hot()) == ["PEV003"]
+
+    def test_comprehension_counts_as_a_loop(self):
+        # the most common spelling of the per-element sync
+        src = ("def drain(batches):\n"
+               "    return [b.item() for b in batches]\n")
+        assert _codes(src, config=_hot()) == ["PEV003"]
+
+    def test_cold_module_not_in_scope(self):
+        src = ("def drain(batches):\n"
+               "    return [b.item() for b in batches]\n")
+        assert _codes(src, config=AnalysisConfig(hot_modules=())) == []
+
+
+# --- PEV004: donation guard ---------------------------------------------------
+
+_PEV004_ONLY = AnalysisConfig(rules=frozenset({"PEV004"}))
+
+
+class TestDonationGuard:
+    def test_unguarded_donation_flags(self):
+        src = ("import jax\n"
+               "step = jax.jit(lambda c, x: c + x, donate_argnums=(0,))\n")
+        assert _codes(src) == ["PEV004"]
+
+    def test_inline_ifexp_guard_passes(self):
+        src = ("import jax\n"
+               "def build(donate_ok):\n"
+               "    return jax.jit(lambda c: c,\n"
+               "                   donate_argnums=(0,) if donate_ok else ())\n")
+        assert _codes(src, config=_PEV004_ONLY) == []
+
+    def test_donate_param_passes(self):
+        # epoch_step_for's contract: the backend-aware caller decides
+        src = ("import jax\n"
+               "def build(fn, donate=False):\n"
+               "    return jax.jit(fn, donate_argnums=(0,) if donate else ())\n")
+        assert _codes(src, config=_PEV004_ONLY) == []
+
+    def test_module_backend_guard_passes(self):
+        src = ("import jax\n"
+               "_donated = jax.jit(lambda c: c, donate_argnums=(0,))\n"
+               "_plain = jax.jit(lambda c: c)\n"
+               "def pick():\n"
+               "    return _plain if jax.default_backend() == 'cpu' "
+               "else _donated\n")
+        assert _codes(src) == []
+
+    def test_docstring_mention_of_the_guard_does_not_exempt(self):
+        src = ('"""This module never calls jax.default_backend()."""\n'
+               "import jax\n"
+               "step = jax.jit(lambda c: c, donate_argnums=(0,))\n")
+        assert _codes(src, config=_PEV004_ONLY) == ["PEV004"]
+
+
+# --- PEV005: silent worker except ---------------------------------------------
+
+class TestSilentWorkerExcept:
+    BUG = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self.t = threading.Thread(target=self._pump_loop)
+
+    def _pump_loop(self):
+        while True:
+            try:
+                self.step()
+            except Exception:
+                continue
+"""
+
+    def test_silent_swallow_in_worker_loop_flags(self):
+        assert _codes(self.BUG) == ["PEV005"]
+
+    def test_emitting_handler_is_clean(self):
+        fixed = self.BUG.replace(
+            "            except Exception:\n                continue",
+            "            except Exception:\n"
+            "                self.errors.inc()\n                continue")
+        assert _codes(fixed) == []
+
+    def test_captured_exception_for_later_surfacing_is_clean(self):
+        # the CheckpointManager._drain_loop idiom
+        fixed = self.BUG.replace(
+            "            except Exception:\n                continue",
+            "            except Exception as e:\n"
+            "                self._worker_error = e")
+        assert _codes(fixed) == []
+
+    def test_nested_loops_report_the_handler_once(self):
+        src = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self.t = threading.Thread(target=self._pump_loop)
+
+    def _pump_loop(self):
+        while True:
+            for x in self.batch():
+                try:
+                    self.step(x)
+                except Exception:
+                    continue
+"""
+        assert _codes(src) == ["PEV005"]
+
+    def test_same_shape_outside_a_worker_is_not_flagged(self):
+        src = ("def parse_all(lines):\n"
+               "    out = []\n"
+               "    for line in lines:\n"
+               "        try:\n"
+               "            out.append(int(line))\n"
+               "        except ValueError:\n"
+               "            continue\n"
+               "    return out\n")
+        assert _codes(src) == []
+
+
+# --- PEV006: mutable shared state ---------------------------------------------
+
+class TestMutableSharedState:
+    def test_mutable_default_flags(self):
+        assert _codes("def f(acc=[]):\n    return acc\n") == ["PEV006"]
+        assert _codes("def f(acc=None):\n    return acc or []\n") == []
+
+    def test_lowercase_module_mutable_mutated_from_function_flags(self):
+        src = ("pending = []\n\n"
+               "def enqueue(x):\n"
+               "    pending.append(x)\n")
+        assert _codes(src) == ["PEV006"]
+
+    def test_screaming_snake_singleton_is_the_declared_idiom(self):
+        src = ("_KERNEL_CACHE = {}\n\n"
+               "def cache_put(k, v):\n"
+               "    _KERNEL_CACHE[k] = v\n")
+        assert _codes(src) == []
+
+    def test_unmutated_module_list_is_fine(self):
+        src = ("default_tiers = [0, 1]\n\n"
+               "def tiers():\n"
+               "    return list(default_tiers)\n")
+        assert _codes(src) == []
+
+    def test_local_shadowing_the_module_name_is_not_a_mutation(self):
+        src = ("pending = []\n\n"
+               "def f(x):\n"
+               "    pending = []\n"
+               "    pending.append(x)\n"
+               "    return pending\n")
+        assert _codes(src) == []
+
+    def test_param_shadowing_the_module_name_is_not_a_mutation(self):
+        src = ("pending = []\n\n"
+               "def f(pending, x):\n"
+               "    pending.append(x)\n"
+               "    return pending\n")
+        assert _codes(src) == []
+
+
+# --- PEV101/PEV102: the PR 12 lockset class -----------------------------------
+
+PR12_BUG = """\
+import threading
+
+class MetricsSeries:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.series = {}
+
+    def inc(self, key, amount=1):
+        self.series[key] = self.series.get(key, 0) + amount
+"""
+
+PR12_FIXED = """\
+import threading
+
+class MetricsSeries:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.series = {}
+
+    def inc(self, key, amount=1):
+        with self._lock:
+            self.series[key] = self.series.get(key, 0) + amount
+"""
+
+
+class TestLockset:
+    def test_pr12_unlocked_counter_flags(self):
+        codes = _codes(PR12_BUG, config=_threaded())
+        assert codes == ["PEV101"]
+
+    def test_pr12_locked_fix_is_clean(self):
+        assert _codes(PR12_FIXED, config=_threaded()) == []
+
+    def test_get_or_create_race_flags_and_locked_version_passes(self):
+        bug = """\
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def get_or_create(self, name):
+        m = self._metrics.get(name)
+        if m is None:
+            m = object()
+            self._metrics[name] = m
+        return m
+"""
+        assert _codes(bug, config=_threaded()) == ["PEV101"]
+        fixed = bug.replace(
+            "        m = self._metrics.get(name)\n"
+            "        if m is None:\n"
+            "            m = object()\n"
+            "            self._metrics[name] = m\n"
+            "        return m",
+            "        with self._lock:\n"
+            "            m = self._metrics.get(name)\n"
+            "            if m is None:\n"
+            "                m = object()\n"
+            "                self._metrics[name] = m\n"
+            "        return m")
+        assert _codes(fixed, config=_threaded()) == []
+
+    def test_two_thread_mini_class_without_lock(self):
+        src = """\
+import threading
+
+class TickPump:
+    def __init__(self):
+        self.ticks = 0
+        self.t = threading.Thread(target=self._tick_loop)
+
+    def _tick_loop(self):
+        while True:
+            self.ticks += 1
+"""
+        assert _codes(src, config=_threaded()) == ["PEV101"]
+
+    def test_method_not_thread_reachable_is_not_flagged_without_lock(self):
+        src = """\
+import threading
+
+class TickPump:
+    def __init__(self):
+        self.ticks = 0
+        self.polls = 0
+        self.t = threading.Thread(target=self._tick_loop)
+
+    def _tick_loop(self):
+        while True:
+            self.tick()
+
+    def tick(self):
+        self.ticks += 1
+
+    def unrelated_main_thread_only(self):
+        self.polls += 1
+"""
+        result = analyze_source(src, "mod.py", _threaded())
+        flagged = {(f.code, f.context) for f in result.findings}
+        # tick() is reachable from the thread target through the call
+        # graph; the main-thread-only method is not
+        assert flagged == {("PEV101", "TickPump.tick")}
+
+    def test_inconsistent_blind_store_flags_pev102(self):
+        src = """\
+import threading
+
+class View:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.current = None
+
+    def get(self):
+        with self._lock:
+            return self.current
+
+    def publish(self, view):
+        self.current = view
+"""
+        assert _codes(src, config=_threaded()) == ["PEV102"]
+
+    def test_helper_always_called_under_lock_is_credited(self):
+        src = """\
+import threading
+
+class Breaker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self.transitions = []
+
+    def _set(self, state):
+        self.state = state
+        self.transitions.append(state)
+
+    def trip(self):
+        with self._lock:
+            self._set("open")
+
+    def heal(self):
+        with self._lock:
+            self._set("closed")
+"""
+        assert _codes(src, config=_threaded()) == []
+
+    def test_inherited_lock_discipline_applies_to_subclass(self):
+        src = """\
+import threading
+
+class _Metric:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.series = {}
+
+    def inc(self, key):
+        with self._lock:
+            self.series[key] = self.series.get(key, 0) + 1
+
+class Gauge(_Metric):
+    def set(self, key, value):
+        self.series[key] = value
+"""
+        result = analyze_source(src, "mod.py", _threaded())
+        # a dict-subscript store counts as a read-modify-write (insertion
+        # races a concurrent resize), so the subclass's unlocked write
+        # against the BASE class's discipline is the stronger PEV101 —
+        # exactly the real telemetry/registry.py Gauge.set finding
+        assert [(f.code, f.context) for f in result.findings] \
+            == [("PEV101", "Gauge.set")]
+
+    def test_wrong_lock_is_not_credited(self):
+        # the classic wrong-lock race: a lockish-NAMED but unrelated lock
+        src = """\
+import threading
+
+other_lock = threading.Lock()
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with other_lock:
+            self.n += 1
+
+    def read(self):
+        with self._lock:
+            return self.n
+"""
+        assert _codes(src, config=_threaded()) == ["PEV101"]
+
+    def test_verified_local_alias_of_the_class_lock_is_credited(self):
+        src = """\
+import threading
+
+class Tally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        lock = self._lock
+        with lock:
+            self.n += 1
+"""
+        assert _codes(src, config=_threaded()) == []
+
+    def test_chained_assignment_records_every_target(self):
+        src = """\
+import threading
+
+class Pair:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.a = 0
+        self.b = 0
+
+    def bump(self):
+        self.a = self.b = self.a + 1
+
+    def read(self):
+        with self._lock:
+            return self.a
+"""
+        result = analyze_source(src, "mod.py", _threaded())
+        codes = sorted((f.code, f.context) for f in result.findings)
+        # the self.a RMW must not be shadowed by the self.b store
+        assert ("PEV101", "Pair.bump") in codes
+
+    def test_untheaded_module_is_out_of_scope(self):
+        assert _codes(PR12_BUG, config=AnalysisConfig(
+            threaded_modules=())) == []
+
+
+# --- suppressions -------------------------------------------------------------
+
+class TestSuppressions:
+    def test_same_line_code_suppression(self):
+        src = PR7_BUG.replace("@jax.jit", "@jax.jit  # pev: ignore[PEV001]")
+        assert _codes(src) == []
+
+    def test_comment_line_above_covers_the_next_line(self):
+        src = PR7_BUG.replace(
+            "    @jax.jit",
+            "    # one-shot demo path\n"
+            "    # pev: ignore[PEV001]\n"
+            "    @jax.jit")
+        assert _codes(src) == []
+
+    def test_comment_above_survives_an_intervening_blank_line(self):
+        src = PR7_BUG.replace(
+            "    @jax.jit",
+            "    # pev: ignore[PEV001]\n"
+            "\n"
+            "    @jax.jit")
+        assert _codes(src) == []
+
+    def test_wrong_code_does_not_suppress(self):
+        src = PR7_BUG.replace("@jax.jit", "@jax.jit  # pev: ignore[PEV006]")
+        assert _codes(src) == ["PEV001"]
+
+    def test_bare_ignore_suppresses_everything_on_the_line(self):
+        src = PR7_BUG.replace("@jax.jit", "@jax.jit  # pev: ignore")
+        assert _codes(src) == []
+
+    def test_suppressed_findings_are_counted(self):
+        src = PR7_BUG.replace("@jax.jit", "@jax.jit  # pev: ignore[PEV001]")
+        result = analyze_source(src, "mod.py", AnalysisConfig())
+        assert result.suppressed == 1 and result.findings == []
+
+    def test_parse_suppressions_shapes(self):
+        sup = parse_suppressions(
+            "x = 1  # pev: ignore[PEV001, PEV102]\n"
+            "# pev: ignore\n"
+            "y = 2\n")
+        assert sup[1] == frozenset({"PEV001", "PEV102"})
+        assert sup[2] is None and sup[3] is None
+
+    def test_malformed_code_list_fails_closed(self):
+        # a typo must suppress NOTHING, never widen to everything
+        assert parse_suppressions("x = 1  # pev: ignore[pev001]\n") == {}
+        assert parse_suppressions("x = 1  # pev: ignore[PEV001\n") == {}
+        assert parse_suppressions("x = 1  # pev: ignore[]\n") == {}
+        src = PR7_BUG.replace("@jax.jit", "@jax.jit  # pev: ignore[pev001]")
+        assert _codes(src) == ["PEV001"]
+
+
+# --- baseline semantics -------------------------------------------------------
+
+class TestBaseline:
+    def _one_finding(self):
+        result = analyze_source(PR7_BUG, "pkg/mod.py", AnalysisConfig())
+        assert len(result.findings) == 1
+        return result.findings[0]
+
+    def test_baselined_finding_is_absorbed_line_independently(self):
+        f = self._one_finding()
+        bl = Baseline(entries=[dict(Baseline.entry_for(f, "demo path"))])
+        shifted = f.__class__(**{**f.__dict__, "line": f.line + 40})
+        new, absorbed, stale = bl.match([shifted])
+        assert new == [] and len(absorbed) == 1 and stale == []
+
+    def test_unmatched_finding_is_new_and_entry_goes_stale(self):
+        f = self._one_finding()
+        entry = Baseline.entry_for(f, "demo path")
+        entry["key"] = "something_else = jax.jit(fn)"
+        bl = Baseline(entries=[entry])
+        new, absorbed, stale = bl.match([f])
+        assert new == [f] and absorbed == [] and stale == [entry]
+
+    def test_count_budget_absorbs_exactly_n(self):
+        f = self._one_finding()
+        entry = Baseline.entry_for(f, "two known copies")
+        entry["count"] = 2
+        bl = Baseline(entries=[entry])
+        new, absorbed, _ = bl.match([f, f, f])
+        assert len(absorbed) == 2 and len(new) == 1
+
+    def test_justification_is_mandatory(self, tmp_path):
+        f = self._one_finding()
+        entry = Baseline.entry_for(f, "")
+        p = tmp_path / "bl.json"
+        p.write_text(json.dumps({"version": 1, "entries": [entry]}))
+        with pytest.raises(AssertionError):
+            Baseline.load(p)
+
+    def test_load_dump_roundtrip(self, tmp_path):
+        f = self._one_finding()
+        bl = Baseline(entries=[Baseline.entry_for(f, "demo path")])
+        p = tmp_path / "bl.json"
+        p.write_text(bl.dump())
+        assert Baseline.load(p).entries == bl.entries
+
+
+# --- reporters ----------------------------------------------------------------
+
+class TestReporters:
+    def test_json_schema_stability(self):
+        summary = gate(["pos_evolution_tpu/analysis"], root=REPO_ROOT)
+        blob = render_json(summary)
+        assert tuple(sorted(blob)) == tuple(sorted(SCHEMA_KEYS))
+        assert blob["version"] == 1
+        for f in blob["findings"]:
+            assert tuple(sorted(f)) == tuple(sorted(FINDING_KEYS))
+        # every registered code is documented in the report
+        assert set(blob["rules"]) >= {"PEV001", "PEV002", "PEV003",
+                                      "PEV004", "PEV005", "PEV006",
+                                      "PEV101", "PEV102"}
+        json.dumps(blob)  # must be serializable as-is
+
+    def test_text_report_carries_locations_and_tally(self):
+        from pos_evolution_tpu.analysis.__main__ import Summary
+        from pos_evolution_tpu.analysis.report import render_text
+        result = analyze_source(PR7_BUG, "pkg/mod.py", AnalysisConfig())
+        text = render_text(Summary(files_scanned=1, new=result.findings))
+        assert "pkg/mod.py:4" in text and "PEV001=1" in text
+
+
+# --- doctor & CLI gate semantics ----------------------------------------------
+
+class TestDoctorAndCLI:
+    def test_doctor_finds_exactly_the_expected_codes(self):
+        lines = []
+        assert run_doctor(out=lines.append) == DOCTOR_FINDINGS
+        joined = "\n".join(lines)
+        for code, n in EXPECTED.items():
+            assert joined.count(f" {code} ") == n, code
+
+    def test_doctor_detects_a_broken_analyzer(self, monkeypatch):
+        import pos_evolution_tpu.analysis.doctor as doctor_mod
+        # analyzer "finds nothing": clean pass on the doctored file
+        monkeypatch.setattr(
+            doctor_mod, "analyze_source",
+            lambda *a, **k: type("R", (), {"findings": []})())
+        assert doctor_mod.run_doctor(out=lambda s: None) == DOCTOR_OK_NONE
+
+    def test_doctor_detects_a_mismatch(self, monkeypatch):
+        import pos_evolution_tpu.analysis.doctor as doctor_mod
+        monkeypatch.setitem(doctor_mod.EXPECTED, "PEV001", 7)
+        assert doctor_mod.run_doctor(out=lambda s: None) == DOCTOR_MISMATCH
+
+    def test_cli_doctor_exit_code(self, capsys):
+        assert main(["--doctor"]) == DOCTOR_FINDINGS
+        assert "doctor: all" in capsys.readouterr().out
+
+    def test_cli_strict_gate_is_clean_on_the_shipped_tree(self, capsys):
+        # THE acceptance pin: tree + checked-in baseline = rc 0
+        rc = main(["--root", REPO_ROOT, "--strict",
+                   "--baseline", os.path.join(REPO_ROOT,
+                                              "analysis_baseline.json")])
+        out = capsys.readouterr().out
+        assert rc == 0, f"shipped tree must gate clean:\n{out}"
+        assert "0 new finding(s)" in out
+
+    def test_cli_tests_scope_gate_is_clean(self, capsys):
+        rc = main(["--root", REPO_ROOT, "tests",
+                   "--rules", "PEV002,PEV006",
+                   "--assume-scope", "decision", "--baseline", "none"])
+        assert rc == 0, capsys.readouterr().out
+
+    def test_cli_rules_filter(self):
+        summary = gate(["pos_evolution_tpu/analysis"], root=REPO_ROOT,
+                       config=AnalysisConfig(rules=frozenset({"PEV006"})))
+        assert all(f.code == "PEV006" for f in summary.new)
+
+    def test_syntax_error_is_reported_not_crashed(self):
+        result = analyze_source("def broken(:\n", "bad.py", AnalysisConfig())
+        assert result.parse_error is not None
+        assert [f.code for f in result.findings] == ["PEV000"]
+
+    def test_nonexistent_path_is_a_loud_error_not_a_clean_pass(self, capsys):
+        rc = main(["--root", REPO_ROOT, "no_such_dir", "--baseline", "none"])
+        assert rc == 2
+        assert "does not exist" in capsys.readouterr().err
