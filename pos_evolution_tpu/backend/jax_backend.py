@@ -95,9 +95,48 @@ def sync_update_verify(batch):
 def das_verify(batch):
     """Batched DAS sample verification on device: one SHA-256 lane per
     sampled cell + the jitted scan merkle walk (bit-identical to
-    numpy_backend.das_verify)."""
-    from pos_evolution_tpu.ops.das_verify import verify_samples_device
+    numpy_backend.das_verify). Small batches stay on the host path —
+    the fixed device-dispatch overhead only amortizes past the merkle
+    crossover (``Config.merkle_device_min_pairs``), and the verdicts are
+    bit-identical either way."""
+    from pos_evolution_tpu.ops import merkle_device
+    from pos_evolution_tpu.ops.das_verify import (
+        verify_samples_device,
+        verify_samples_host,
+    )
+    mode = merkle_device.get_mode()
+    # one sample ≈ 16 pair-equivalents of SHA-256 work (cell-hash blocks
+    # + the branch walk), so the pair-denominated crossover divides down
+    floor = merkle_device.small_batch_floor(per_item_pairs=16)
+    if mode == "host" or (mode == "auto" and batch.size < floor):
+        return verify_samples_host(batch)
     return verify_samples_device(batch)
+
+
+def merkle_level(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    """One merkle level sweep on device: the batched SHA-256 kernel with
+    the Pallas -> XLA -> NumPy fallback ladder (bit-identical to
+    numpy_backend.merkle_level)."""
+    from pos_evolution_tpu.ops.merkle_device import merkle_level_device
+    return merkle_level_device(left, right)
+
+
+def merkleize(chunks: np.ndarray, limit: int | None = None) -> bytes:
+    """Whole-tree merkleization (bit-identical to
+    numpy_backend.merkleize). Convenience front: the real per-level seam
+    is ``merkle_level`` — ops/merkle_device.merkleize dispatches each
+    sweep back through it when the batch is device-eligible."""
+    from pos_evolution_tpu.ops.merkle_device import merkleize as _m
+    return _m(chunks, limit)
+
+
+def build_multiproof_paths(leaves: np.ndarray, indices, depth: int):
+    """Shared-tree proof-branch extraction: one tree build through the
+    dispatch layer (device sweeps when eligible), then vectorized
+    sibling gathers on the host copies (bit-identical to
+    numpy_backend.build_multiproof_paths, which pins host)."""
+    from pos_evolution_tpu.ops.merkle_device import build_multiproof_paths
+    return build_multiproof_paths(leaves, indices, depth)
 
 
 def das_reconstruct(cells: np.ndarray, present: np.ndarray):

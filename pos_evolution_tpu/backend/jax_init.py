@@ -1,0 +1,31 @@
+"""One place that touches process-global JAX configuration.
+
+Every device kernel in this repo depends on exact int64 Gwei/epoch
+arithmetic (``jax_enable_x64``); historically each op module flipped the
+flag at *import* time, so merely importing ``ops/sha256.py`` mutated the
+process for every other jax user in it. ``ensure_x64`` is the
+consolidated, idempotent entry point: op modules call it lazily — on
+first kernel *use*, never at import — and modules that are jax-only by
+contract may call it at the top of their device builders.
+"""
+
+from __future__ import annotations
+
+_X64_DONE = False
+
+
+def ensure_x64() -> None:
+    """Enable 64-bit jax types, once per process. Safe to call from
+    inside traced code (idempotent, guarded) and cheap after the first
+    call."""
+    global _X64_DONE
+    if _X64_DONE:
+        return
+    import jax
+
+    # read-before-write: when another module (or a previous call) already
+    # enabled it, touching the config again — possibly from inside a
+    # trace — is pure risk with zero effect
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+    _X64_DONE = True

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from pos_evolution_tpu.ssz.hash import hash_eth2, sha256_batch
+from pos_evolution_tpu.ssz.hash import hash_eth2, sha256_batch, sha256_pairs
 
 name = "numpy"
 
@@ -89,6 +89,30 @@ def link_tally(link_idx, weight, active, n_links):
     (ops/variant_tally.py contract)."""
     from pos_evolution_tpu.ops.variant_tally import link_tally_host
     return link_tally_host(link_idx, weight, active, n_links)
+
+
+def merkle_level(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    """One merkle level sweep: sha256(left[i] || right[i]) over (N, 32)
+    u8 rows (ops/merkle_device.py contract). The host kernel — native
+    C++ core when built, vectorized NumPy lanes otherwise."""
+    return sha256_pairs(np.ascontiguousarray(left),
+                        np.ascontiguousarray(right))
+
+
+def merkleize(chunks: np.ndarray, limit: int | None = None) -> bytes:
+    """Whole-tree merkleization (SSZ padding rules) on the host path."""
+    from pos_evolution_tpu.ssz.merkle import merkleize_chunks
+    return merkleize_chunks(chunks, limit)
+
+
+def build_multiproof_paths(leaves: np.ndarray, indices, depth: int):
+    """Shared-tree proof-branch extraction (ops/merkle_device.py
+    contract), PINNED to host sweeps — this backend is the reference
+    oracle, so it must not pick up the thread's device dispatch state."""
+    from pos_evolution_tpu.ops.merkle_device import (
+        build_multiproof_paths_host,
+    )
+    return build_multiproof_paths_host(leaves, indices, depth)
 
 
 def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:

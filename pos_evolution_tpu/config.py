@@ -141,6 +141,14 @@ class Config:
     das_max_blobs_per_block: int = 2
     das_samples_per_client: int = 8
 
+    # --- device merkleization (ops/merkle_device.py, DESIGN.md §22) ---
+    # Level sweeps with fewer sibling pairs than this stay on the host
+    # SHA-256 path: below the crossover the fixed device-dispatch
+    # overhead (transfer + launch) loses to the host kernel. Measured by
+    # ``scripts/bench_merkle.py``; auto-dispatch additionally requires a
+    # real accelerator (jax-on-CPU never wins against the native core).
+    merkle_device_min_pairs: int = 4096
+
     # --- protocol-variant knobs (L7) ---
     # Vote expiry period η: ∞ (None→2**62) = LMD, 1 = Goldfish
     # (pos-evolution.md:1585).

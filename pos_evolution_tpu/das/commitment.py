@@ -3,12 +3,14 @@
 A commitment scheme binds one blob's extended cell grid to a 32-byte
 commitment and proves individual cells (or batches of cells) against it.
 The default ``MerkleCellScheme`` is a padded binary merkle tree over the
-per-cell SHA-256 leaves — every tree level is one batched
-``sha256_pairs`` sweep (ssz/hash.py on host, ops/sha256.py on device),
-the level-sweep kernel shape of the MTU tree-unit paper (arxiv
-2507.16793) — with generalized-index multiproofs
-(``ssz.merkle.build_multiproof``) standing in for the polynomial
-multiproofs of arxiv 2604.16559.
+per-cell SHA-256 leaves — every tree level is one batched sweep through
+the ``ops/merkle_device.py`` dispatch layer (host SHA-256 below the
+crossover, the batched device kernel above it; DESIGN.md §22), the
+level-sweep kernel shape of the MTU tree-unit paper (arxiv 2507.16793)
+— with generalized-index multiproofs standing in for the polynomial
+multiproofs of arxiv 2604.16559. Proof branches come off ONE shared
+tree build via vectorized sibling gathers
+(``build_multiproof_paths``).
 
 The scheme is a seam, not a constant: commitments travel as opaque
 32-byte roots and every verifier goes through the scheme object, so a
@@ -22,15 +24,13 @@ from __future__ import annotations
 import numpy as np
 
 from pos_evolution_tpu.config import cfg
-from pos_evolution_tpu.ssz.hash import sha256_batch
-from pos_evolution_tpu.ssz.merkle import (
-    ZERO_HASHES,
-    _tree_levels,
-    build_multiproof,
-    merkle_tree_branch,
-    merkleize_chunks,
-    verify_multiproof,
+from pos_evolution_tpu.ops.merkle_device import (
+    build_multiproof_paths,
+    merkleize,
+    multiproof,
 )
+from pos_evolution_tpu.ssz.hash import sha256_batch
+from pos_evolution_tpu.ssz.merkle import merkle_tree_branch, verify_multiproof
 
 __all__ = [
     "CellCommitmentScheme",
@@ -86,7 +86,10 @@ class MerkleCellScheme(CellCommitmentScheme):
         return sha256_batch(np.ascontiguousarray(cells, dtype=np.uint8))
 
     def commit(self, cells: np.ndarray) -> bytes:
-        return merkleize_chunks(self.cell_leaves(cells))
+        # level sweeps through the device dispatch layer
+        # (ops/merkle_device.py): host below the crossover, the batched
+        # SHA-256 kernel above it — same bytes either way
+        return merkleize(self.cell_leaves(cells))
 
     def branch(self, cells: np.ndarray, index: int) -> np.ndarray:
         leaves = self.cell_leaves(cells)
@@ -97,24 +100,15 @@ class MerkleCellScheme(CellCommitmentScheme):
     def branches(self, cells: np.ndarray, indices) -> tuple[np.ndarray, np.ndarray]:
         """(leaves[indices], (len(indices), depth, 32) branches) for the
         batched sample-verification kernel — leaves hashed once, every
-        branch read off one shared tree."""
+        branch gathered VECTORIZED off one shared (device-built) tree."""
         leaves = self.cell_leaves(cells)
-        depth = self.depth_for(leaves.shape[0])
-        levels = _tree_levels(leaves, depth)  # hash the tree ONCE
-        out = np.zeros((len(indices), depth, 32), dtype=np.uint8)
-        for j, i in enumerate(indices):
-            idx = int(i)
-            for d in range(depth):
-                layer, sib = levels[d], idx ^ 1
-                out[j, d] = (layer[sib] if sib < layer.shape[0]
-                             else ZERO_HASHES[d])
-                idx >>= 1
-        return leaves[np.asarray(indices, dtype=np.int64)], out
+        return build_multiproof_paths(leaves, indices,
+                                      self.depth_for(leaves.shape[0]))
 
     def prove_cells(self, cells: np.ndarray, indices) -> list[bytes]:
         leaves = self.cell_leaves(cells)
-        return build_multiproof(leaves, [int(i) for i in indices],
-                                self.depth_for(leaves.shape[0]))
+        return multiproof(leaves, [int(i) for i in indices],
+                          self.depth_for(leaves.shape[0]))
 
     def verify_cells(self, commitment: bytes, cells: np.ndarray, indices,
                      proof: list[bytes]) -> bool:

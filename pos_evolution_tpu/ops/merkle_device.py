@@ -1,0 +1,444 @@
+"""Device-resident merkleization: the dispatch layer behind every
+SHA-256 level sweep (ROADMAP item 4, DESIGN.md §22).
+
+Incremental SSZ (PR 6) made merkleization do *less* hashing; this module
+decides where the remaining hashes RUN. Every consumer of the merkle
+combiner — ``ssz/incremental.py`` dirty-path rehashes,
+``das/commitment.MerkleCellScheme`` leaf-tree builds + proof-branch
+extraction, ``ops/das_verify`` sample batches, the resilience checkpoint
+payload digests, the dense driver's state witness — funnels its level
+sweeps through ``pair_hash``, which picks a path per call:
+
+- **device** (jax backend active, batch past the measured crossover):
+  the batched SHA-256 kernel — the Pallas merkle-level kernel
+  (``ops/pallas_sha256.merkle_level_pallas``) when an accelerator is
+  attached and the padded batch fills its 512-lane tiles, else the
+  jitted XLA formulation (``ops/sha256.sha256_pair_words``). Batches are
+  padded to the next power of two so the shape lattice (and therefore
+  the retrace count) stays logarithmic.
+- **host**: ``ssz/hash.sha256_pairs`` (native C++ core when built,
+  vectorized NumPy lanes otherwise) — bit-identical by construction
+  (SHA-256 is exact integer arithmetic on every path).
+
+The **fallback ladder** is Pallas -> XLA -> NumPy: a missing/broken
+Pallas lowering drops to XLA (counted ``fallback_xla``), a missing or
+failing jax drops all the way to the host path (``fallback_numpy``) —
+a degraded box computes the same roots, slower, loudly (telemetry).
+
+Dispatch is sized, not assumed: ``Config.merkle_device_min_pairs`` is
+the crossover below which the fixed device-dispatch overhead loses to
+the host path (measured by ``scripts/bench_merkle.py``; the device wins
+only on real accelerators, so the *auto* mode also stays on host when
+jax is running on CPU). ``set_mode`` forces ``"device"``/``"host"`` for
+parity tests and benches. Every decision lands in ``stats()`` — the sim
+driver snapshots the deltas per slot and ``run_report.py`` renders the
+device-vs-host split and device sweep throughput.
+
+``LevelSweeper`` is the batching half of the tentpole: a lockstep
+coordinator that advances MANY trees' dirty-path updates one level per
+round and hashes all of a round's pairs in ONE ``pair_hash`` call — one
+kernel launch services every dirty path of a ``ContainerTreeCache``
+rehash instead of one call per level per field (the MTU tree-unit shape
+of arxiv 2507.16793: one tree-structured datapath serving merkleization,
+multiproof generation and verification).
+
+Import-time contract: this module imports numpy only; jax is reached
+lazily on the first device-eligible sweep (the numpy backend never pays
+for it), and process-global jax config goes through
+``backend/jax_init.ensure_x64`` — never a module-import side effect.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from functools import lru_cache
+
+import numpy as np
+
+from pos_evolution_tpu.ssz.hash import sha256_pairs
+from pos_evolution_tpu.ssz.merkle import (
+    ZERO_HASHES,
+    _tree_levels,
+    build_multiproof,
+    merkleize_chunks,
+    mix_in_length,
+)
+
+__all__ = [
+    "pair_hash", "merkle_level_device", "merkleize", "tree_levels",
+    "build_multiproof_paths", "build_multiproof_paths_host",
+    "multiproof", "digest_bytes",
+    "LevelSweeper", "drive", "set_mode", "get_mode", "stats",
+    "reset_stats", "device_eligible", "small_batch_floor", "DIGEST_ALGO",
+]
+
+# Manifest tag for the merkle payload digest (resilience/manager.py):
+# 32-byte chunks (zero-padded), SSZ vector-rule merkleization, byte
+# length mixed in. Host and device paths produce identical bytes.
+DIGEST_ALGO = "merkle32-sha256-v1"
+
+_MODES = ("auto", "device", "host")
+_MODE = "auto"
+
+# Cumulative process counters; the sim driver feeds per-slot deltas to
+# its MetricsRegistry (``merkle.*``) and run_report.py renders them.
+# Locked: pair_hash is reached from serve-tier worker threads (proof
+# builds) and the async checkpoint writer, not just the sim loop.
+_STATS = {
+    "device_sweeps": 0,    # level sweeps that ran on the device path
+    "host_sweeps": 0,      # level sweeps served by the host kernel
+    "device_pairs": 0,     # sibling pairs hashed on device
+    "host_pairs": 0,       # sibling pairs hashed on host
+    "fallback_xla": 0,     # Pallas unavailable/failed -> XLA
+    "fallback_numpy": 0,   # jax unavailable/failed -> NumPy host
+    "batched_launches": 0,  # LevelSweeper rounds (one launch each)
+    "batched_jobs": 0,     # tree-update jobs coalesced into those rounds
+    "device_ms": 0.0,      # wall-clock spent in device sweeps
+}
+_STATS_LOCK = threading.Lock()
+
+
+def _bump(**deltas) -> None:
+    with _STATS_LOCK:
+        for k, v in deltas.items():
+            _STATS[k] += v
+
+
+def stats() -> dict:
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def reset_stats() -> None:
+    with _STATS_LOCK:
+        for k in _STATS:
+            _STATS[k] = 0.0 if k == "device_ms" else 0
+
+
+def set_mode(mode: str) -> str:
+    """Force the dispatch decision: ``"device"`` (always device when the
+    jax backend is active), ``"host"`` (never device), ``"auto"``
+    (threshold + accelerator crossover). Returns the previous mode."""
+    global _MODE
+    if mode not in _MODES:
+        raise ValueError(f"merkle dispatch mode must be one of {_MODES}")
+    prev, _MODE = _MODE, mode
+    return prev
+
+
+def get_mode() -> str:
+    return _MODE
+
+
+def _min_pairs() -> int:
+    from pos_evolution_tpu.config import cfg
+    return cfg().merkle_device_min_pairs
+
+
+def small_batch_floor(per_item_pairs: int = 1) -> int:
+    """The measured crossover, exported for sibling dispatchers.
+    ``per_item_pairs`` converts units: the knob is sized in sibling-PAIR
+    compressions, so a dispatcher whose batch items are heavier (a DAS
+    sample = cell-hash blocks + a depth-deep branch walk, ~16
+    compressions) divides the floor accordingly — same total-work
+    crossover, different item count."""
+    return max(_min_pairs() // max(per_item_pairs, 1), 1)
+
+
+def device_eligible(n_pairs: int) -> bool:
+    """Would a sweep of ``n_pairs`` sibling pairs go to the device?"""
+    if _MODE == "host" or n_pairs <= 0:
+        return False
+    from pos_evolution_tpu.backend import get_backend
+    if getattr(get_backend(), "name", "") != "jax":
+        return False
+    if _MODE == "device":
+        return True
+    if n_pairs < _min_pairs():
+        return False
+    try:
+        import jax
+        # jax-on-CPU is the same silicon as the host kernel plus
+        # dispatch overhead — the crossover never arrives (measured in
+        # bench_merkle); real accelerators flip this.
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+# --- word/byte plumbing -------------------------------------------------------
+
+def _rows_to_words(rows: np.ndarray) -> np.ndarray:
+    """(N, 32) u8 digest rows -> (N, 8) u32 big-endian words."""
+    return np.ascontiguousarray(rows, dtype=np.uint8).reshape(
+        -1, 8, 4).view(">u4")[..., 0].astype(np.uint32)
+
+
+def _words_to_rows(words) -> np.ndarray:
+    """(N, 8) u32 words -> (N, 32) u8 digest rows."""
+    return np.asarray(words, dtype=np.uint32).astype(
+        ">u4").view(np.uint8).reshape(-1, 32)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+# Device batches are padded UP to at least this many pairs: the tail
+# levels of a tree sweep (1, 2, 4, ... pairs) would otherwise each mint
+# their own compiled shape — one padded floor shape absorbs them all,
+# and hashing a few dozen zero pairs is cheaper than one retrace.
+_MIN_PAD_PAIRS = 128
+
+
+# --- device kernels (the fallback ladder) -------------------------------------
+
+@lru_cache(maxsize=None)
+def _xla_level_for():
+    """Memoized jitted XLA level kernel: (N, 16) u32 message words
+    (left||right digest words per pair) -> (N, 8) u32 digests. Built
+    once per process; retraces only per padded (pow2) batch shape."""
+    import jax
+
+    from pos_evolution_tpu.backend.jax_init import ensure_x64
+    ensure_x64()
+
+    from pos_evolution_tpu.ops.sha256 import sha256_pair_words
+
+    @jax.jit
+    def level(words16):
+        return sha256_pair_words(words16[:, :8], words16[:, 8:])
+
+    return level
+
+
+def _pallas_usable(m: int) -> bool:
+    """Top rung precondition: a real accelerator and a padded batch that
+    fills the kernel's lane tiles. Split out so the ladder tests can
+    force the rung on a CPU box and watch the fallback trip."""
+    try:
+        import jax
+
+        from pos_evolution_tpu.ops.pallas_sha256 import TILE
+    except Exception:
+        return False
+    return m % TILE == 0 and jax.default_backend() != "cpu"
+
+
+def _pallas_level(words16: np.ndarray) -> np.ndarray:
+    """Pallas rung: (N, 16) u32, N a multiple of TILE. Raises on any
+    failure — the caller's ladder catches and drops to XLA."""
+    import jax.numpy as jnp
+
+    from pos_evolution_tpu.ops.pallas_sha256 import merkle_level_pallas
+    return np.asarray(merkle_level_pallas(
+        jnp.asarray(np.ascontiguousarray(words16.T))).T)
+
+
+def merkle_level_device(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    """One merkle level on the device path: (N, 32)+(N, 32) u8 -> (N, 32)
+    u8 digests, Pallas -> XLA -> NumPy ladder. This is the jax backend's
+    ``merkle_level`` method; ``pair_hash`` reaches it via dispatch."""
+    n = left.shape[0]
+    words = np.concatenate(
+        [_rows_to_words(left), _rows_to_words(right)], axis=1)
+    m = max(_next_pow2(n), _MIN_PAD_PAIRS)
+    if m != n:  # pad to pow2: bounded shape lattice, sliced back below
+        padded = np.zeros((m, 16), dtype=np.uint32)
+        padded[:n] = words
+        words = padded
+    t0 = time.perf_counter()
+    try:
+        if _pallas_usable(m):
+            try:
+                out_words = _pallas_level(words)
+            except Exception:
+                _bump(fallback_xla=1)
+                import jax.numpy as jnp
+                out_words = _xla_level_for()(jnp.asarray(words))
+        else:
+            import jax.numpy as jnp
+            out_words = _xla_level_for()(jnp.asarray(words))
+        rows = _words_to_rows(out_words)[:n]
+    except Exception:
+        # jax itself missing/broken: the bottom rung still answers
+        _bump(fallback_numpy=1, host_sweeps=1, host_pairs=n)
+        return sha256_pairs(np.ascontiguousarray(left),
+                            np.ascontiguousarray(right))
+    _bump(device_sweeps=1, device_pairs=n,
+          device_ms=(time.perf_counter() - t0) * 1e3)
+    return rows
+
+
+def pair_hash(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    """THE merkle combiner: sha256(left[i] || right[i]) over (N, 32) u8
+    rows, dispatched device/host per the module policy. Bit-identical on
+    every path."""
+    n = left.shape[0]
+    if n == 0:
+        return np.empty((0, 32), dtype=np.uint8)
+    if device_eligible(n):
+        from pos_evolution_tpu.backend import get_backend
+        fn = getattr(get_backend(), "merkle_level", None)
+        if fn is not None:
+            return fn(left, right)
+    _bump(host_sweeps=1, host_pairs=n)
+    return sha256_pairs(np.ascontiguousarray(left),
+                        np.ascontiguousarray(right))
+
+
+# --- whole trees --------------------------------------------------------------
+
+def merkleize(chunks: np.ndarray, limit: int | None = None) -> bytes:
+    """``ssz.merkle.merkleize_chunks`` semantics (virtual zero padding to
+    ``limit``, vector rule when ``limit=None``) with every level routed
+    through ``pair_hash``. Small/ineligible trees delegate to the host
+    whole-tree path unchanged."""
+    chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+    if chunks.ndim == 1:
+        chunks = chunks.reshape(-1, 32)
+    if not device_eligible(chunks.shape[0] // 2):
+        # whole-tree host fast path (one native call); counted so the
+        # device/host split stays honest — a padded binary tree over
+        # count leaves hashes count-1 internal pairs plus the zero cap
+        if chunks.shape[0] > 1:
+            _bump(host_sweeps=1, host_pairs=chunks.shape[0] - 1)
+        return merkleize_chunks(chunks, limit)
+    # the ONE padded walk, with the dispatching combiner
+    return merkleize_chunks(chunks, limit, combine=pair_hash)
+
+
+def tree_levels(leaves: np.ndarray, depth: int) -> list[np.ndarray]:
+    """All levels of the padded tree, leaves first: the ONE
+    ``ssz.merkle._tree_levels`` walk with the dispatching ``pair_hash``
+    as its combiner — each level one (host-or-device) sweep. Virtual
+    zero padding stays virtual — callers read out-of-range nodes from
+    ``ZERO_HASHES``."""
+    return _tree_levels(leaves, depth, combine=pair_hash)
+
+
+def _paths_from_levels(levels: list[np.ndarray], indices, depth: int
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized sibling gather off a built tree: ``(leaves[indices],
+    (S, depth, 32) branches)`` — replaces per-index Python walks."""
+    idx = np.asarray(indices, dtype=np.int64).reshape(-1)
+    out = np.zeros((idx.size, depth, 32), dtype=np.uint8)
+    cur = idx.copy()
+    for d in range(depth):
+        layer = levels[d]
+        sib = cur ^ 1
+        in_range = sib < layer.shape[0]
+        if in_range.any():
+            out[in_range, d] = layer[sib[in_range]]
+        if (~in_range).any():
+            out[~in_range, d] = ZERO_HASHES[d]
+        cur >>= 1
+    return levels[0][idx], out
+
+
+def build_multiproof_paths(leaves: np.ndarray, indices, depth: int
+                           ) -> tuple[np.ndarray, np.ndarray]:
+    """Batched proof-branch extraction: one shared tree build (device
+    level sweeps when eligible), then the vectorized sibling gather —
+    the shape the batched sample-verification kernel consumes."""
+    return _paths_from_levels(tree_levels(leaves, depth), indices, depth)
+
+
+def build_multiproof_paths_host(leaves: np.ndarray, indices, depth: int
+                                ) -> tuple[np.ndarray, np.ndarray]:
+    """Host-pinned twin (the numpy backend's method): the tree builds on
+    ``sha256_pairs`` regardless of the thread's active backend or the
+    dispatch mode — an oracle must not depend on the thing it oracles."""
+    return _paths_from_levels(
+        _tree_levels(leaves, depth, combine=sha256_pairs), indices, depth)
+
+
+def multiproof(leaves: np.ndarray, leaf_indices, depth: int) -> list[bytes]:
+    """``ssz.merkle.build_multiproof`` with the shared tree built through
+    the dispatch layer (same helper order, same bytes)."""
+    return build_multiproof(leaves, leaf_indices, depth, combine=pair_hash)
+
+
+# --- byte-blob digests --------------------------------------------------------
+
+def digest_bytes(blob) -> bytes:
+    """Length-bound merkle digest of a byte string (``DIGEST_ALGO``):
+    32-byte chunks (tail zero-padded), vector-rule merkleization through
+    the dispatch layer, byte length mixed in. The device-portable stand-in
+    for a linear sha256 over checkpoint payloads / witness columns —
+    identical bytes whichever path hashed it."""
+    data = np.frombuffer(blob, dtype=np.uint8) if isinstance(
+        blob, (bytes, bytearray, memoryview)) else \
+        np.ascontiguousarray(blob, dtype=np.uint8).reshape(-1)
+    n = int(data.size)
+    if n == 0:
+        chunks = np.empty((0, 32), dtype=np.uint8)
+    elif n % 32 == 0:
+        chunks = data.reshape(-1, 32)
+    else:
+        padded = np.zeros(((n + 31) // 32) * 32, dtype=np.uint8)
+        padded[:n] = data
+        chunks = padded.reshape(-1, 32)
+    return mix_in_length(merkleize(chunks), n)
+
+
+# --- lockstep batching --------------------------------------------------------
+
+class LevelSweeper:
+    """Coalesce many trees' level sweeps into one kernel launch per
+    level. Jobs are generators that yield ``(left, right)`` pair blocks
+    and receive the digests back via ``send``; each ``run`` round
+    concatenates every active job's current block, hashes it with ONE
+    ``pair_hash`` call, and scatters the digests back. Trees advance in
+    lockstep — level k of every tree hashes together, which is what
+    turns a ``ContainerTreeCache`` rehash from one call per level per
+    field into one launch per level."""
+
+    def __init__(self):
+        self._jobs: list = []
+
+    def add(self, gen) -> None:
+        """Register one tree-update generator (primed to its first pair
+        block; a generator with no hashing to do completes here)."""
+        try:
+            req = next(gen)
+        except StopIteration:
+            return
+        self._jobs.append((gen, req))
+
+    def run(self) -> None:
+        jobs, self._jobs = self._jobs, []
+        if jobs:
+            _bump(batched_jobs=len(jobs))
+        while jobs:
+            lefts = [left for _, (left, _r) in jobs]
+            rights = [right for _, (_l, right) in jobs]
+            digests = pair_hash(
+                np.concatenate(lefts) if len(lefts) > 1 else lefts[0],
+                np.concatenate(rights) if len(rights) > 1 else rights[0])
+            _bump(batched_launches=1)
+            nxt = []
+            off = 0
+            for gen, (left, _right) in jobs:
+                k = left.shape[0]
+                try:
+                    req = gen.send(digests[off:off + k])
+                except StopIteration:
+                    pass
+                else:
+                    nxt.append((gen, req))
+                off += k
+            jobs = nxt
+
+
+def drive(gen) -> None:
+    """Run one tree-update generator standalone: every yielded pair
+    block goes straight through ``pair_hash`` (the no-batching twin of
+    ``LevelSweeper`` for single-tree callers)."""
+    try:
+        req = next(gen)
+        while True:
+            req = gen.send(pair_hash(*req))
+    except StopIteration:
+        return

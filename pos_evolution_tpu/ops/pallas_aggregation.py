@@ -21,7 +21,8 @@ import numpy as np
 
 import jax
 
-jax.config.update("jax_enable_x64", True)
+from pos_evolution_tpu.backend.jax_init import ensure_x64
+ensure_x64()
 
 import jax.numpy as jnp  # noqa: E402
 

@@ -19,12 +19,12 @@ from functools import partial
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
-jax.config.update("jax_enable_x64", True)
-
-import jax.numpy as jnp  # noqa: E402
-
-from pos_evolution_tpu.ops.sha256 import _K, H0, sha256_pair_words  # noqa: E402
+# x64 goes through the one consolidated helper, at first kernel USE —
+# importing this module must never mutate process-global JAX config.
+from pos_evolution_tpu.backend.jax_init import ensure_x64
+from pos_evolution_tpu.ops.sha256 import _K, H0, sha256_pair_words
 
 TILE = 512  # messages per grid step (lanes)
 
@@ -113,6 +113,7 @@ def _merkle_level_kernel(k_ref, in_ref, out_ref, *, unroll: bool):
 def _pallas_level_call(pairs_t: jax.Array, interpret: bool) -> jax.Array:
     from jax.experimental import pallas as pl
 
+    ensure_x64()
     n = pairs_t.shape[1]
     return pl.pallas_call(
         partial(_merkle_level_kernel, unroll=not interpret),
@@ -135,6 +136,7 @@ def merkle_level_pallas(pairs_t: jax.Array, interpret: bool = False) -> jax.Arra
     """One merkle level: pairs_t (16, N) u32 (transposed 64-byte messages,
     N a multiple of TILE) -> (8, N) u32 digests. Interpret mode runs
     eagerly (jit-wrapping the interpreter embeds a huge graph in XLA:CPU)."""
+    ensure_x64()  # before entering the jit — never mid-trace
     if interpret:
         return _pallas_level_call(pairs_t, interpret=True)
     return _jitted_level(pairs_t)
@@ -163,6 +165,7 @@ def merkleize_words_device(leaves: jax.Array, depth: int,
 
     zero_words: (depth+1, 8) u32 — ZERO_HASHES as big-endian words.
     """
+    ensure_x64()
     nodes = leaves
     level = 0
     while nodes.shape[0] > 1:

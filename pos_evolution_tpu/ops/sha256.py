@@ -16,13 +16,14 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 # Exact Gwei/epoch integer semantics across all device kernels (balances sum
 # to ~2^55 at mainnet scale); the differential tests assert bit-equality
-# with the NumPy oracle.
-jax.config.update("jax_enable_x64", True)
-
-import jax.numpy as jnp  # noqa: E402
+# with the NumPy oracle. The flag is flipped LAZILY at first kernel use via
+# the consolidated backend helper — importing this module must never mutate
+# process-global JAX config (ISSUE 15 satellite).
+from pos_evolution_tpu.backend.jax_init import ensure_x64
 
 _K = np.array([
     0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
@@ -57,6 +58,7 @@ def _unroll_rounds() -> bool:
 
 def sha256_compress(state, block_words):
     """One compression: state (..., 8) u32, block_words (..., 16) u32."""
+    ensure_x64()
     w = [block_words[..., t] for t in range(16)]
     for t in range(16, 64):
         s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
@@ -92,6 +94,7 @@ def sha256_compress(state, block_words):
 
 def sha256_words(msg_words):
     """SHA-256 over pre-padded messages: (N, 16*blocks) u32 -> (N, 8) u32."""
+    ensure_x64()
     n_blocks = msg_words.shape[-1] // 16
     state = jnp.broadcast_to(jnp.asarray(H0), msg_words.shape[:-1] + (8,))
     for b in range(n_blocks):
@@ -102,6 +105,7 @@ def sha256_words(msg_words):
 def sha256_pair_words(left, right):
     """Merkle combiner: H(left || right) where left/right are (N, 8) u32
     digest words. 64-byte message = one padded second block."""
+    ensure_x64()
     n = left.shape[0]
     pad = jnp.zeros((n, 16), dtype=jnp.uint32)
     pad = pad.at[:, 0].set(np.uint32(0x80000000))

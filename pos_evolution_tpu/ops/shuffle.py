@@ -21,6 +21,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+# this kernel's pivots/flip arithmetic are int64: x64 is enabled lazily
+# at first shuffle (ops/sha256 no longer flips it at import — ISSUE 15)
+from pos_evolution_tpu.backend.jax_init import ensure_x64
 from pos_evolution_tpu.ops.sha256 import sha256_words
 from pos_evolution_tpu.ssz.hash import hash_eth2
 
@@ -86,6 +89,7 @@ def _shuffle_device(seed_words, pivots, n: int, rounds: int):
 
 def shuffle_permutation_jax(seed: bytes, n: int, rounds: int) -> jax.Array:
     """Device permutation equivalent to the reference's per-index shuffle."""
+    ensure_x64()  # before the jit — int64 pivot avals
     if n == 0:
         return jnp.zeros(0, dtype=jnp.int32)
     return _shuffle_device(jnp.asarray(_seed_words(seed)),

@@ -94,7 +94,8 @@ def link_tally_host(link_idx: np.ndarray, weight: np.ndarray,
 
 def _jit_windowed():
     import jax
-    jax.config.update("jax_enable_x64", True)  # Gwei sums need int64
+    from pos_evolution_tpu.backend.jax_init import ensure_x64
+    ensure_x64()  # Gwei sums need int64
     import jax.numpy as jnp
 
     @partial(jax.jit, static_argnames=("nb",))
@@ -144,7 +145,8 @@ def link_tally_device(link_idx, weight, active, n_links: int) -> np.ndarray:
     """Jitted twin of ``link_tally_host`` (same padding discipline)."""
     global _link_kern
     import jax
-    jax.config.update("jax_enable_x64", True)  # Gwei sums need int64
+    from pos_evolution_tpu.backend.jax_init import ensure_x64
+    ensure_x64()  # Gwei sums need int64
     import jax.numpy as jnp
     if _link_kern is None:
         @partial(jax.jit, static_argnames=("nl",))

@@ -4,8 +4,9 @@ XLA knows, at compile time, how many FLOPs and HBM bytes every kernel
 will touch — ``lowered.compile().cost_analysis()`` and
 ``memory_analysis()`` expose it. This module walks the repo's hot-path
 kernels (attestation aggregation, fork-choice rescan + incremental head,
-dense epoch sweep, sync-committee merkle walk, swap-or-not shuffle) at a
-configurable validator count and emits one per-kernel table:
+dense epoch sweep, sync-committee merkle walk, swap-or-not shuffle, the
+batched SHA-256 merkle level sweep) at a configurable validator count
+and emits one per-kernel table:
 
     {"kernel": {"flops", "bytes_accessed", "transcendentals",
                 "argument_bytes", "output_bytes", "temp_bytes",
@@ -97,6 +98,10 @@ def hot_path_specs(n: int = 4096, capacity: int = 64) -> dict:
     import numpy as np
 
     import jax.numpy as jnp
+
+    from pos_evolution_tpu.backend.jax_init import ensure_x64
+    ensure_x64()  # the int64 specs below need 64-bit avals regardless of
+    # which op modules a --kernel subset happens to import
 
     rng = np.random.default_rng(0)
     gwei = 10**9
@@ -197,6 +202,17 @@ def hot_path_specs(n: int = 4096, capacity: int = 64) -> dict:
                                  jnp.asarray(host_pivots(seed, n, rounds))), \
             {"n": n, "rounds": rounds}
 
+    def _merkle_level():
+        # the batched SHA-256 merkle level sweep (ops/merkle_device.py):
+        # one (N, 16)-word message per sibling pair, N pairs = a 2N-leaf
+        # tree level — the production merkleization kernel behind
+        # hash_tree_root / DAS commitments / checkpoint digests
+        from pos_evolution_tpu.ops.merkle_device import _xla_level_for
+        words = jnp.asarray(
+            rng.integers(0, 2**32, (n, 16), dtype=np.uint64)
+            .astype(np.uint32))
+        return _xla_level_for(), (words,), {}
+
     return {
         "aggregation.aggregate_verify_batch": _aggregation,
         "forkchoice.head_and_weights": _forkchoice_rescan,
@@ -204,6 +220,7 @@ def hot_path_specs(n: int = 4096, capacity: int = 64) -> dict:
         "epoch.process_epoch_dense": _epoch,
         "sync_verify.merkle_walk": _sync_verify,
         "shuffle.swap_or_not": _shuffle,
+        "merkle_device.level_sweep": _merkle_level,
     }
 
 

@@ -83,7 +83,14 @@ class AutoCheckpoint:
     staleness: at most one interval plus one in-flight step is lost on
     a kill). ``guard_every`` arms an ``IntegrityGuard`` audit every N
     slots (0 = off). ``heartbeat`` names a ``utils/watchdog.Heartbeat``
-    file beaten once per slot for the supervisor's hang detection."""
+    file beaten once per slot for the supervisor's hang detection.
+    ``digest`` picks the payload checksum: ``"auto"`` (default) resolves
+    at supervision construction to the ``"merkle"`` digest
+    (``ops/merkle_device.DIGEST_ALGO``) when the jax backend is active —
+    payload hashing then rides the device merkle path at gather time —
+    and to plain ``"sha256"`` otherwise (on the numpy backend the merkle
+    digest is pure overhead: ~2x the hashing with no device to win it
+    back). Explicit ``"merkle"``/``"sha256"`` are honored as given."""
 
     every_n_slots: int
     dir: str
@@ -91,6 +98,7 @@ class AutoCheckpoint:
     async_mode: bool = True
     guard_every: int = 0
     heartbeat: str | None = None
+    digest: str = "auto"
 
     @classmethod
     def of(cls, spec) -> "AutoCheckpoint":
@@ -123,13 +131,20 @@ def state_digest(sim) -> str:
     h = hashlib.sha256()
     if hasattr(sim, "head_host_walk"):  # DenseSimulation
         import numpy as np
+
+        # registry-scale columns go through the merkle payload digest
+        # (device level sweeps when the jax backend is active) and only
+        # the 32-byte column digests feed the scalar accumulator —
+        # identical witness whichever path hashed the columns
+        from pos_evolution_tpu.ops.merkle_device import digest_bytes
         for f in sim.registry._fields:
-            h.update(np.ascontiguousarray(
-                np.asarray(getattr(sim.registry, f))[: sim.n]).tobytes())
-        h.update(np.ascontiguousarray(
-            np.asarray(sim.msg_block)[: sim.n]).tobytes())
-        h.update(np.ascontiguousarray(
-            np.asarray(sim.msg_epoch)[: sim.n]).tobytes())
+            h.update(digest_bytes(np.ascontiguousarray(
+                np.asarray(getattr(sim.registry, f))[: sim.n]).view(
+                    np.uint8)))
+        h.update(digest_bytes(np.ascontiguousarray(
+            np.asarray(sim.msg_block)[: sim.n]).view(np.uint8)))
+        h.update(digest_bytes(np.ascontiguousarray(
+            np.asarray(sim.msg_epoch)[: sim.n]).view(np.uint8)))
         meta = {"slot": sim.slot, "roots": [r.hex() for r in sim.roots],
                 "parents": sim.parents, "block_slots": sim.block_slots,
                 "bits": [bool(b) for b in sim.bits],

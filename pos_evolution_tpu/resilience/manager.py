@@ -61,6 +61,20 @@ def _sha256(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
 
 
+def _merkle_hex(data: bytes) -> str:
+    """Merkle payload digest (``ops/merkle_device.DIGEST_ALGO``): the
+    device-portable checksum — 32-byte chunks merkleized through the
+    merkle dispatch layer, byte length mixed in. The writer may hash on
+    the device (jax backend active at gather time, payload past the
+    crossover); validation recomputes on whatever path the loading
+    process has — identical hex either way."""
+    from pos_evolution_tpu.ops.merkle_device import digest_bytes
+    return digest_bytes(data).hex()
+
+
+_DIGESTS = {"sha256": _sha256, "merkle": _merkle_hex}
+
+
 def _fsync_write(path: str, data: bytes) -> None:
     with open(path, "wb") as fh:
         fh.write(data)
@@ -81,11 +95,16 @@ class CheckpointManager:
 
     def __init__(self, dir: str | os.PathLike, retain: int = 3,
                  async_mode: bool = False,
-                 fingerprint: dict | None = None):
+                 fingerprint: dict | None = None,
+                 digest: str = "sha256"):
+        if digest not in _DIGESTS:
+            raise ValueError(f"unknown checkpoint digest {digest!r}; "
+                             f"one of {sorted(_DIGESTS)}")
         self.dir = os.fspath(dir)
         self.retain = int(retain)
         self.async_mode = bool(async_mode)
         self.fingerprint = fingerprint
+        self.digest = digest
         os.makedirs(self.dir, exist_ok=True)
         self._sweep_tmp()
         self._stats = {"saves": 0, "bytes": 0, "blocked_s": 0.0,
@@ -146,12 +165,18 @@ class CheckpointManager:
         """
         if not isinstance(payloads, dict):
             payloads = {"payload.bin": payloads}
+        # the digest policy is pinned at GATHER time: the writer thread
+        # hashes under the backend the *caller* had active, so a run on
+        # the jax backend gets device payload digests even though the
+        # bytes materialize on the background thread
+        from pos_evolution_tpu.backend import get_backend
+        backend = getattr(get_backend(), "name", "numpy")
         t0 = time.perf_counter()
         if self._queue is None:
             self._write_step(step, payloads)
         else:
             self._raise_worker_error()
-            self._queue.put((step, payloads))  # blocks if one in flight
+            self._queue.put((step, payloads, backend))  # blocks if in flight
             if wait:
                 self._queue.join()
                 self._raise_worker_error()
@@ -164,9 +189,11 @@ class CheckpointManager:
             if item is None:
                 self._queue.task_done()
                 return
-            step, payloads = item
+            step, payloads, backend = item
             t0 = time.perf_counter()
             try:
+                from pos_evolution_tpu.backend import set_backend
+                set_backend(backend)  # thread-local: the caller's policy
                 self._write_step(step, payloads)
             except BaseException as e:  # surfaced on the next save/drain
                 self._worker_error = e
@@ -190,7 +217,8 @@ class CheckpointManager:
             if callable(data):
                 data = data()
             _fsync_write(os.path.join(tmp, name), data)
-            files[name] = {"sha256": _sha256(data), "bytes": len(data)}
+            files[name] = {self.digest: _DIGESTS[self.digest](data),
+                           "bytes": len(data)}
             total += len(data)
         manifest = {"v": MANIFEST_VERSION, "step": int(step),
                     "fingerprint": self.fingerprint, "files": files}
@@ -274,9 +302,16 @@ class CheckpointManager:
                 raise CheckpointCorruption(
                     f"step {step}: {name!r} truncated "
                     f"({len(data)} of {meta['bytes']} bytes)")
-            if _sha256(data) != meta["sha256"]:
+            # the manifest entry names its own algorithm, so a store can
+            # hold (and validate) steps written under either digest
+            algo = next((a for a in _DIGESTS if a in meta), None)
+            if algo is None:
                 raise CheckpointCorruption(
-                    f"step {step}: {name!r} checksum mismatch "
+                    f"step {step}: {name!r} carries no known digest "
+                    f"(expected one of {sorted(_DIGESTS)})")
+            if _DIGESTS[algo](data) != meta[algo]:
+                raise CheckpointCorruption(
+                    f"step {step}: {name!r} {algo} checksum mismatch "
                     f"(bit flip or doctored manifest)")
             if keep_payloads:
                 payloads[name] = data

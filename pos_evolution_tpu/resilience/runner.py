@@ -44,10 +44,20 @@ class RunSupervision:
     def __init__(self, spec, kind: str, telemetry=None, cfg_obj=None):
         from pos_evolution_tpu.resilience import AutoCheckpoint
         self.cfg = AutoCheckpoint.of(spec)
+        digest = self.cfg.digest
+        if digest == "auto":
+            # merkle digests only pay off when the device path can take
+            # them (jax backend active at gather time); otherwise they
+            # are ~2x the hashing of a linear sha256 for nothing
+            from pos_evolution_tpu.backend import get_backend
+            digest = ("merkle"
+                      if getattr(get_backend(), "name", "") == "jax"
+                      else "sha256")
         self.manager = CheckpointManager(
             self.cfg.dir, retain=self.cfg.retain,
             async_mode=self.cfg.async_mode,
-            fingerprint=run_fingerprint(kind, cfg_obj))
+            fingerprint=run_fingerprint(kind, cfg_obj),
+            digest=digest)
         self.heartbeat = None
         if self.cfg.heartbeat:
             from pos_evolution_tpu.utils.watchdog import Heartbeat

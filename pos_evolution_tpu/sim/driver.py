@@ -1066,6 +1066,9 @@ class Simulation:
             cur.update({f"fused.{k}": v for k, v in session_stats().items()})
         except Exception:
             pass  # transition module unavailable: ssz counters still flow
+        from pos_evolution_tpu.ops import merkle_device
+        cur.update({f"merkle.{k}": v
+                    for k, v in merkle_device.stats().items()})
         mark = getattr(self, "_merkle_mark", None)
         self._merkle_mark = cur
         if mark is None:

@@ -14,7 +14,8 @@ import hashlib
 
 import numpy as np
 
-__all__ = ["hash_eth2", "sha256", "sha256_batch", "sha256_pairs"]
+__all__ = ["hash_eth2", "sha256", "sha256_batch", "sha256_batch_lanes",
+           "sha256_pairs", "sha256_pairs_lanes"]
 
 
 def sha256(data: bytes) -> bytes:
@@ -136,6 +137,20 @@ def sha256_batch(msgs: np.ndarray) -> np.ndarray:
             out[i] = np.frombuffer(
                 hashlib.sha256(raw[i * length:(i + 1) * length]).digest(), dtype=np.uint8)
         return out
+    return sha256_batch_lanes(msgs)
+
+
+def sha256_batch_lanes(msgs: np.ndarray) -> np.ndarray:
+    """The vectorized uint32-lane kernel, undispatched: (N, L) uint8 ->
+    (N, 32) digests on pure NumPy regardless of batch size or the
+    native core. This is the "host NumPy sweep" that
+    ``scripts/bench_merkle.py`` baselines the device kernel against,
+    and the bottom rung of the ops/merkle_device fallback ladder's
+    bit-identity tests."""
+    msgs = np.ascontiguousarray(msgs, dtype=np.uint8)
+    n = msgs.shape[0]
+    if n == 0:
+        return np.empty((0, 32), dtype=np.uint8)
     words = _pad_messages(msgs)  # (N, n_blocks*16)
     state = np.broadcast_to(_H0, (n, 8)).copy()
     for blk in range(words.shape[1] // 16):
@@ -151,3 +166,9 @@ def sha256_pairs(left: np.ndarray, right: np.ndarray) -> np.ndarray:
     tree combiner used by ``ssz.merkle.merkleize``.
     """
     return sha256_batch(np.concatenate([left, right], axis=1))
+
+
+def sha256_pairs_lanes(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    """``sha256_pairs`` pinned to the pure-NumPy lane kernel (no native
+    core, no hashlib loop) — the bench baseline / ladder oracle."""
+    return sha256_batch_lanes(np.concatenate([left, right], axis=1))

@@ -40,13 +40,23 @@ object. Diff-based detection makes that safe — whichever state asks for its
 root next diffs against whatever the cache last hashed, so fork siblings and
 parent/child states share one ~O(state) cache per lineage instead of one per
 stored state. (Single-threaded simulation; the cache is not locked.)
+
+Where the hashes RUN (ISSUE 15): every level sweep goes through
+``ops/merkle_device.pair_hash`` — host SHA-256 below the measured
+crossover, the batched device kernel above it — and a container-root
+computation drives all of its field trees in LOCKSTEP through one
+``LevelSweeper``: the tree updates are generators that yield their
+per-level pair blocks, and each level of every dirty field hashes in ONE
+kernel launch instead of one ``sha256_pairs`` call per level per field.
+Bit-identical on every path; ``tests/test_merkle_device.py`` pins it.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from pos_evolution_tpu.ssz.hash import sha256, sha256_pairs
+from pos_evolution_tpu.ops.merkle_device import LevelSweeper, drive, pair_hash
+from pos_evolution_tpu.ssz.hash import sha256
 from pos_evolution_tpu.ssz.merkle import ZERO_HASHES, mix_in_length
 
 __all__ = [
@@ -109,28 +119,40 @@ class ChunkTree:
     path).
     """
 
-    __slots__ = ("limit", "count", "levels", "_root")
+    __slots__ = ("limit", "count", "levels", "_root", "_pending")
 
     def __init__(self, limit: int | None = None):
         self.limit = limit
         self.count = -1
         self.levels: list[np.ndarray] | None = None
         self._root = b""
+        # an update generator is in flight: leaves may be written before
+        # the internal nodes hash, so an ABANDONED sweep (exception
+        # between sweeper registration and run) must not leave the tree
+        # claiming a clean diff against a stale root — the next query
+        # rebuilds instead
+        self._pending = False
 
     # -- public ---------------------------------------------------------------
 
-    def root(self, chunks: np.ndarray) -> bytes:
+    def root(self, chunks: np.ndarray, sweeper: LevelSweeper | None = None):
+        """Incremental root. Without ``sweeper``: returns the 32-byte
+        root, hashing dirty paths immediately. With one: registers this
+        tree's level sweeps on the lockstep batcher and returns a
+        zero-arg finisher to call AFTER ``sweeper.run()`` — that is how a
+        ``ContainerTreeCache`` turns a whole-container rehash into one
+        kernel launch per level across every dirty field."""
         chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
         if chunks.ndim == 1:
             chunks = chunks.reshape(-1, 32)
         n = chunks.shape[0]
         if self.limit is not None and n > self.limit:
             raise ValueError(f"{n} chunks exceed limit {self.limit}")
-        if self.levels is None or n < self.count:
-            return self._rebuild(chunks)
+        if self.levels is None or n < self.count or self._pending:
+            return self._launch(self._rebuild_steps(chunks), sweeper)
         if n == self.count and np.array_equal(self.levels[0], chunks):
             _STATS["htr_cache_hit"] += 1
-            return self._root
+            return self._done(sweeper)
         m = self.count
         diff = (self.levels[0][: min(m, n)] != chunks[: min(m, n)]).any(axis=1)
         dirty = np.nonzero(diff)[0]
@@ -141,13 +163,13 @@ class ChunkTree:
             # pure equality (count unchanged) was handled above; reaching
             # here with an empty dirty set means nothing changed
             _STATS["htr_cache_hit"] += 1
-            return self._root
-        self._update(chunks, dirty, n)
+            return self._done(sweeper)
         _STATS["htr_cache_miss"] += 1
         _STATS["dirty_chunks"] += int(dirty.size)
-        return self._root
+        return self._launch(self._update_steps(chunks, dirty, n), sweeper)
 
-    def update_rows(self, chunks: np.ndarray, dirty: np.ndarray) -> bytes:
+    def update_rows(self, chunks: np.ndarray, dirty: np.ndarray,
+                    sweeper: LevelSweeper | None = None):
         """Like ``root`` but with the dirty leaf set supplied by the caller
         (``RegistryTree`` already knows which validator rows changed, so the
         chunk-level compare would be redundant work). ``dirty`` must be a
@@ -156,8 +178,8 @@ class ChunkTree:
         n = chunks.shape[0]
         if self.limit is not None and n > self.limit:
             raise ValueError(f"{n} chunks exceed limit {self.limit}")
-        if self.levels is None or n < self.count:
-            return self._rebuild(chunks)
+        if self.levels is None or n < self.count or self._pending:
+            return self._launch(self._rebuild_steps(chunks), sweeper)
         dirty = np.asarray(dirty, dtype=np.int64)
         if n > self.count:
             dirty = np.concatenate(
@@ -165,28 +187,48 @@ class ChunkTree:
         dirty = np.unique(dirty)
         if dirty.size == 0 and n == self.count:
             _STATS["htr_cache_hit"] += 1
-            return self._root
-        self._update(chunks, dirty, n)
+            return self._done(sweeper)
         _STATS["htr_cache_miss"] += 1
         _STATS["dirty_chunks"] += int(dirty.size)
-        return self._root
+        return self._launch(self._update_steps(chunks, dirty, n), sweeper)
 
     # -- internals ------------------------------------------------------------
+
+    def _done(self, sweeper):
+        """Root already known (cache hit): bytes, or a finisher in
+        deferred mode — same contract either way."""
+        if sweeper is None:
+            return self._root
+        root = self._root
+        return lambda: root
+
+    def _launch(self, gen, sweeper):
+        """Run one update generator — immediately (standalone) or on the
+        caller's lockstep batcher (deferred)."""
+        if sweeper is None:
+            drive(gen)
+            return self._root
+        sweeper.add(gen)
+        return lambda: self._root
 
     def _effective_depth(self, n: int) -> int:
         limit = self.limit if self.limit is not None else max(n, 1)
         return _depth_for(limit)
 
-    def _rebuild(self, chunks: np.ndarray) -> bytes:
+    def _rebuild_steps(self, chunks: np.ndarray):
+        """Full-tree rebuild as a level-sweep generator: yields each
+        level's (left, right) pair block, receives the digests."""
         n = chunks.shape[0]
         _STATS["rebuilds"] += 1
         _STATS["htr_cache_miss"] += 1
         _STATS["dirty_chunks"] += n
+        self._pending = True
         self.count = n
         if n == 0:
             self.levels = [np.empty((0, 32), dtype=np.uint8)]
             self._root = ZERO_HASHES[self._effective_depth(0)].tobytes()
-            return self._root
+            self._pending = False
+            return
         levels = [chunks.copy()]
         layer = levels[0]
         level = 0
@@ -194,14 +236,19 @@ class ChunkTree:
             if layer.shape[0] % 2 == 1:
                 layer = np.concatenate(
                     [layer, ZERO_HASHES[level][None, :]], axis=0)
-            layer = sha256_pairs(layer[0::2], layer[1::2])
+            layer = yield (np.ascontiguousarray(layer[0::2]),
+                           np.ascontiguousarray(layer[1::2]))
             levels.append(layer)
             level += 1
         self.levels = levels
         self._root = self._cap(levels[-1][0], level)
-        return self._root
+        self._pending = False
 
-    def _update(self, chunks: np.ndarray, dirty: np.ndarray, n: int) -> None:
+    def _update_steps(self, chunks: np.ndarray, dirty: np.ndarray, n: int):
+        """Dirty-path rehash as a level-sweep generator (the lockstep
+        form of the old ``_update`` — identical writes, identical
+        digests; leaf writes happen when the generator is primed)."""
+        self._pending = True
         levels = self.levels
         if n != self.count:
             levels[0] = chunks.copy()
@@ -229,13 +276,14 @@ class ChunkTree:
                 right[in_range] = child[right_idx[in_range]]
             if (~in_range).any():
                 right[~in_range] = ZERO_HASHES[k]
-            levels[k + 1][parents] = sha256_pairs(
-                np.ascontiguousarray(left), right)
+            digests = yield (np.ascontiguousarray(left), right)
+            levels[k + 1][parents] = digests
             dirty = parents
             size = next_size
             k += 1
         del levels[k + 1:]
         self._root = self._cap(levels[k][0], k)
+        self._pending = False
 
     def _cap(self, top: np.ndarray, k: int) -> bytes:
         """Combine the top of the occupied subtree with virtual zero
@@ -261,7 +309,7 @@ def _validator_roots_rows(reg, idx: np.ndarray) -> np.ndarray:
     pk = reg.pubkeys[idx]
     pk_hi = np.zeros((k, 32), dtype=np.uint8)
     pk_hi[:, :16] = pk[:, 32:]
-    leaves[:, 0] = sha256_pairs(np.ascontiguousarray(pk[:, :32]), pk_hi)
+    leaves[:, 0] = pair_hash(np.ascontiguousarray(pk[:, :32]), pk_hi)
     leaves[:, 1] = reg.withdrawal_credentials[idx]
     leaves[:, 2, :8] = reg.effective_balance[idx].astype(
         "<u8").view(np.uint8).reshape(k, 8)
@@ -272,7 +320,7 @@ def _validator_roots_rows(reg, idx: np.ndarray) -> np.ndarray:
             "<u8").view(np.uint8).reshape(k, 8)
     layer = leaves.reshape(k * 8, 32)
     for _ in range(3):
-        layer = sha256_pairs(layer[0::2], layer[1::2])
+        layer = pair_hash(layer[0::2], layer[1::2])
     return layer.reshape(k, 32)
 
 
@@ -294,7 +342,11 @@ class RegistryTree:
         self._tree: ChunkTree | None = None
         self._limit = -1
 
-    def root(self, reg, limit: int) -> bytes:
+    def root(self, reg, limit: int, sweeper: LevelSweeper | None = None):
+        """Incremental registry root; same deferred contract as
+        ``ChunkTree.root`` (the dirty-row re-merkleization runs eagerly
+        — it is itself one batched ``pair_hash`` cascade — and the
+        chunk-tree update joins the caller's lockstep sweep)."""
         n = len(reg)
         if self._tree is None or limit != self._limit:
             self._limit = limit
@@ -303,9 +355,11 @@ class RegistryTree:
         if self._cols is None or n < self._roots.shape[0]:
             self._roots = reg.validator_roots()
             self._snapshot(reg, np.arange(n, dtype=np.int64), n)
-            tree_root = self._tree.update_rows(
-                self._roots, np.arange(n, dtype=np.int64))
-            return mix_in_length(tree_root, n)
+            fin = self._tree.update_rows(
+                self._roots, np.arange(n, dtype=np.int64), sweeper)
+            if sweeper is None:
+                return mix_in_length(fin, n)
+            return lambda: mix_in_length(fin(), n)
 
         old_n = self._roots.shape[0]
         m = min(old_n, n)
@@ -333,8 +387,10 @@ class RegistryTree:
                 self._roots = grown
             self._roots[dirty] = new_roots
             self._snapshot(reg, dirty, n)
-        tree_root = self._tree.update_rows(self._roots, dirty)
-        return mix_in_length(tree_root, n)
+        fin = self._tree.update_rows(self._roots, dirty, sweeper)
+        if sweeper is None:
+            return mix_in_length(fin, n)
+        return lambda: mix_in_length(fin(), n)
 
     def _snapshot(self, reg, dirty: np.ndarray, n: int) -> None:
         """Refresh the column copies for the rows just re-hashed."""
@@ -371,10 +427,17 @@ class _TreeField:
         self.tree = ChunkTree(limit)
 
     def root(self, value) -> bytes:
-        r = self.tree.root(self.chunker(value))
-        if self.mix:
-            r = mix_in_length(r, self.length_of(value))
-        return r
+        return self.root_deferred(value, None)()
+
+    def root_deferred(self, value, sweeper):
+        fin = self.tree.root(self.chunker(value), sweeper)
+        if sweeper is None:
+            root = fin
+            fin = lambda: root  # noqa: E731 — uniform finisher shape
+        if not self.mix:
+            return fin
+        length = self.length_of(value)
+        return lambda: mix_in_length(fin(), length)
 
 
 class _SmallField:
@@ -398,6 +461,10 @@ class _SmallField:
         self._root = self.sedes.htr(value)
         return self._root
 
+    def root_deferred(self, value, sweeper):
+        root = self.root(value)  # cheap fields never defer
+        return lambda: root
+
 
 class _RegistryField:
     __slots__ = ("reg_tree",)
@@ -408,6 +475,15 @@ class _RegistryField:
     def root(self, value) -> bytes:
         from pos_evolution_tpu.config import cfg
         return self.reg_tree.root(value, cfg().validator_registry_limit)
+
+    def root_deferred(self, value, sweeper):
+        from pos_evolution_tpu.config import cfg
+        fin = self.reg_tree.root(value, cfg().validator_registry_limit,
+                                 sweeper)
+        if sweeper is None:
+            root = fin
+            return lambda: root
+        return fin
 
 
 class ContainerTreeCache:
@@ -452,9 +528,17 @@ class ContainerTreeCache:
         self.top = ChunkTree(None)
 
     def root(self, value) -> bytes:
+        """One container root = one lockstep sweep: every dirty field
+        tree registers its level generators on a shared ``LevelSweeper``,
+        so level k of ALL fields hashes in one kernel launch (and one
+        device dispatch decision) instead of one call per field. The top
+        field-roots tree depends on every finisher, so it runs after."""
         _STATS["htr_calls"] += 1
-        roots = b"".join(self.fields[f].root(getattr(value, f))
-                         for f in self.cls._fields)
+        sweeper = LevelSweeper()
+        finishers = [self.fields[f].root_deferred(getattr(value, f), sweeper)
+                     for f in self.cls._fields]
+        sweeper.run()
+        roots = b"".join(fin() for fin in finishers)
         chunks = np.frombuffer(roots, dtype=np.uint8).reshape(-1, 32)
         return self.top.root(chunks)
 

@@ -49,11 +49,15 @@ def _depth_for(limit: int) -> int:
     return (next_pow_of_two(limit) - 1).bit_length() if limit > 1 else 0
 
 
-def merkleize_chunks(chunks: np.ndarray, limit: int | None = None) -> bytes:
+def merkleize_chunks(chunks: np.ndarray, limit: int | None = None,
+                     combine=sha256_pairs) -> bytes:
     """Merkleize (N, 32) uint8 chunk array, virtually padded to ``limit``.
 
     ``limit=None`` pads to the next power of two of N (SSZ vector rule).
-    Returns the 32-byte root.
+    Returns the 32-byte root. ``combine`` is the level combiner —
+    ``ops/merkle_device.merkleize`` passes its dispatching ``pair_hash``
+    so this stays the one copy of the padded walk; the native whole-tree
+    fast path only applies to the default host combiner.
     """
     chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
     if chunks.ndim == 1:
@@ -66,7 +70,7 @@ def merkleize_chunks(chunks: np.ndarray, limit: int | None = None) -> bytes:
     depth = _depth_for(limit)
     if count == 0:
         return ZERO_HASHES[depth].tobytes()
-    if count >= 32:
+    if count >= 32 and combine is sha256_pairs:
         # Whole-tree merkleization in one native call (component N2).
         try:
             from pos_evolution_tpu import native
@@ -78,7 +82,7 @@ def merkleize_chunks(chunks: np.ndarray, limit: int | None = None) -> bytes:
     for level in range(depth):
         if layer.shape[0] % 2 == 1:
             layer = np.concatenate([layer, ZERO_HASHES[level][None, :]], axis=0)
-        layer = sha256_pairs(layer[0::2], layer[1::2])
+        layer = combine(layer[0::2], layer[1::2])
     return layer[0].tobytes()
 
 
@@ -130,15 +134,20 @@ def multiproof_helper_gindices(leaf_indices, depth: int) -> list[int]:
     return sorted(helpers, reverse=True)
 
 
-def _tree_levels(leaves: np.ndarray, depth: int) -> list[np.ndarray]:
+def _tree_levels(leaves: np.ndarray, depth: int,
+                 combine=sha256_pairs) -> list[np.ndarray]:
     """All levels of the padded tree, leaves first (virtual zero padding
-    stays virtual: out-of-range nodes read from ``ZERO_HASHES``)."""
+    stays virtual: out-of-range nodes read from ``ZERO_HASHES``).
+    ``combine`` is the level combiner — ``ops/merkle_device.tree_levels``
+    passes its dispatching ``pair_hash`` so THIS stays the one copy of
+    the padded-tree walk."""
     layer = np.ascontiguousarray(leaves, dtype=np.uint8).reshape(-1, 32)
     levels = [layer]
     for level in range(depth):
         if layer.shape[0] % 2 == 1:
             layer = np.concatenate([layer, ZERO_HASHES[level][None, :]], axis=0)
-        layer = sha256_pairs(layer[0::2], layer[1::2])
+        layer = combine(np.ascontiguousarray(layer[0::2]),
+                        np.ascontiguousarray(layer[1::2]))
         levels.append(layer)
     return levels
 
@@ -152,12 +161,13 @@ def _node_value(levels: list[np.ndarray], gindex: int, depth: int) -> bytes:
     return ZERO_HASHES[level].tobytes()
 
 
-def build_multiproof(leaves: np.ndarray, leaf_indices, depth: int) -> list[bytes]:
+def build_multiproof(leaves: np.ndarray, leaf_indices, depth: int,
+                     combine=sha256_pairs) -> list[bytes]:
     """One proof for all ``leaf_indices`` of a depth-``depth`` tree over
     ``leaves``: the helper-sibling values in ``multiproof_helper_gindices``
     order. Shared path prefixes are shipped once, so proving c cells costs
     ~c*(depth - log2 c) siblings instead of c*depth."""
-    levels = _tree_levels(leaves, depth)
+    levels = _tree_levels(leaves, depth, combine)
     return [_node_value(levels, g, depth)
             for g in multiproof_helper_gindices(leaf_indices, depth)]
 

@@ -1,0 +1,148 @@
+"""Merkle level-sweep microbench: device kernel vs host sweeps.
+
+Times one tree level — N/2 sibling-pair SHA-256 compressions for an
+N-leaf batch — on three paths and pins them bit-identical:
+
+- **device**: the ops/merkle_device dispatch layer forced to
+  ``"device"`` (Pallas on a real accelerator, the jitted XLA kernel
+  otherwise), warmed before timing so compile never pollutes the
+  number;
+- **host numpy**: the pure uint32-lane NumPy kernel
+  (``ssz.hash.sha256_pairs_lanes``) — the "host NumPy sweep" of the
+  ROADMAP item 4 acceptance line (device ≥ 3x at ≥ 64K leaves);
+- **host dispatched**: ``ssz.hash.sha256_pairs`` as production ships it
+  (the native C++ core when built) — recorded for honesty: on a CPU box
+  with the native core this wins, which is exactly why auto-dispatch
+  keeps jax-on-CPU on the host path.
+
+The emission (``metric: bench_merkle``) lands in
+``bench_history.jsonl`` as ``kind=bench_merkle``;
+``scripts/perf_gate.py --kind bench_merkle --strict-timing`` bands the
+``*_ms`` leaves, so a regressed device sweep (or a silently vanished
+device path — ``counts.device_sweeps`` is count-gated) fails CI. The
+doctored-slow (x10) negative is pinned in the telemetry-smoke job.
+
+Usage:
+    python scripts/bench_merkle.py [--leaves 65536] [--repeats 5]
+        [--json out.json] [--history bench_history.jsonl]
+        [--require-speedup 3.0] [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _median_ms(fn, repeats: int) -> float:
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(sorted(times)[len(times) // 2])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--leaves", type=int, default=65536,
+                    help="leaf batch per level sweep (pairs = leaves/2)")
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", help="write the bench_merkle emission here")
+    ap.add_argument("--history",
+                    help="append the emission to this bench_history.jsonl")
+    ap.add_argument("--require-speedup", type=float, default=None,
+                    help="exit nonzero unless device beats the host "
+                         "NumPy sweep by this factor (the acceptance run)")
+    args = ap.parse_args(argv)
+
+    from pos_evolution_tpu.backend import set_backend
+    from pos_evolution_tpu.ops import merkle_device
+    from pos_evolution_tpu.ssz.hash import sha256_pairs, sha256_pairs_lanes
+
+    n_pairs = args.leaves // 2
+    rng = np.random.default_rng(args.seed)
+    left = rng.integers(0, 256, (n_pairs, 32), dtype=np.uint8)
+    right = rng.integers(0, 256, (n_pairs, 32), dtype=np.uint8)
+
+    set_backend("jax")
+    import jax
+    merkle_device.reset_stats()
+    prev_mode = merkle_device.set_mode("device")
+    try:
+        device_out = merkle_device.pair_hash(left, right)  # compile warm-up
+        device_ms = _median_ms(
+            lambda: merkle_device.pair_hash(left, right), args.repeats)
+        counts = merkle_device.stats()
+    finally:
+        merkle_device.set_mode(prev_mode)
+        set_backend("numpy")
+
+    host_numpy_out = sha256_pairs_lanes(left, right)
+    host_numpy_ms = _median_ms(
+        lambda: sha256_pairs_lanes(left, right), args.repeats)
+    host_dispatch_ms = _median_ms(
+        lambda: sha256_pairs(left, right), args.repeats)
+
+    parity_ok = bool((device_out == host_numpy_out).all())
+    speedup = host_numpy_ms / device_ms if device_ms else float("inf")
+    fell_back = counts["fallback_numpy"] > 0
+
+    print(f"merkle level sweep @ {args.leaves} leaves ({n_pairs} pairs), "
+          f"jax backend = {jax.default_backend()}")
+    print(f"  device        : {device_ms:9.2f} ms"
+          + ("  [FELL BACK TO NUMPY]" if fell_back else ""))
+    print(f"  host numpy    : {host_numpy_ms:9.2f} ms")
+    print(f"  host dispatch : {host_dispatch_ms:9.2f} ms (native core "
+          f"when built)")
+    print(f"  device vs host-numpy speedup: {speedup:.2f}x; "
+          f"parity: {'ok' if parity_ok else 'MISMATCH'}")
+    print(f"  dispatch counters: {counts}")
+
+    emission = {
+        "metric": "bench_merkle",
+        "leaves": args.leaves,
+        "pairs": n_pairs,
+        "jax_backend": jax.default_backend(),
+        "sweeps": {
+            "device_ms": round(device_ms, 4),
+            "host_numpy_ms": round(host_numpy_ms, 4),
+            "host_dispatch_ms": round(host_dispatch_ms, 4),
+        },
+        "speedup_vs_numpy": round(speedup, 3),
+        "device_pairs_per_s": (round(n_pairs / (device_ms / 1e3))
+                               if device_ms else None),
+        "parity_ok": parity_ok,
+        "counts": {k: v for k, v in counts.items() if k != "device_ms"},
+    }
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(emission, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"emission -> {args.json}")
+    if args.history:
+        from pos_evolution_tpu.profiling import history
+        history.append_entry(args.history, emission, kind="bench_merkle")
+        print(f"history  -> {args.history} (kind=bench_merkle)")
+
+    if not parity_ok:
+        print("FAIL: device sweep diverged from the host kernel",
+              file=sys.stderr)
+        return 1
+    if args.require_speedup is not None and speedup < args.require_speedup:
+        print(f"FAIL: device speedup {speedup:.2f}x < required "
+              f"{args.require_speedup}x", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

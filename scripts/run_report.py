@@ -208,17 +208,29 @@ def build_report(events: list[dict], top_ops: dict | None = None,
     merk_totals: dict[str, int] = {}
     for e in merk_events:
         for k, v in e.items():
-            if k.startswith(("ssz_", "fused_")) and isinstance(v, (int, float)):
+            if k.startswith(("ssz_", "fused_", "merkle_")) \
+                    and isinstance(v, (int, float)):
                 merk_totals[k] = merk_totals.get(k, 0) + v
     merkleization = None
     if merk_totals:
         hits = merk_totals.get("ssz_htr_cache_hit", 0)
         misses = merk_totals.get("ssz_htr_cache_miss", 0)
+        dev_pairs = merk_totals.get("merkle_device_pairs", 0)
+        host_pairs = merk_totals.get("merkle_host_pairs", 0)
+        dev_ms = merk_totals.get("merkle_device_ms", 0)
         merkleization = {
             "slots_with_activity": len(merk_events),
-            "totals": dict(sorted(merk_totals.items())),
+            "totals": {k: (round(v, 3) if isinstance(v, float) else v)
+                       for k, v in sorted(merk_totals.items())},
             "htr_hit_rate": (round(hits / (hits + misses), 4)
                              if hits + misses else None),
+            # device-vs-host split of the level sweeps (ops/merkle_device)
+            "device_pairs": dev_pairs,
+            "host_pairs": host_pairs,
+            "device_share": (round(dev_pairs / (dev_pairs + host_pairs), 4)
+                             if dev_pairs + host_pairs else None),
+            "device_pairs_per_s": (round(dev_pairs / (dev_ms / 1e3))
+                                   if dev_ms else None),
         }
 
     # -- DAS serving (das/server.py summaries via das_serve events) -----------
@@ -619,6 +631,14 @@ def to_markdown(report: dict) -> str:
             md.append(f"- field-root cache hit rate: "
                       f"**{merk['htr_hit_rate']:.1%}** over "
                       f"{merk['slots_with_activity']} active slot(s)")
+        if merk.get("device_share") is not None:
+            md.append(f"- level-sweep dispatch: "
+                      f"**{merk['device_pairs']}** pairs on device / "
+                      f"{merk['host_pairs']} on host "
+                      f"({merk['device_share']:.1%} device)"
+                      + (f", device sweep throughput "
+                         f"{merk['device_pairs_per_s']} pairs/s"
+                         if merk.get("device_pairs_per_s") else ""))
         md += ["", *_md_table(
             ["counter", "total"],
             [[k, v] for k, v in merk["totals"].items()])]
