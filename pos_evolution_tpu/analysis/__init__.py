@@ -36,6 +36,7 @@ PEV003      host-device sync inside per-slot hot loops
 PEV004      ``donate_argnums`` without the off-CPU guard
 PEV005      except-and-continue that swallows errors in daemon loops
 PEV006      mutable default args / lowercase module mutables
+PEV007      fork-unsafety: fork-start amid threads; pre-fork locks in child entries
 PEV101      unlocked read-modify-write on a shared instance attribute
 PEV102      inconsistent locking discipline on a shared instance attribute
 ==========  ==================================================================

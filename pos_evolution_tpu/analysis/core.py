@@ -91,7 +91,8 @@ def register_rule(cls):
 
 def all_rules() -> dict[str, Rule]:
     # import for side effect: rule modules self-register on first use
-    from . import lockset, rules_determinism, rules_hygiene, rules_jax  # noqa: F401
+    from . import (lockset, rules_determinism, rules_hygiene,  # noqa: F401
+                   rules_jax, rules_mp)
     return dict(sorted(_RULES.items()))
 
 

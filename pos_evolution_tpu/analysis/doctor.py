@@ -28,6 +28,7 @@ DOCTOR_RELPATH = "doctor_synthetic.py"
 # One bug per class. Never imported or executed — parsed only.
 DOCTOR_SOURCE = '''\
 """Synthesized bug zoo for the static-analysis doctor (never executed)."""
+import multiprocessing
 import threading
 import time
 
@@ -74,6 +75,21 @@ class PumpWorker:
                 continue
 
 
+_pump_registry_lock = threading.Lock()
+
+
+def pump_child(work):
+    # PR 16 class: parent-created lock referenced on the child side
+    with _pump_registry_lock:
+        work()
+
+
+def launch_pump(work):
+    # PR 16 class: fork-start in a module that also runs threads
+    ctx = multiprocessing.get_context("fork")
+    return ctx.Process(target=pump_child, args=(work,))
+
+
 class SharedCounters:
     """PR 12 class: a locked class with an unlocked read-modify-write."""
 
@@ -100,6 +116,7 @@ EXPECTED = {
     "PEV004": 1,   # donated_step without an off-CPU guard
     "PEV005": 1,   # PumpWorker._pump_loop swallows silently
     "PEV006": 1,   # collect's mutable default
+    "PEV007": 2,   # launch_pump's fork context + pump_child's lock
     "PEV101": 1,   # SharedCounters.inc: the PR 12 unlocked counter
     "PEV102": 1,   # SharedCounters.reset: blind store, locked elsewhere
 }

@@ -113,7 +113,8 @@ class DasServer:
     """Serves coalesced DAS samples (and cached best-updates) for one node."""
 
     def __init__(self, scheme: CellCommitmentScheme, registry=None,
-                 proof_cache: int | LRUCache = 4096, update_cache: int = 64):
+                 proof_cache: int | LRUCache = 4096, update_cache: int = 64,
+                 flight=None):
         self.scheme = scheme
         self.registry = registry
         # an existing LRUCache instance is shared as-is: the serve tier
@@ -126,8 +127,11 @@ class DasServer:
         # stampede suppression: a new-block miss populates the proof
         # cache ONCE per (block, blob) however many threads miss
         # concurrently; scheme_builds counts actual backing builds (the
-        # regression contract of tests/test_serve.py)
-        self._flight = SingleFlight()
+        # regression contract of tests/test_serve.py). A worker PROCESS
+        # passes a ``utils/singleflight.ProcessFlight`` here so the
+        # same guarantee holds across the whole pool: one backing build
+        # per (block, blob) however many processes stampede.
+        self._flight = flight if flight is not None else SingleFlight()
         self.scheme_builds = 0
         self._stats_lock = threading.Lock()
         self.served_blocks = 0
@@ -196,7 +200,18 @@ class DasServer:
                 out[cell] = pair
             return out
 
-        return self._flight.do(("blob_proofs", block_root, blob), _build)
+        def _absorb(built: dict) -> None:
+            # another PROCESS led this build (cross-process flight):
+            # populate our per-process LRU from its spooled result —
+            # a cache fill, not a backing build, so scheme_builds
+            # stays untouched (the global one-build-per-blob pin)
+            for cell, pair in built.items():
+                self.proof_cache.put((block_root, blob, cell), pair)
+
+        key = ("blob_proofs", block_root, blob)
+        if getattr(self._flight, "wants_absorb", False):
+            return self._flight.do(key, _build, absorb=_absorb)
+        return self._flight.do(key, _build)
 
     def serve_samples(self, block_root: bytes, sidecars: list,
                       population) -> dict:

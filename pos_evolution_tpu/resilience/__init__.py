@@ -39,6 +39,11 @@ from pos_evolution_tpu.resilience.manager import (
     FingerprintMismatch,
 )
 from pos_evolution_tpu.resilience.runner import RunSupervision
+from pos_evolution_tpu.resilience.supervision import (
+    RetryPolicy,
+    heartbeat_age,
+    rss_kb,
+)
 from pos_evolution_tpu.resilience.supervisor import (
     SupervisorGaveUp,
     backoff_delay,
@@ -48,9 +53,9 @@ from pos_evolution_tpu.resilience.supervisor import (
 __all__ = [
     "AutoCheckpoint", "CheckpointManager", "CheckpointCorruption",
     "FingerprintMismatch", "IntegrityGuard", "IntegrityError",
-    "RunSupervision", "SupervisorGaveUp", "backoff_delay",
-    "fingerprint_config", "replayed_slots_from_events", "scan_columns",
-    "state_digest", "supervise",
+    "RetryPolicy", "RunSupervision", "SupervisorGaveUp", "backoff_delay",
+    "fingerprint_config", "heartbeat_age", "replayed_slots_from_events",
+    "rss_kb", "scan_columns", "state_digest", "supervise",
 ]
 
 

@@ -31,13 +31,22 @@ interruption/retry/goodput story offline.
 from __future__ import annotations
 
 import os
-import random
 import signal
 import subprocess
 import sys
 import time
 
+# the crash/hang/backoff POLICY lives in supervision.py, shared with the
+# serving tier's WorkerPool (serve/workers.py) — this module is the
+# one-child, run-to-completion parent built on it
+from pos_evolution_tpu.resilience.supervision import (
+    RetryPolicy,
+    backoff_delay,
+    heartbeat_age,
+)
 from pos_evolution_tpu.utils.watchdog import read_heartbeat
+
+__all__ = ["SupervisorGaveUp", "backoff_delay", "supervise"]
 
 
 class SupervisorGaveUp(RuntimeError):
@@ -50,18 +59,6 @@ def _emit(bus, type_: str, **fields) -> None:
     else:
         from pos_evolution_tpu.telemetry import emit_global
         emit_global(type_, **fields)
-
-
-def backoff_delay(failures: int, base_s: float, cap_s: float,
-                  jitter: float, seed: int) -> float:
-    """Capped exponential backoff with deterministic jitter: attempt k
-    after ``failures`` consecutive failures sleeps
-    ``min(cap, base * 2**(failures-1)) * (1 + jitter * u)`` with
-    ``u ~ U[0, 1)`` drawn from ``Random(seed, failures)``."""
-    if failures <= 0:
-        return 0.0
-    u = random.Random((int(seed) << 16) ^ int(failures)).random()
-    return min(cap_s, base_s * 2 ** (failures - 1)) * (1.0 + jitter * u)
 
 
 def supervise(build_argv, *, heartbeat_path: str | None = None,
@@ -86,10 +83,10 @@ def supervise(build_argv, *, heartbeat_path: str | None = None,
     """
     t_start = time.perf_counter()
     interruptions: list[dict] = []
-    backoff_total = 0.0
-    failures = 0
+    policy = RetryPolicy(max_failures=max_failures, backoff_s=backoff_s,
+                         backoff_cap_s=backoff_cap_s, jitter=jitter,
+                         seed=seed)
     attempt = 0
-    best_slot = None  # furthest heartbeat slot any attempt reached
     while True:
         if on_attempt is not None:
             on_attempt(attempt)
@@ -105,14 +102,12 @@ def supervise(build_argv, *, heartbeat_path: str | None = None,
             if rc is not None:
                 break
             if heartbeat_path is not None and hang_timeout_s:
-                hb = read_heartbeat(heartbeat_path)
-                started_s = time.perf_counter() - t0
-                # a beat from a PREVIOUS attempt is not this child's
-                # liveness — until this attempt beats, measure from its
-                # own launch instead of the stale file
-                stale = (hb is None
-                         or hb["payload"].get("unix", 0) < t0_unix)
-                age = started_s if stale else hb["age_s"]
+                # attempt-boundary rule (supervision.heartbeat_age): a
+                # beat from a PREVIOUS attempt is not this child's
+                # liveness — until this attempt beats, age is measured
+                # from its own launch instead of the stale file
+                age = heartbeat_age(heartbeat_path, t0_unix,
+                                    time.perf_counter() - t0)
                 if age > hang_timeout_s:
                     # no SIGTERM courtesy: a hung child may be wedged in
                     # native code and ignore it; the checkpoint store is
@@ -128,50 +123,41 @@ def supervise(build_argv, *, heartbeat_path: str | None = None,
             summary = {"ok": True, "attempts": attempt + 1,
                        "interruptions": interruptions,
                        "final_wall_s": round(wall, 3),
-                       "backoff_s": round(backoff_total, 3),
+                       "backoff_s": round(policy.backoff_total_s, 3),
                        "total_wall_s": round(
                            time.perf_counter() - t_start, 3)}
             _emit(events_bus, "supervisor_done", **{
                 k: v for k, v in summary.items() if k != "interruptions"},
                 n_interruptions=len(interruptions))
             return summary
-        failures += 1
         hb = (read_heartbeat(heartbeat_path)
               if heartbeat_path is not None else None)
         hb_slot = ((hb or {}).get("payload") or {}).get("slot")
-        if hb_slot is not None and (best_slot is None or hb_slot > best_slot):
-            if best_slot is not None:
-                # the run is advancing between failures — a flaky
-                # environment, not a systematic one; restart the streak
-                # so a long run is not doomed by N spread-out crashes
-                failures = 1
-            best_slot = hb_slot
+        delay = policy.record_failure(progress=hb_slot)
         record = {"attempt": attempt, "reason": reason or "crash",
                   "exit_code": rc, "wall_s": round(wall, 3),
                   "last_heartbeat": (hb or {}).get("payload")}
         interruptions.append(record)
         _emit(events_bus, "supervisor_interruption", **record)
-        if failures >= max_failures:
+        if delay is None:
             summary = {"ok": False, "attempts": attempt + 1,
                        "interruptions": interruptions,
-                       "backoff_s": round(backoff_total, 3),
+                       "backoff_s": round(policy.backoff_total_s, 3),
                        "total_wall_s": round(
                            time.perf_counter() - t_start, 3)}
             _emit(events_bus, "supervisor_gaveup", attempts=attempt + 1,
-                  consecutive_failures=failures)
+                  consecutive_failures=policy.failures)
             err = SupervisorGaveUp(
-                f"{failures} consecutive failed attempts (last: "
+                f"{policy.failures} consecutive failed attempts (last: "
                 f"{record['reason']}, exit {rc}) — refusing to thrash; "
                 f"inspect the checkpoint store and the child log")
             err.summary = summary
             raise err
-        delay = backoff_delay(failures, backoff_s, backoff_cap_s, jitter,
-                              seed)
-        backoff_total += delay
-        _emit(events_bus, "supervisor_backoff", failures=failures,
+        _emit(events_bus, "supervisor_backoff", failures=policy.failures,
               delay_s=round(delay, 3))
         print(f"# supervisor: attempt {attempt} {record['reason']} "
               f"(exit {rc}); retrying in {delay:.2f}s "
-              f"[{failures}/{max_failures} failures]", file=sys.stderr)
+              f"[{policy.failures}/{max_failures} failures]",
+              file=sys.stderr)
         time.sleep(delay)
         attempt += 1

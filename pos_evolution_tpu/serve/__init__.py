@@ -35,6 +35,19 @@ collapsing:
   audited through the existing telemetry machinery — overload degrades
   throughput but never correctness: every served proof still verifies,
   every shed request gets an honest rejection.
+
+The **multi-process plane** (PR 16) scales the same tier past the GIL:
+immutable ``ServeView`` snapshots publish once into POSIX shared memory
+(``serve/shm.py``'s seqlock ``ShmViewBoard``), a supervised
+``WorkerPool`` (``serve/workers.py``) runs spawn-context worker
+*processes* sharing SO_REUSEPORT listeners with heartbeat / crash /
+hang / rss supervision and capped-backoff respawn, cross-process
+stampedes collapse onto one build via the board's lease table
+(``utils/singleflight.ProcessFlight``), and a health-routed
+``Balancer`` (``serve/balancer.py``) spreads a pipelined swarm load
+across fronts. ``serve/harness.py``'s ``run_mp_scenario`` runs the
+whole plane under seeded process chaos and returns a self-judging
+verdict.
 """
 
 from pos_evolution_tpu.serve.admission import (
@@ -43,8 +56,14 @@ from pos_evolution_tpu.serve.admission import (
     CircuitBreaker,
     ServiceEstimator,
 )
-from pos_evolution_tpu.serve.chaos import ServeChaos, SlowLorisSwarm
+from pos_evolution_tpu.serve.balancer import Balancer, SwarmLoadGenerator
+from pos_evolution_tpu.serve.chaos import (
+    FdExhaustSwarm,
+    ServeChaos,
+    SlowLorisSwarm,
+)
 from pos_evolution_tpu.serve.client import ClientResult, ServeClient
+from pos_evolution_tpu.serve.harness import run_mp_scenario
 from pos_evolution_tpu.serve.loadgen import (
     LoadGenerator,
     arrival_times,
@@ -56,15 +75,20 @@ from pos_evolution_tpu.serve.protocol import (
     send_frame,
 )
 from pos_evolution_tpu.serve.server import TIER_BULK, TIER_INTERACTIVE, ServeFront
+from pos_evolution_tpu.serve.shm import ShmViewBoard
 from pos_evolution_tpu.serve.state import ServeView, ServingState
-from pos_evolution_tpu.utils.singleflight import SingleFlight
+from pos_evolution_tpu.serve.workers import WorkerPool, worker_spec
+from pos_evolution_tpu.utils.singleflight import ProcessFlight, SingleFlight
 
 __all__ = [
     "AdmissionQueue",
+    "Balancer",
     "BrownoutController",
     "CircuitBreaker",
     "ClientResult",
+    "FdExhaustSwarm",
     "LoadGenerator",
+    "ProcessFlight",
     "ProtocolError",
     "ServeChaos",
     "ServeClient",
@@ -72,12 +96,17 @@ __all__ = [
     "ServeView",
     "ServiceEstimator",
     "ServingState",
+    "ShmViewBoard",
     "SingleFlight",
     "SlowLorisSwarm",
+    "SwarmLoadGenerator",
     "TIER_BULK",
     "TIER_INTERACTIVE",
+    "WorkerPool",
     "arrival_times",
     "discover_targets",
     "recv_frame",
+    "run_mp_scenario",
     "send_frame",
+    "worker_spec",
 ]

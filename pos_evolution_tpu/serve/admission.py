@@ -83,7 +83,8 @@ class AdmissionQueue:
         self._tiers: tuple[deque, deque] = (deque(), deque())
         self._closed = False
         self.admitted = 0
-        self.shed = {"deadline": 0, "depth": 0, "brownout": 0}
+        self.shed = {"deadline": 0, "depth": 0, "brownout": 0,
+                     "draining": 0}
 
     def depth(self, tier: int | None = None) -> int:
         with self._cond:
@@ -150,6 +151,18 @@ class AdmissionQueue:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+
+    def drain(self) -> list:
+        """Every still-queued item, after ``close()`` — the honest-drain
+        path: a stopping front answers each with ``shed`` + retry-after
+        instead of letting admitted work vanish silently."""
+        with self._cond:
+            assert self._closed, "drain() is for closed queues"
+            items = [item for q in self._tiers for item in q]
+            for q in self._tiers:
+                q.clear()
+            self.shed["draining"] += len(items)
+            return items
 
 
 class BrownoutController:

@@ -33,7 +33,7 @@ import struct
 import threading
 import time
 
-__all__ = ["ServeChaos", "SlowLorisSwarm"]
+__all__ = ["FdExhaustSwarm", "ServeChaos", "SlowLorisSwarm"]
 
 
 def _unit(seed: int, *parts) -> float:
@@ -62,6 +62,8 @@ class ServeChaos:
         self.clock = clock
         self._stall_n = 0
         self._stall_windows: dict[int, list[tuple[float, float]]] = {}
+        # process-level kill schedule: [fire_at, worker_id, fired]
+        self._kill_sched: list[list] = []
         self._lock = threading.Lock()
         self.log: list[dict] = []
 
@@ -115,6 +117,73 @@ class ServeChaos:
                                  "stall_s": self._stall_s})
             return self._stall_s
         return 0.0
+
+    # -- process-level injections (the multi-process plane) --------------------
+
+    def arm_worker_kills(self, start: float, duration_s: float,
+                         n_kills: int, workers: int) -> list[dict]:
+        """Seeded SIGKILL schedule against worker PROCESSES: kill k
+        fires at a seeded offset inside [start, start + duration)
+        against a seeded worker id. The pool's watch loop polls
+        ``worker_kills_due`` and delivers the signal — chaos plans,
+        the supervisor executes, so the kill shows up in the SAME
+        interruption accounting as a real crash."""
+        planned = []
+        # seeded permutation, so n_kills <= workers hits DISTINCT
+        # workers — the scenario bar is 'N live workers killed', which
+        # a with-replacement draw can silently under-deliver
+        order = sorted(range(workers),
+                       key=lambda w: _unit(self.seed, "kill-order", w))
+        with self._lock:
+            for k in range(n_kills):
+                w = order[k % workers]
+                at = start + (0.15 + 0.7 * _unit(
+                    self.seed, "kill-at", k)) * duration_s
+                self._kill_sched.append([at, w, False])
+                planned.append({"kind": "worker_kill_armed", "worker": w,
+                                "at_s": round(at - start, 3)})
+            self.log.extend(planned)
+        return planned
+
+    def worker_kills_due(self) -> list[int]:
+        """Worker ids whose kill time has passed, each returned exactly
+        once (the consumer SIGKILLs them)."""
+        now = self.clock()
+        due = []
+        with self._lock:
+            for item in self._kill_sched:
+                if not item[2] and now >= item[0]:
+                    item[2] = True
+                    due.append(item[1])
+                    self.log.append({"kind": "worker_kill_fired",
+                                     "worker": item[1]})
+        return due
+
+    def wedge_windows(self, start_unix: float, duration_s: float,
+                      n_wedges: int, wedge_s: float,
+                      workers: int) -> dict[int, list[tuple[float, float]]]:
+        """Seeded heartbeat-wedge windows in UNIX time, keyed by worker
+        id — embedded into spawn specs (``spec["chaos"]["wedge_windows"]``)
+        so the worker itself skips beats inside its window while still
+        serving: the liveness lie the pool's hang detector must catch.
+        Unix (not monotonic) time because the window crosses a process
+        boundary; monotonic clocks do not agree across processes."""
+        out: dict[int, list[tuple[float, float]]] = {}
+        # draw wedge targets from the TAIL of the kill-order
+        # permutation: kills + wedges <= workers then hit DISJOINT
+        # workers, so each injection's detection path is exercised on
+        # its own victim
+        order = sorted(range(workers),
+                       key=lambda w: _unit(self.seed, "kill-order", w))
+        for k in range(n_wedges):
+            w = order[workers - 1 - (k % workers)]
+            lo = start_unix + _unit(self.seed, "wedge-at", k) * max(
+                duration_s - wedge_s, 0.0)
+            out.setdefault(w, []).append((lo, lo + wedge_s))
+        with self._lock:
+            self.log.append({"kind": "wedge_windows",
+                             "workers": sorted(out)})
+        return out
 
     # -- cache wipes on publish ------------------------------------------------
 
@@ -220,3 +289,54 @@ class SlowLorisSwarm:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=3.0)
+
+
+class FdExhaustSwarm:
+    """N connections opened at once and held idle — the fd/conn-slot
+    exhaustion window. The server's ``max_connections`` cap must refuse
+    the overflow at accept (``conn_rejected``) while ALREADY-established
+    traffic keeps flowing; when the swarm releases, capacity returns.
+    Nothing is ever sent, so no worker slot is ever at risk — only
+    accept-side resources are under attack."""
+
+    def __init__(self, addr, n: int = 256, hold_s: float = 2.0):
+        self.addr = (addr[0], int(addr[1]))
+        self.n = int(n)
+        self.hold_s = float(hold_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.connected = 0
+        self.refused = 0
+
+    def _run(self) -> None:
+        socks = []
+        connected = refused = 0
+        for _ in range(self.n):
+            if self._stop.is_set():
+                break
+            try:
+                socks.append(socket.create_connection(self.addr,
+                                                      timeout=1.0))
+                connected += 1
+            # not a swallow: the refusal IS the datum this swarm exists
+            # to count (the server shedding accepts under fd pressure)
+            except OSError:  # pev: ignore[PEV005]
+                refused += 1
+        self.connected, self.refused = connected, refused
+        self._stop.wait(self.hold_s)
+        for s in socks:
+            try:
+                s.close()
+            # closing an already-dead socket during teardown
+            except OSError:  # pev: ignore[PEV005]
+                pass
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run,
+                                        name="fd-exhaust", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -70,6 +70,10 @@ class _Channel:
     def __init__(self, addr: tuple[str, int], connect_timeout: float):
         self.sock = socket.create_connection(addr, timeout=connect_timeout)
         self.sock.settimeout(None)
+        # without TCP_NODELAY, small frames sit in Nagle's buffer
+        # waiting on the peer's delayed ACK — a 40ms floor per
+        # request/response ping-pong that looks like server latency
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.wlock = threading.Lock()
         self.pending: dict[int, tuple[threading.Event, list]] = {}
         self.plock = threading.Lock()

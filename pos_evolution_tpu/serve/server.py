@@ -27,6 +27,7 @@ worker thread.
 
 from __future__ import annotations
 
+import json
 import math
 import socket
 import struct
@@ -69,6 +70,31 @@ METHOD_TIERS = {
 
 _LEN = struct.Struct(">I")
 _LAT_CAP = 1 << 20  # exact per-tier latency samples kept for p999
+
+# methods the reader may answer from the response cache WITHOUT a JSON
+# parse ("stats" deliberately absent: it must take the full path)
+_FAST_METHODS = {b"ping": "ping", b"head": "head",
+                 b"finality": "finality", b"lc_update": "lc_update"}
+
+
+def _scan_interactive(body: bytes):
+    """``(id, method)`` when ``body`` is a well-formed interactive
+    request in the clients' canonical encoding (``{"id":N,...`` with a
+    ``"method":"..."`` member), else None — the json.loads a reader
+    pays per request is most of a cached reply's cost at 20k+/s, and
+    anything this scan cannot prove falls back to the full parse."""
+    if not body.startswith(b'{"id":') or not body.endswith(b"}"):
+        return None
+    try:
+        rid = int(body[6:body.index(b",", 6, 24)])
+    except ValueError:
+        return None
+    m = body.find(b'"method":"')
+    if m < 0:
+        return None
+    m += 10
+    method = _FAST_METHODS.get(body[m:body.find(b'"', m)])
+    return None if method is None else (rid, method)
 # caps the das_cells RESPONSE well under MAX_FRAME_BYTES (a sample is
 # ~cell_bytes + depth*32 hex-encoded); a real sampling client draws ~8
 MAX_SAMPLES_PER_REQUEST = 512
@@ -96,6 +122,18 @@ class _Conn:
             self.alive = False
             return False
 
+    def reply_raw(self, payload: bytes) -> bool:
+        """Send pre-encoded frame bytes (length prefix included) —
+        the fast path's replies are built from cached templates and
+        coalesced, one ``sendall`` per recv batch."""
+        try:
+            with self.wlock:
+                self.sock.sendall(payload)
+            return True
+        except OSError:
+            self.alive = False
+            return False
+
     def close(self) -> None:
         self.alive = False
         try:
@@ -115,7 +153,8 @@ class ServeFront:
                  brownout: BrownoutController | None = None,
                  breaker: CircuitBreaker | None = None,
                  read_timeout_s: float = 2.0, max_connections: int = 512,
-                 default_deadline_ms: float = 1000.0, chaos=None):
+                 default_deadline_ms: float = 1000.0, chaos=None,
+                 reuse_port: bool = False, ident: str | None = None):
         self.state = state
         self.registry = registry
         self.workers = int(workers)
@@ -124,6 +163,20 @@ class ServeFront:
         self.max_connections = int(max_connections)
         self.default_deadline_ms = float(default_deadline_ms)
         self.chaos = chaos
+        # SO_REUSEPORT lets N worker PROCESSES bind the same port and
+        # have the kernel spread connections across them — the
+        # multi-process plane's listener strategy (serve/workers.py)
+        self.reuse_port = bool(reuse_port)
+        self.ident = ident
+        # per-view interactive response cache: head/finality/lc_update
+        # answers are pure functions of the published view, so the hex
+        # walks run once per (view, method), not once per request
+        self._resp_view = None
+        self._resp_cache: dict = {}
+        # encoded twin of _resp_cache: (view, {method: reply-tail
+        # bytes}) swapped as ONE tuple so reader threads never pair a
+        # new view with a stale method's bytes
+        self._fast: tuple = (None, {})
         # the DAS proof path IS a DasServer: same hardened LRU, same
         # single-flight, same scheme_builds counter — the socket tier and
         # the in-process vectorized path are one cache domain
@@ -148,8 +201,14 @@ class ServeFront:
         self._lat: dict[int, list[float]] = {TIER_INTERACTIVE: [],
                                              TIER_BULK: []}
         self._lat_lock = threading.Lock()
+        # fast-path tallies: {method: [count, latency_sum_s]}, folded
+        # into the registry in one update per method at read time
+        # (_flush_fast_metrics) — the per-request counter inc +
+        # histogram observe is most of a cached reply's CPU
+        self._fast_ok: dict[str, list] = {}
         self.slow_loris_closed = 0
         self.conn_rejected = 0
+        self.frame_errors = 0
         self.chaos_stalls = 0
         self.started_at: float | None = None
         # chaos cache wipes ride the publish boundary: a wiped proof
@@ -164,6 +223,8 @@ class ServeFront:
         self.started_at = time.monotonic()
         lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if self.reuse_port:
+            lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         lst.bind((self.host, self.port))
         lst.listen(256)
         self._listener = lst
@@ -184,6 +245,18 @@ class ServeFront:
     def stop(self) -> None:
         self._stopping.set()
         self.queue.close()
+        # honest drain: whatever was admitted but not yet served gets a
+        # shed + retry-after answer before its connection dies — a
+        # stopping (or SIGTERM'd) worker never swallows queued work
+        for item in self.queue.drain():
+            req, conn, _arrival, _expires, tier = item
+            self._count("serve_requests_total", "requests by status",
+                        method=req.get("method"), status="shed")
+            self._count("serve_shed_total", "load-shed requests",
+                        tier=tier, reason="draining")
+            if conn is not None:  # best-effort: the conn may be gone
+                conn.reply({"id": req["id"], "status": "shed",
+                            "reason": "draining", "retry_after_ms": 50.0})
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -233,6 +306,11 @@ class ServeFront:
                     sock.close()
                     continue
                 sock.settimeout(self.read_timeout_s)
+                # small request/response frames ping-ponging through
+                # Nagle + delayed ACK stall for whole ACK timeouts;
+                # at serving rates that idleness IS the latency floor
+                sock.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
                 conn = _Conn(sock)
                 self._conns.append(conn)
             t = threading.Thread(target=self._reader_loop, args=(conn,),
@@ -263,11 +341,20 @@ class ServeFront:
                 conn.close()
                 return
             buf.extend(chunk)
+            # fast-path replies for THIS recv batch coalesce into one
+            # sendall — a pipelined client's 64-frame burst costs one
+            # write syscall, not 64
+            out: list = []
             while True:
                 if len(buf) < _LEN.size:
                     break
                 (length,) = _LEN.unpack(buf[:_LEN.size])
                 if length > MAX_FRAME_BYTES:
+                    # counted: a peer streaming unframed bytes reads as
+                    # a giant bogus length here, and an uncounted close
+                    # makes that bug invisible in every stats bundle
+                    with self._conn_lock:
+                        self.frame_errors += 1
                     conn.close()
                     return
                 if len(buf) < _LEN.size + length:
@@ -275,16 +362,58 @@ class ServeFront:
                 body = bytes(buf[_LEN.size:_LEN.size + length])
                 del buf[:_LEN.size + length]
                 try:
-                    self._on_request(conn, body)
+                    self._on_request(conn, body, out)
                 except Exception:
                     # ProtocolError or anything a hostile payload can
-                    # provoke: close THIS connection; a dead reader
-                    # with a live socket would leak a connection slot
+                    # provoke: close THIS connection (flushing replies
+                    # already owed for earlier frames in the batch); a
+                    # dead reader with a live socket would leak a slot
+                    with self._conn_lock:
+                        self.frame_errors += 1
+                    if out:
+                        conn.reply_raw(b"".join(out))
                     conn.close()
                     return
+            if out:
+                conn.reply_raw(b"".join(out))
 
-    def _on_request(self, conn: _Conn, body: bytes) -> None:
-        import json
+    _PING_TAIL = b',"status":"ok","result":{},"served_by":-1}'
+
+    def _on_request(self, conn: _Conn, body: bytes,
+                    out: list | None = None) -> None:
+        # parse-free fast path: a canonical interactive request whose
+        # answer is already in the per-view cache is served straight
+        # from the byte scan — id + method are the only fields a cached
+        # reply depends on (the deadline cannot matter: the reply is
+        # constructed inline, microseconds after arrival)
+        scan = _scan_interactive(body)
+        if scan is not None:
+            rid, method = scan
+            if method == "ping":
+                tail = self._PING_TAIL
+            else:
+                fview, tmpl = self._fast
+                tail = (tmpl.get(method)
+                        if fview is self.state.current() else None)
+            if tail is not None:
+                arrival = time.monotonic()
+                rbody = b'{"id":%d' % rid + tail
+                payload = _LEN.pack(len(rbody)) + rbody
+                dt = time.monotonic() - arrival
+                with self._lat_lock:
+                    lat = self._lat[TIER_INTERACTIVE]
+                    if len(lat) < _LAT_CAP:
+                        lat.append(dt)
+                    row = self._fast_ok.get(method)
+                    if row is None:
+                        self._fast_ok[method] = row = [0, 0.0]
+                    row[0] += 1
+                    row[1] += dt
+                if out is not None:
+                    out.append(payload)
+                else:
+                    conn.reply_raw(payload)
+                return
         try:
             req = json.loads(body)
         except json.JSONDecodeError as e:
@@ -304,6 +433,33 @@ class ServeFront:
                         "error": f"unknown method {str(method)[:64]!r}"})
             return
         arrival = time.monotonic()
+        # interactive fast path: when the per-view response cache
+        # already holds this method's answer, serve it straight from
+        # the reader — a queue hop (condvar wakeup + worker context
+        # switch) costs more than the cached reply itself, and at
+        # 20k+/s on a shared core that overhead IS the capacity limit.
+        # The FIRST request per (view, method) still takes the full
+        # admission path and populates the cache; bulk always queues.
+        if tier == TIER_INTERACTIVE and method != "stats":
+            if method == "ping":
+                tail = self._PING_TAIL
+            else:
+                fview, tmpl = self._fast
+                tail = (tmpl.get(method)
+                        if fview is self.state.current() else None)
+            if tail is not None:
+                self._count("serve_requests_total",
+                            "requests by status",
+                            method=method, status="ok")
+                self._record_latency(tier, time.monotonic() - arrival,
+                                     "ok")
+                rbody = b'{"id":%d' % req["id"] + tail
+                payload = _LEN.pack(len(rbody)) + rbody
+                if out is not None:
+                    out.append(payload)
+                else:
+                    conn.reply_raw(payload)
+                return
         deadline_ms = req.get("deadline_ms", self.default_deadline_ms)
         # NaN/Infinity parse as valid JSON numbers and would sail past
         # every `now >= expires_at` / projected-wait comparison —
@@ -449,15 +605,36 @@ class ServeFront:
         if method == "stats":
             return self.summary()
         view = self._view()
-        if method == "head":
-            return view.head_summary()
-        if method == "finality":
-            return view.finality_summary()
-        if method == "lc_update":
-            if view.update_ssz is None:
-                return {"update": None, "update_root": None}
-            return {"update": view.update_ssz.hex(),
-                    "update_root": view.update_root.hex()}
+        if method in ("head", "finality", "lc_update"):
+            # identity-keyed per-view cache: these answers are pure
+            # functions of the published view, and the hex walks are
+            # most of an interactive request's CPU at high rate
+            if self._resp_view is not view:
+                self._resp_view, self._resp_cache = view, {}
+            hit = self._resp_cache.get(method)
+            if hit is None:
+                if method == "head":
+                    hit = view.head_summary()
+                elif method == "finality":
+                    hit = view.finality_summary()
+                elif view.update_ssz is None:
+                    hit = {"update": None, "update_root": None}
+                else:
+                    hit = {"update": view.update_ssz.hex(),
+                           "update_root": view.update_root.hex()}
+                # idempotent per-view memo: concurrent builders store
+                # equal values, so a lost setitem costs one recompute
+                # pev: ignore[PEV101]
+                self._resp_cache[method] = hit
+            fast = self._fast
+            if fast[0] is not view:
+                fast = (view, {})
+                self._fast = fast
+            if method not in fast[1]:
+                enc = json.dumps(hit, separators=(",", ":")).encode()
+                fast[1][method] = (b',"status":"ok","result":' + enc
+                                   + b',"served_by":-1}')
+            return hit
         assert method == "das_cells"
         return self._das_cells(view, params, expires_at)
 
@@ -525,9 +702,29 @@ class ServeFront:
                 "p99_ms": percentile_ms(xs, 99),
                 "p999_ms": percentile_ms(xs, 99.9)}
 
+    def _flush_fast_metrics(self) -> None:
+        """Fold fast-path tallies into the registry — one counter inc
+        and one batched histogram update per method instead of one of
+        each per request."""
+        with self._lat_lock:
+            if not self._fast_ok:
+                return
+            pending, self._fast_ok = self._fast_ok, {}
+        if self.registry is None:
+            return
+        for method, (n, total_s) in pending.items():
+            self.registry.counter(
+                "serve_requests_total", "requests by status").inc(
+                n, method=method, status="ok")
+            self.registry.histogram(
+                "serve_request_seconds",
+                "arrival -> response write, per tier").observe_n(
+                total_s / n, n, tier=TIER_INTERACTIVE, status="ok")
+
     def summary(self) -> dict:
         """The ``serve_summary`` payload: everything the run report's
         "Serving" section and the bench_serve emission need."""
+        self._flush_fast_metrics()
         with self._lat_lock:
             lat = {t: list(v) for t, v in self._lat.items()}
         by_status: dict[str, int] = {}
@@ -566,6 +763,7 @@ class ServeFront:
                             "hit_rate": round(cache.hit_rate, 4)},
             "slow_loris_closed": self.slow_loris_closed,
             "conn_rejected": self.conn_rejected,
+            "frame_errors": self.frame_errors,
             "chaos_stalls": self.chaos_stalls,
             "service_ema_ms": round(self.estimator.ema_s * 1e3, 4),
         }

@@ -118,6 +118,12 @@ class Histogram(_Metric):
         self.buckets = tuple(sorted(buckets))
 
     def observe(self, value: float, **labels) -> None:
+        self.observe_n(value, 1, **labels)
+
+    def observe_n(self, value: float, n: int, **labels) -> None:
+        """``n`` observations of ``value`` in one bucket update — hot
+        paths that tally identical sub-bucket samples batch them here
+        instead of paying the label-key encode + lock per sample."""
         key = _label_key(labels)
         with self._lock:
             row = self.series.get(key)
@@ -127,9 +133,9 @@ class Histogram(_Metric):
                 self.series[key] = row
             i = bisect_left(self.buckets, value)
             if i < len(self.buckets):
-                row["bucket_counts"][i] += 1
-            row["sum"] += value
-            row["count"] += 1
+                row["bucket_counts"][i] += n
+            row["sum"] += value * n
+            row["count"] += n
 
     def value(self, **labels) -> dict | None:
         return self.series.get(_label_key(labels))

@@ -938,6 +938,26 @@ def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
     return summary
 
 
+def _serve_mp(args) -> int:
+    """The multi-process serving chaos scenario (ISSUE 16): the shared
+    harness runs the pool + swarm under seeded SIGKILLs / wedges /
+    fd exhaustion and self-judges; the bundle here is one JSON."""
+    from pos_evolution_tpu.serve.harness import run_mp_scenario
+    with use_config(minimal_config()):
+        out = run_mp_scenario(
+            arrivals=args.serve_arrivals, rate=args.serve_rate,
+            seed=args.seed, kills=args.serve_kills,
+            wedges=args.serve_wedges, fd_exhaust_n=64)
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, f"serve_mp_seed{args.seed}.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1, sort_keys=True, default=str)
+        fh.write("\n")
+    verdict = out["verdict"]
+    print(json.dumps({"verdict": verdict, "bundle": path}, indent=1))
+    return 0 if verdict["ok"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos fuzz: adversary x fault compositions under "
@@ -963,6 +983,17 @@ def main(argv=None) -> int:
                          "open-loop loadgen to every episode; the "
                          "SLO/goodput outcome joins the verdict and a "
                          "wrong served proof fails the episode")
+    ap.add_argument("--serve-mp", action="store_true",
+                    help="run the MULTI-PROCESS serving chaos scenario "
+                         "instead of episodes: a supervised worker pool "
+                         "behind SO_REUSEPORT fronts under seeded "
+                         "process-level injections (worker SIGKILLs, "
+                         "heartbeat wedges, an fd-exhaustion window); "
+                         "exit code follows the scenario verdict")
+    ap.add_argument("--serve-arrivals", type=int, default=30000)
+    ap.add_argument("--serve-rate", type=float, default=10000.0)
+    ap.add_argument("--serve-kills", type=int, default=2)
+    ap.add_argument("--serve-wedges", type=int, default=1)
     ap.add_argument("--dense", type=int, default=0, metavar="N",
                     help="run N DENSE episodes instead (ISSUE 13): "
                          "mainnet-scale DenseSimulation runs with "
@@ -988,6 +1019,8 @@ def main(argv=None) -> int:
                          "flushed config + checkpoint; verifies the "
                          "violations only when the bundle recorded some")
     args = ap.parse_args(argv)
+    if args.serve_mp:
+        return _serve_mp(args)
     if args.dense and args.mesh:
         from pos_evolution_tpu.utils.hostdev import reexec_with_host_devices
         pods, shard = (int(x) for x in args.mesh.lower().split("x"))

@@ -300,6 +300,57 @@ def build_report(events: list[dict], top_ops: dict | None = None,
                        if attach.get(k) is not None} or None,
         }
 
+    # -- multi-process serving (serve_mp_attach + serve_mp_summary) -----------
+    mp_events = by_type.get("serve_mp_summary", [])
+    serving_mp = None
+    if mp_events:
+        last = mp_events[-1]
+        attach = (by_type.get("serve_mp_attach") or [{}])[0]
+
+        def _mp_phase(result: dict | None) -> dict | None:
+            if not result:
+                return None
+            inter = (result.get("load") or {}).get("tiers", {}).get(
+                "interactive", {})
+            verdict = result.get("verdict") or {}
+            return {
+                "arrivals": result.get("arrivals"),
+                "rate": result.get("rate"),
+                "wall_s": (result.get("load") or {}).get("wall_s"),
+                "p50_ms": inter.get("p50_ms"),
+                "p99_ms": inter.get("p99_ms"),
+                "goodput_pct": inter.get("goodput_pct"),
+                "resends": verdict.get("resends"),
+                "lost": verdict.get("lost"),
+                "verified_proofs": verdict.get("verified_proofs"),
+                "verify_failures": verdict.get("verify_failures"),
+                "ok": verdict.get("ok"),
+            }
+
+        steady_r = last.get("steady") or {}
+        chaos_r = last.get("chaos")
+        # the chaos phase's pool carries the interruption story; a
+        # no-chaos run falls back to the steady pool
+        pool = ((chaos_r or steady_r).get("pool")) or {}
+        serving_mp = {
+            "fronts": attach.get("fronts") or steady_r.get("fronts"),
+            "workers": attach.get("workers") or steady_r.get("workers"),
+            "steady": _mp_phase(steady_r),
+            "chaos": _mp_phase(chaos_r),
+            "worker_rows": pool.get("workers") or [],
+            "interruptions": pool.get("interruptions") or [],
+            "interruptions_by_reason":
+                pool.get("interruptions_by_reason") or {},
+            "restarts": pool.get("restarts"),
+            "parked": pool.get("parked"),
+            "chaos_kills_delivered": pool.get("chaos_kills_delivered"),
+            "board_generation": (chaos_r or steady_r).get(
+                "board_generation"),
+            "respawned_on_current_generation":
+                ((chaos_r or steady_r).get("verdict") or {}).get(
+                    "respawned_on_current_generation"),
+        }
+
     # -- resilience (resilience/ checkpoint + supervisor events) --------------
     ckpts = by_type.get("checkpoint_saved", [])
     interruptions = by_type.get("supervisor_interruption", [])
@@ -446,6 +497,8 @@ def build_report(events: list[dict], top_ops: dict | None = None,
         report["resilience"] = resilience
     if serving:
         report["serving"] = serving
+    if serving_mp:
+        report["serving_mp"] = serving_mp
     if merkleization:
         report["merkleization"] = merkleization
     if das_serving:
@@ -681,6 +734,50 @@ def to_markdown(report: dict) -> str:
         if s.get("slo_ms") is not None:
             verdict = "**met**" if s.get("slo_ok") else "**MISSED**"
             md.append(f"- interactive p99 SLO {s['slo_ms']} ms: {verdict}")
+
+    if report.get("serving_mp"):
+        s = report["serving_mp"]
+        md += ["", "## Serving (multi-process)", ""]
+        md.append(f"- plane: **{s.get('fronts')}** fronts x "
+                  f"**{s.get('workers')}** worker processes over "
+                  f"shared-memory view generation "
+                  f"{s.get('board_generation')}")
+        phases = [(name, s.get(name)) for name in ("steady", "chaos")
+                  if s.get(name)]
+        if phases:
+            md += ["", *_md_table(
+                ["phase", "arrivals", "rate/s", "goodput %", "p50 ms",
+                 "p99 ms", "resends", "lost", "verify fails", "verdict"],
+                [[name, p.get("arrivals"), p.get("rate"),
+                  p.get("goodput_pct"), p.get("p50_ms"), p.get("p99_ms"),
+                  p.get("resends"), p.get("lost"),
+                  p.get("verify_failures"),
+                  "ok" if p.get("ok") else "FAILED"]
+                 for name, p in phases]), ""]
+        if s.get("worker_rows"):
+            md += [*_md_table(
+                ["worker", "pid", "alive", "restarts", "requests",
+                 "generation", "rss kb", "hb age s"],
+                [[r.get("worker"), r.get("pid"), r.get("alive"),
+                  r.get("restarts"), r.get("requests"),
+                  r.get("generation"), r.get("rss_kb"),
+                  r.get("hb_age_s")]
+                 for r in s["worker_rows"]]), ""]
+        if s.get("interruptions"):
+            md.append(f"- worker interruptions "
+                      f"({s.get('interruptions_by_reason')}; "
+                      f"{s.get('chaos_kills_delivered')} chaos SIGKILLs "
+                      f"delivered, {s.get('restarts')} respawns, "
+                      f"{s.get('parked')} parked):")
+            md += ["", *_md_table(
+                ["worker", "reason", "pid", "exit code", "at wall s"],
+                [[r.get("worker"), r.get("reason"), r.get("pid"),
+                  r.get("exit_code"), r.get("wall_s")]
+                 for r in s["interruptions"]]), ""]
+        regen = s.get("respawned_on_current_generation")
+        md.append(f"- respawned workers on current shared-memory "
+                  f"generation: "
+                  f"{'**yes**' if regen else '**NO — silent fork**'}")
 
     if report.get("das_serving"):
         d = report["das_serving"]

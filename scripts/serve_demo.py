@@ -25,6 +25,17 @@ Usage:
 ``scripts/run_report.py`` (the "Serving" section); ``--json`` writes a
 ``bench_serve`` emission gated by
 ``scripts/perf_gate.py --history --kind bench_serve``.
+
+``--mp`` switches to the **multi-process** plane (PR 16): shared-memory
+``ShmViewBoard`` view publication, a supervised ``WorkerPool`` of
+SO_REUSEPORT worker *processes* across ``--fronts`` listeners, a
+health-routed ``Balancer``, and the pipelined ``SwarmLoadGenerator`` —
+driven 10x harder (20000/s default) while seeded chaos SIGKILLs
+workers, wedges heartbeats, and exhausts fds. The emission kind becomes
+``bench_serve_mp`` and the run fails unless the harness verdict is ok:
+goodput >= 99%, p99 inside the SLO, zero verify failures, every kill
+and wedge detected, and every respawned worker on the current
+shared-memory generation.
 """
 
 from __future__ import annotations
@@ -79,31 +90,225 @@ def _verify_update_fn():
     return verify
 
 
+def _emit_artifacts(args, emission: dict, kind: str) -> None:
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(emission, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"emission -> {args.json}")
+    if args.record is not None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            f"SERVE_DEMO_r{args.record:02d}.json")
+        with open(path, "w") as fh:
+            json.dump(emission, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"record   -> {path}")
+    if args.history:
+        from pos_evolution_tpu.profiling import history
+        history.append_entry(args.history, emission, kind=kind)
+        print(f"history  -> {args.history} (kind={kind})")
+
+
+def _phase_line(tag: str, result: dict) -> None:
+    load, verdict = result["load"], result["verdict"]
+    inter = load["tiers"]["interactive"]
+    bulk = load["tiers"]["bulk"]
+    print(f"{tag}: interactive p50 {inter['p50_ms']} ms / "
+          f"p99 {inter['p99_ms']} ms, goodput {inter['goodput_pct']}% "
+          f"| bulk goodput {bulk['goodput_pct']}% | wall "
+          f"{load['wall_s']}s | resends {verdict['resends']}, "
+          f"lost {verdict['lost']}")
+
+
+def _main_mp(args, telemetry) -> int:
+    """The multi-process plane, in the same two-phase shape as the
+    classic demo: a **steady** SLO phase at the full (10x) rate with no
+    injections, then a **chaos** phase where workers are SIGKILLed and
+    wedged mid-traffic — each phase is one ``run_mp_scenario`` call and
+    must return a clean self-judged verdict."""
+    from pos_evolution_tpu.serve import run_mp_scenario
+    n_workers = args.fronts * args.workers_per_front
+    chaos_on = not args.no_chaos
+    print(f"== mp serving demo: steady {args.arrivals} arrivals @ "
+          f"{args.rate:.0f}/s"
+          + (f" + chaos {args.chaos_arrivals} @ "
+             f"{args.chaos_rate:.0f}/s" if chaos_on else "")
+          + f", {args.fronts} fronts x {args.workers_per_front} worker "
+          f"processes, seed={args.seed} ==")
+    telemetry.bus.emit(
+        "serve_mp_attach", fronts=args.fronts, workers=n_workers,
+        arrivals=args.arrivals, rate=args.rate,
+        chaos=({"seed": args.seed, "arrivals": args.chaos_arrivals,
+                "rate": args.chaos_rate, "kills": args.kills,
+                "wedges": args.wedges, "fd_exhaust": args.fd_exhaust}
+               if chaos_on else None))
+
+    # phase 1: steady state at the headline rate — the SLO phase
+    steady = run_mp_scenario(
+        n_fronts=args.fronts, workers_per_front=args.workers_per_front,
+        arrivals=args.arrivals, rate=args.rate, seed=args.seed,
+        kills=0, wedges=0, fd_exhaust_n=0, slo_ms=args.slo_ms,
+        events_bus=telemetry.bus)
+    _phase_line("steady", steady)
+    s_verdict = steady["verdict"]
+
+    # phase 2: process chaos — SIGKILLs, a heartbeat wedge, and an
+    # fd-exhaustion window against front 0, at a rate the survivors
+    # can still absorb while their peers respawn
+    chaos = None
+    if chaos_on:
+        chaos = run_mp_scenario(
+            n_fronts=args.fronts,
+            workers_per_front=args.workers_per_front,
+            arrivals=args.chaos_arrivals, rate=args.chaos_rate,
+            seed=args.seed, kills=args.kills, wedges=args.wedges,
+            fd_exhaust_n=args.fd_exhaust, slo_ms=args.slo_ms,
+            events_bus=telemetry.bus)
+        _phase_line("chaos ", chaos)
+        c_verdict = chaos["verdict"]
+        print(f"pool:  {c_verdict['kills_delivered']} SIGKILLs "
+              f"delivered ({c_verdict['crash_interruptions']} crash "
+              f"interruptions), {c_verdict['hang_interruptions']} "
+              f"hangs detected, {c_verdict['restarts']} respawns; "
+              f"live workers on current generation: "
+              f"{c_verdict['respawned_on_current_generation']}")
+
+    verified = s_verdict["verified_proofs"] + (
+        chaos["verdict"]["verified_proofs"] if chaos else 0)
+    failures = s_verdict["verify_failures"] + (
+        chaos["verdict"]["verify_failures"] if chaos else 0)
+    print(f"SLO (steady interactive p99 <= {args.slo_ms} ms at "
+          f"{args.rate:.0f}/s): "
+          f"{'MET' if s_verdict['slo_ok'] else 'MISSED'}; verified "
+          f"proofs {verified} (failures: {failures})")
+    telemetry.bus.emit("serve_mp_summary", steady=steady, chaos=chaos)
+    for tag, result in (("steady", steady),
+                        ("chaos", chaos)) if chaos else (
+                            ("steady", steady),):
+        verdict = result["verdict"]
+        detail = json.dumps({k: v for k, v in verdict.items()
+                             if k != "ok"}, sort_keys=True)
+        print(f"{tag} verdict: {'ok' if verdict['ok'] else 'FAILED'} "
+              f"({detail})")
+    assert failures == 0, \
+        "a served proof failed verification — correctness violation"
+    assert s_verdict["ok"], "steady mp verdict failed"
+    assert chaos is None or chaos["verdict"]["ok"], \
+        "chaos mp verdict failed"
+
+    s_inter = steady["load"]["tiers"]["interactive"]
+    emission = {
+        "metric": "bench_serve_mp",
+        "arrivals": args.arrivals + (args.chaos_arrivals
+                                     if chaos_on else 0),
+        "rate": args.rate,
+        "fronts": args.fronts,
+        "workers": n_workers,
+        "seed": args.seed,
+        "slo_ms": args.slo_ms,
+        "slo_ok": s_verdict["slo_ok"],
+        "serving": {
+            "steady": {k: s_inter[k] for k in
+                       ("p50_ms", "p99_ms", "p999_ms", "goodput_pct")},
+            "verified_proofs": verified,
+            "verify_failures": failures,
+        },
+        "board_generation": steady["board_generation"],
+    }
+    if chaos is not None:
+        c_inter = chaos["load"]["tiers"]["interactive"]
+        c_bulk = chaos["load"]["tiers"]["bulk"]
+        c_verdict = chaos["verdict"]
+        emission["serving"]["chaos_interactive"] = {
+            k: c_inter[k] for k in ("p50_ms", "p99_ms", "goodput_pct")}
+        emission["serving"]["chaos_bulk"] = {
+            "goodput_pct": c_bulk["goodput_pct"],
+            "shed_pct": c_bulk["shed_pct"]}
+        emission["serving"]["chaos_resends"] = c_verdict["resends"]
+        emission["serving"]["chaos_lost"] = c_verdict["lost"]
+        emission["chaos"] = {
+            "arrivals": args.chaos_arrivals,
+            "rate": args.chaos_rate,
+            "injections": chaos["chaos"]["injections"],
+            "fd_exhaust": chaos.get("fd_exhaust"),
+        }
+        emission["supervision"] = {
+            "kills_delivered": c_verdict["kills_delivered"],
+            "crash_interruptions": c_verdict["crash_interruptions"],
+            "hang_interruptions": c_verdict["hang_interruptions"],
+            "restarts": c_verdict["restarts"],
+            "live_workers": c_verdict["live_workers"],
+            "respawned_on_current_generation":
+                c_verdict["respawned_on_current_generation"],
+        }
+    _emit_artifacts(args, emission, kind="bench_serve_mp")
+    if args.events:
+        telemetry.close()
+        print(f"events   -> {args.events}\n  next: "
+              f"python scripts/run_report.py {args.events}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--arrivals", type=int, default=100_000,
-                    help="total client arrivals across both phases")
-    ap.add_argument("--rate", type=float, default=6000.0,
-                    help="mean arrival rate per second")
+    ap.add_argument("--mp", action="store_true",
+                    help="drive the multi-process plane (board + "
+                         "supervised worker pool + balancer) instead of "
+                         "the in-process ServeFront")
+    ap.add_argument("--arrivals", type=int, default=None,
+                    help="total client arrivals (default 100000, "
+                         "or 60000 with --mp)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="mean arrival rate per second (default 6000, "
+                         "or 20000 with --mp)")
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--validators", type=int, default=32)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--pattern", default="hotspot",
                     choices=("uniform", "diurnal", "bursty", "hotspot"),
                     help="chaos-phase arrival pattern")
-    ap.add_argument("--slo-ms", type=float, default=50.0,
-                    help="steady-state interactive p99 SLO")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="interactive p99 SLO (default 50 steady-state, "
+                         "or 300 under --mp process chaos)")
     ap.add_argument("--no-chaos", action="store_true")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--fronts", type=int, default=2,
+                    help="[--mp] SO_REUSEPORT listener groups")
+    ap.add_argument("--workers-per-front", type=int, default=2,
+                    help="[--mp] worker processes per front")
+    ap.add_argument("--kills", type=int, default=2,
+                    help="[--mp] seeded worker SIGKILLs")
+    ap.add_argument("--wedges", type=int, default=1,
+                    help="[--mp] seeded heartbeat-wedge windows")
+    ap.add_argument("--fd-exhaust", type=int, default=32,
+                    help="[--mp] idle connections held against front 0")
+    ap.add_argument("--chaos-arrivals", type=int, default=30_000,
+                    help="[--mp] chaos-phase arrivals")
+    ap.add_argument("--chaos-rate", type=float, default=10_000.0,
+                    help="[--mp] chaos-phase arrival rate — the rate "
+                         "the surviving workers must hold while their "
+                         "peers are killed, wedged, and respawned")
     ap.add_argument("--events", help="telemetry JSONL output path")
-    ap.add_argument("--json", help="write the bench_serve emission here")
+    ap.add_argument("--json", help="write the bench emission here")
     ap.add_argument("--history",
                     help="append the emission to this bench_history.jsonl")
     ap.add_argument("--record", type=int, default=None,
                     help="also write SERVE_DEMO_r{N}.json at the repo root")
     args = ap.parse_args(argv)
+    if args.arrivals is None:
+        args.arrivals = 60_000 if args.mp else 100_000
+    if args.rate is None:
+        args.rate = 20_000.0 if args.mp else 6000.0
+    if args.slo_ms is None:
+        args.slo_ms = 300.0 if args.mp else 50.0
 
     with use_config(minimal_config()):
+        if args.mp:
+            from pos_evolution_tpu.telemetry import Telemetry
+            telemetry = (Telemetry.to_file(args.events) if args.events
+                         else Telemetry())
+            return _main_mp(args, telemetry)
         from pos_evolution_tpu.serve import (
             LoadGenerator,
             ServeChaos,
@@ -292,23 +497,7 @@ def main(argv=None) -> int:
             },
             "telemetry": {"counts": telemetry.registry.counts()},
         }
-        if args.json:
-            with open(args.json, "w") as fh:
-                json.dump(emission, fh, indent=1, sort_keys=True)
-                fh.write("\n")
-            print(f"emission -> {args.json}")
-        if args.record is not None:
-            path = os.path.join(
-                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                f"SERVE_DEMO_r{args.record:02d}.json")
-            with open(path, "w") as fh:
-                json.dump(emission, fh, indent=1, sort_keys=True)
-                fh.write("\n")
-            print(f"record   -> {path}")
-        if args.history:
-            from pos_evolution_tpu.profiling import history
-            history.append_entry(args.history, emission, kind="bench_serve")
-            print(f"history  -> {args.history} (kind=bench_serve)")
+        _emit_artifacts(args, emission, kind="bench_serve")
         if args.events:
             telemetry.close()
             print(f"events   -> {args.events}\n  next: "

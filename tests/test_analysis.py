@@ -433,6 +433,95 @@ class TestMutableSharedState:
                "    _KERNEL_CACHE[k] = v\n")
         assert _codes(src) == []
 
+
+# --- PEV007: fork-unsafety ----------------------------------------------------
+
+_THREADED_PREAMBLE = (
+    "import multiprocessing\n"
+    "import threading\n\n"
+    "def start_pump(fn):\n"
+    "    threading.Thread(target=fn, daemon=True).start()\n\n")
+
+
+class TestForkUnsafety:
+    def test_fork_context_in_a_thread_running_module_flags(self):
+        src = _THREADED_PREAMBLE + (
+            "def launch(fn):\n"
+            "    ctx = multiprocessing.get_context(\"fork\")\n"
+            "    return ctx.Process(target=fn)\n")
+        assert _codes(src) == ["PEV007"]
+
+    def test_spawn_context_is_the_sanctioned_shape(self):
+        src = _THREADED_PREAMBLE + (
+            "def launch(fn):\n"
+            "    ctx = multiprocessing.get_context(\"spawn\")\n"
+            "    return ctx.Process(target=fn)\n")
+        assert _codes(src) == []
+
+    def test_fork_without_threads_is_not_flagged(self):
+        src = ("import multiprocessing\n\n"
+               "def launch(fn):\n"
+               "    ctx = multiprocessing.get_context(\"fork\")\n"
+               "    return ctx.Process(target=fn)\n")
+        assert _codes(src) == []
+
+    def test_bare_process_inherits_the_platform_default(self):
+        src = _THREADED_PREAMBLE + (
+            "def launch(fn):\n"
+            "    return multiprocessing.Process(target=fn)\n")
+        assert _codes(src) == ["PEV007"]
+
+    def test_child_entry_referencing_a_parent_lock_flags(self):
+        src = ("import multiprocessing\n"
+               "import threading\n\n"
+               "_registry_lock = threading.Lock()\n\n"
+               "def child(work):\n"
+               "    with _registry_lock:\n"
+               "        work()\n\n"
+               "def launch(work):\n"
+               "    ctx = multiprocessing.get_context(\"spawn\")\n"
+               "    return ctx.Process(target=child, args=(work,))\n")
+        assert _codes(src) == ["PEV007"]
+
+    def test_child_creating_its_own_lock_is_clean(self):
+        src = ("import multiprocessing\n"
+               "import threading\n\n"
+               "def child(work):\n"
+               "    lock = threading.Lock()\n"
+               "    with lock:\n"
+               "        work()\n\n"
+               "def launch(work):\n"
+               "    ctx = multiprocessing.get_context(\"spawn\")\n"
+               "    return ctx.Process(target=child, args=(work,))\n")
+        assert _codes(src) == []
+
+    def test_self_attr_lock_crossing_the_boundary_flags(self):
+        src = ("import multiprocessing\n"
+               "import threading\n\n"
+               "class Pool:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n\n"
+               "    def _child_main(self):\n"
+               "        with self._lock:\n"
+               "            pass\n\n"
+               "    def launch(self):\n"
+               "        ctx = multiprocessing.get_context(\"spawn\")\n"
+               "        return ctx.Process(target=self._child_main)\n")
+        assert _codes(src) == ["PEV007"]
+
+    def test_documented_handoff_suppresses(self):
+        src = ("import multiprocessing\n"
+               "import threading\n\n"
+               "_registry_lock = threading.Lock()\n\n"
+               "def child(work):\n"
+               "    # handoff: re-armed post-spawn by the supervisor\n"
+               "    with _registry_lock:  # pev: ignore[PEV007]\n"
+               "        work()\n\n"
+               "def launch(work):\n"
+               "    ctx = multiprocessing.get_context(\"spawn\")\n"
+               "    return ctx.Process(target=child, args=(work,))\n")
+        assert _codes(src) == []
+
     def test_unmutated_module_list_is_fine(self):
         src = ("default_tiers = [0, 1]\n\n"
                "def tiers():\n"
@@ -808,7 +897,7 @@ class TestReporters:
         # every registered code is documented in the report
         assert set(blob["rules"]) >= {"PEV001", "PEV002", "PEV003",
                                       "PEV004", "PEV005", "PEV006",
-                                      "PEV101", "PEV102"}
+                                      "PEV007", "PEV101", "PEV102"}
         json.dumps(blob)  # must be serializable as-is
 
     def test_text_report_carries_locations_and_tally(self):

@@ -1,0 +1,405 @@
+"""Multi-process serving plane tests (serve/shm, serve/workers,
+serve/balancer, utils/singleflight.ProcessFlight).
+
+Covers, inside-out:
+
+- the shared-memory view board: encode/decode fidelity, the
+  publish-once / attach-many seqlock contract, health slots and the
+  supervisor's tombstone;
+- the cross-process build lease: leader election, spool handoff to
+  waiters, dead-leader takeover;
+- the 8-process stampede pin: however many processes miss the same
+  (block, blob) keys at once, the backing build runs once per key —
+  ``sum(leads) == n_keys`` across the whole pool;
+- ``WorkerPool`` supervision end-to-end with real spawn children:
+  a SIGKILL'd worker is detected as a crash and respawned, a wedged
+  worker (heartbeats stop) is detected as a hang and respawned, and
+  respawns land on the board's current view generation;
+- the balancer's health-biased weighting, including the tombstone's
+  routing effect (a cleared front drops to the probe trickle).
+
+Everything here runs on the CPU-pinned test mesh; worker children are
+real ``spawn`` processes (the plane's production start method).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import multiprocessing
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.config import cfg, minimal_config, use_config
+from pos_evolution_tpu.serve.shm import (
+    LEASE_BUILDING,
+    LEASE_DONE,
+    ShmViewBoard,
+    decode_view,
+    encode_view,
+    lease_digest,
+)
+from pos_evolution_tpu.serve.state import ServeView
+from pos_evolution_tpu.utils.singleflight import ProcessFlight
+
+_CTX = multiprocessing.get_context("spawn")
+
+
+def _tiny_view(slot: int = 7, n_blobs: int = 2) -> ServeView:
+    root = bytes([slot % 256]) * 32
+    sidecars = [_Sidecar(np.full((8, 40), i + 1, dtype=np.uint8),
+                         bytes([i]) * 32) for i in range(n_blobs)]
+    return ServeView(
+        slot=slot, head_root=root, head_slot=slot,
+        justified_epoch=1, justified_root=b"\x01" * 32,
+        finalized_epoch=0, finalized_root=b"\x02" * 32,
+        update_ssz=b"\x5a" * 64, update_root=b"\x03" * 32,
+        sidecars={root: sidecars}, n_cells=16)
+
+
+class _Sidecar:
+    def __init__(self, cells, commitment):
+        self.cells = cells
+        self.commitment = commitment
+
+
+def _board(tmp, **kw):
+    lock_path = os.path.join(tmp, "board.lock")
+    return ShmViewBoard.create(lock_path, **kw), lock_path
+
+
+# --- shared-memory view board -------------------------------------------------
+
+class TestShmViewBoard:
+    def test_encode_decode_roundtrip(self):
+        view = _tiny_view()
+        out = decode_view(encode_view(view))
+        assert out.slot == view.slot
+        assert out.head_root == view.head_root
+        assert out.update_ssz == view.update_ssz
+        assert out.update_root == view.update_root
+        assert out.n_cells == view.n_cells
+        (root, cars), = out.sidecars.items()
+        assert root == view.head_root
+        for got, want in zip(cars, view.sidecars[view.head_root]):
+            assert got.commitment == want.commitment
+            np.testing.assert_array_equal(got.cells, want.cells)
+
+    def test_publish_once_attach_many(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            board, lock_path = _board(tmp)
+            try:
+                assert board.current() == (0, None)
+                g1 = board.publish(_tiny_view(slot=7))
+                reader = ShmViewBoard.attach(board.name, lock_path)
+                try:
+                    gen, view = reader.current()
+                    assert gen == g1 and view.slot == 7
+                    # same generation decodes once: the cache is hit
+                    assert reader.current()[1] is view
+                    g2 = board.publish(_tiny_view(slot=8))
+                    assert g2 > g1
+                    gen, view = reader.current()
+                    assert gen == g2 and view.slot == 8
+                finally:
+                    reader.close()
+            finally:
+                board.close()
+
+    def test_health_slots_and_tombstone(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            board, _ = _board(tmp, n_fronts=4)
+            try:
+                board.write_health(1, generation=6, brownout=True,
+                                   depth=3, requests=42, shed=2)
+                (row,) = board.read_health()
+                assert row["front"] == 1 and row["pid"] == os.getpid()
+                assert row["brownout"] and row["depth"] == 3
+                assert row["requests"] == 42 and row["shed"] == 2
+                assert row["age_s"] < 2.0
+                # the supervisor's tombstone: the slot vanishes from
+                # routing immediately, no staleness window
+                board.clear_health(1)
+                assert board.read_health() == []
+            finally:
+                board.close()
+
+
+# --- build lease --------------------------------------------------------------
+
+def _built(n: int = 4) -> dict:
+    return {c: (np.full(6, c, dtype=np.uint8),
+                np.full((2, 3), c, dtype=np.uint8)) for c in range(n)}
+
+
+class TestBuildLease:
+    def test_leader_spools_then_waiters_absorb(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            board, _ = _board(tmp)
+            try:
+                digest = lease_digest(("proofs", b"\x07" * 32, 0))
+                role, slot = board.lease_acquire(digest)
+                assert role == "lead" and slot >= 0
+                built = _built()
+                board.spool_write(digest, built)
+                board.lease_done(slot, digest)
+                role2, slot2 = board.lease_acquire(digest)
+                assert role2 == "done" and slot2 == slot
+                got = board.spool_read(digest)
+                assert set(got) == set(built)
+                for c in built:
+                    np.testing.assert_array_equal(got[c][0], built[c][0])
+                    np.testing.assert_array_equal(got[c][1], built[c][1])
+            finally:
+                board.close()
+
+    def test_live_leader_makes_waiters(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            board, _ = _board(tmp)
+            try:
+                digest = lease_digest(("proofs", b"\x08" * 32, 1))
+                role, slot = board.lease_acquire(digest)
+                assert role == "lead"
+                # this process IS the live leader: a second claimant
+                # must wait, not build
+                assert board.lease_acquire(digest) == ("wait", slot)
+                assert board.lease_state(slot, digest) == (
+                    LEASE_BUILDING, os.getpid())
+            finally:
+                board.close()
+
+    def test_dead_leader_takeover(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            board, _ = _board(tmp)
+            try:
+                # a real dead pid: spawn-and-reap a child
+                proc = subprocess.run([sys.executable, "-c", "pass"])
+                dead = subprocess.Popen([sys.executable, "-c", "pass"])
+                dead_pid = dead.pid
+                dead.wait()
+                assert proc.returncode == 0
+                digest = lease_digest(("proofs", b"\x09" * 32, 2))
+                board._write_lease(0, digest, LEASE_BUILDING, dead_pid)
+                role, slot = board.lease_acquire(digest)
+                assert (role, slot) == ("lead", 0)
+            finally:
+                board.close()
+
+
+# --- 8-process stampede -------------------------------------------------------
+
+def _stampede_child(board_name: str, lock_path: str, barrier,
+                    out_path: str, n_keys: int) -> None:
+    """Spawn entry: rendezvous with 7 siblings, then miss every key at
+    once. Builds are tiny and deterministic so waiters can check the
+    absorbed values."""
+    board = ShmViewBoard.attach(board_name, lock_path)
+    flight = ProcessFlight(board, timeout_s=60.0)
+    results = {}
+    try:
+        barrier.wait(60.0)
+        for k in range(n_keys):
+            built = flight.do(("stampede", k),
+                              lambda k=k: _built(4 + k))
+            results[k] = int(sum(int(v[0][0]) for v in built.values()))
+        payload = {"leads": flight.leads,
+                   "cross_waits": flight.cross_waits,
+                   "fallbacks": flight.fallbacks,
+                   "results": results}
+    finally:
+        board.close()
+    with open(out_path, "w") as f:
+        json.dump(payload, f)
+
+
+class TestEightProcessStampede:
+    def test_builds_once_per_key_across_eight_processes(self):
+        n_procs, n_keys = 8, 2
+        with tempfile.TemporaryDirectory() as tmp:
+            board, lock_path = _board(tmp)
+            barrier = _CTX.Barrier(n_procs)
+            outs = [os.path.join(tmp, f"p{i}.json")
+                    for i in range(n_procs)]
+            procs = [_CTX.Process(target=_stampede_child,
+                                  args=(board.name, lock_path, barrier,
+                                        outs[i], n_keys))
+                     for i in range(n_procs)]
+            try:
+                for p in procs:
+                    p.start()
+                for p in procs:
+                    p.join(120.0)
+                    assert p.exitcode == 0
+                reports = []
+                for path in outs:
+                    with open(path) as f:
+                        reports.append(json.load(f))
+            finally:
+                for p in procs:
+                    if p.is_alive():
+                        p.kill()
+                board.close()
+            # THE stampede pin: one build per key across the entire
+            # process pool — every other (process, key) pair absorbed
+            # the leader's spool
+            assert sum(r["leads"] for r in reports) == n_keys
+            assert sum(r["fallbacks"] for r in reports) == 0
+            assert (sum(r["cross_waits"] for r in reports)
+                    == n_procs * n_keys - n_keys)
+            # and every process saw the same built values
+            want = {str(k): (4 + k) * (3 + k) // 2 for k in range(n_keys)}
+            for r in reports:
+                assert r["results"] == want
+
+
+# --- WorkerPool supervision ---------------------------------------------------
+
+def _free_ports(n: int) -> list[int]:
+    ports, socks = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class TestWorkerPoolSupervision:
+    def test_crash_and_hang_detection_respawn_on_current_generation(self):
+        from pos_evolution_tpu.serve.workers import WorkerPool, worker_spec
+
+        with use_config(minimal_config()), \
+                tempfile.TemporaryDirectory() as tmp:
+            board, lock_path = _board(tmp, n_fronts=4)
+            board.publish(_tiny_view(slot=1))
+            (port,) = _free_ports(1)
+            cfg_dict = dataclasses.asdict(cfg())
+            # worker 1 wedges shortly after ready: its beat thread goes
+            # silent inside the window while the process stays alive —
+            # exactly what hang detection exists to catch. The window is
+            # short enough that the RESPAWNED child is outside it (a
+            # still-open window would wedge every respawn into parking)
+            wedge_at = time.time() + 3.0
+            specs = [
+                worker_spec(0, port, board.name, lock_path, tmp,
+                            threads=1, config=cfg_dict),
+                worker_spec(1, port, board.name, lock_path, tmp,
+                            threads=1, config=cfg_dict,
+                            chaos={"wedge_windows":
+                                   [(wedge_at, wedge_at + 2.5)]}),
+            ]
+            pool = WorkerPool(specs, board, hang_timeout_s=1.5,
+                              backoff_s=0.1, backoff_cap_s=0.5)
+            try:
+                pool.start()
+                assert pool.wait_ready(60.0), "pool never became ready"
+                killed = pool.kill_worker(0)
+                assert killed is not None
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    reasons = {i["reason"] for i in pool.interruptions}
+                    rows = pool.worker_rows()
+                    if {"crash", "hang"} <= reasons \
+                            and all(r["alive"] for r in rows) \
+                            and all(r["restarts"] >= 1 for r in rows):
+                        break
+                    time.sleep(0.1)
+                reasons = [i["reason"] for i in pool.interruptions]
+                assert "crash" in reasons, reasons
+                assert "hang" in reasons, reasons
+                by_worker = {i["worker"]: i["reason"]
+                             for i in pool.interruptions}
+                assert by_worker.get(0) == "crash"
+                assert by_worker.get(1) == "hang"
+                rows = pool.worker_rows()
+                assert all(r["alive"] for r in rows), rows
+                assert all(r["restarts"] >= 1 for r in rows), rows
+                # respawns serve the CURRENT published view: advance the
+                # generation and require both children to converge on it
+                gen = board.publish(_tiny_view(slot=2))
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    rows = pool.worker_rows()
+                    if all(r["generation"] == gen for r in rows):
+                        break
+                    time.sleep(0.1)
+                assert all(r["generation"] == gen
+                           for r in pool.worker_rows()), \
+                    (gen, pool.worker_rows())
+            finally:
+                pool.stop()
+                board.close()
+
+
+# --- balancer weighting -------------------------------------------------------
+
+class TestBalancerWeighting:
+    def _shares(self, bal, n: int = 2000) -> list[float]:
+        counts = [0] * bal.n_fronts
+        for i in range(n):
+            counts[bal.pick((i + 0.5) / n)] += 1
+        return [c / n for c in counts]
+
+    def test_health_bias_and_tombstone_trickle(self):
+        from pos_evolution_tpu.serve.balancer import Balancer
+
+        with tempfile.TemporaryDirectory() as tmp:
+            board, _ = _board(tmp, n_fronts=4)
+            try:
+                for slot in (0, 1, 2, 3):
+                    board.write_health(slot, generation=2)
+                bal = Balancer(2, board=board,
+                               slot_map=[[0, 1], [2, 3]],
+                               refresh_s=0.0)
+                shares = self._shares(bal)
+                assert abs(shares[0] - 0.5) < 0.05, shares
+                # front 1 browns out entirely: it keeps a reduced share
+                # (brownout is degradation, not death)
+                board.write_health(2, generation=2, brownout=True)
+                board.write_health(3, generation=2, brownout=True)
+                shares = self._shares(bal)
+                assert shares[0] > 0.65, shares
+                assert shares[1] > 0.1, shares
+                # both its workers die and the supervisor tombstones
+                # them: the front drops to the probe trickle at once
+                board.clear_health(2)
+                board.clear_health(3)
+                shares = self._shares(bal)
+                assert shares[1] < 0.1, shares
+                assert shares[0] > 0.9, shares
+            finally:
+                board.close()
+
+    def test_no_board_is_uniform(self):
+        from pos_evolution_tpu.serve.balancer import Balancer
+
+        bal = Balancer(4)
+        shares = self._shares(bal)
+        assert all(abs(s - 0.25) < 0.02 for s in shares), shares
+
+
+# --- end-to-end scenario (heavy: full mp plane under chaos) -------------------
+
+@pytest.mark.slow
+class TestMpScenario:
+    def test_chaos_scenario_verdict_ok(self):
+        from pos_evolution_tpu.serve.harness import run_mp_scenario
+
+        with use_config(minimal_config()):
+            result = run_mp_scenario(
+                arrivals=12000, rate=6000.0, seed=11, kills=1,
+                wedges=1, fd_exhaust_n=32)
+        verdict = result["verdict"]
+        assert verdict["ok"], verdict
+        assert verdict["interactive_goodput_pct"] >= 99.0
+        assert verdict["lost"] == 0 or \
+            result["load"]["lost_by_reason"], "losses must carry reasons"
