@@ -199,6 +199,23 @@ def link_tally(link_idx, weight, active, n_links):
     return np.asarray(link_tally_for(mesh, nl)(li, w, ac))[:n_links]
 
 
+def fr_ntt(values: np.ndarray, inverse: bool = False) -> np.ndarray:
+    """Batched Fr NTT/INTT on device: the jitted Cooley-Tukey kernel
+    over int32 Montgomery limbs, with a loud-once host fallback
+    (bit-identical to numpy_backend.fr_ntt)."""
+    from pos_evolution_tpu.kzg.ntt import fr_ntt_device_entry
+    return fr_ntt_device_entry(values, inverse)
+
+
+def g1_msm(points, scalars):
+    """G1 multi-scalar multiply on device (kzg/scheme.py commit path):
+    per-lane double-and-add scans over int32 limb vectors + a Jacobian
+    lane tree (ops/pairing.g1_msm_device), bit-identical to the host
+    Pippenger MSM (kzg/curve.py)."""
+    from pos_evolution_tpu.ops.pairing import g1_msm_device_entry
+    return g1_msm_device_entry(points, scalars)
+
+
 def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
     """Same contract as numpy_backend.subtree_weights (parent[i] < i)."""
     w = node_weight.astype(np.int64).copy()

@@ -115,6 +115,13 @@ def build_multiproof_paths(leaves: np.ndarray, indices, depth: int):
     return build_multiproof_paths_host(leaves, indices, depth)
 
 
+def fr_ntt(values: np.ndarray, inverse: bool = False) -> np.ndarray:
+    """Batched Fr NTT/INTT (kzg/ntt.py contract), PINNED to the host
+    NumPy twin — this backend is the reference oracle."""
+    from pos_evolution_tpu.kzg.ntt import fr_ntt_host_entry
+    return fr_ntt_host_entry(values, inverse)
+
+
 def subtree_weights(parent: np.ndarray, node_weight: np.ndarray) -> np.ndarray:
     """Accumulate each node's weight into all ancestors.
 

@@ -141,6 +141,13 @@ class Config:
     das_max_blobs_per_block: int = 2
     das_samples_per_client: int = 8
 
+    # --- KZG cell commitments (kzg/, DESIGN.md §23) ---
+    # Seed of the deterministic (insecure-by-design) powers-of-tau
+    # setup: every node and every resumed checkpoint must regenerate
+    # the identical SRS from config alone, so tau derives from this
+    # public value. The domain size is n_cells * cell_bytes/16.
+    kzg_setup_seed: int = 0
+
     # --- device merkleization (ops/merkle_device.py, DESIGN.md §22) ---
     # Level sweeps with fewer sibling pairs than this stay on the host
     # SHA-256 path: below the crossover the fixed device-dispatch

@@ -25,6 +25,7 @@ exists so crypto tests and future accelerated kernels have exact vectors.
 from __future__ import annotations
 
 import hashlib
+import os
 
 # --- parameters ---------------------------------------------------------------
 
@@ -432,16 +433,72 @@ def ec_neg_g2(q):
 
 # --- hash to G2 (try-and-increment + cofactor clearing) -----------------------
 
-def hash_to_g2(message: bytes):
+_G2_DST = b"blsg2"
+
+
+def _g2_cache_path(message: bytes, dst: bytes):
+    """Cache file for one (message, dst) pair, or None when the
+    ``POS_G2_CACHE_DIR`` knob is unset (the default: no disk IO)."""
+    cache_dir = os.environ.get("POS_G2_CACHE_DIR")
+    if not cache_dir:
+        return None
+    key = hashlib.sha256(b"g2cache-v1\x00" + dst + b"\x00" + message)
+    return os.path.join(cache_dir, f"g2_{key.hexdigest()}.bin")
+
+
+def _g2_cache_load(path: str):
+    """Stored point, or None on miss/corruption (caller recomputes)."""
+    try:
+        with open(path, "rb") as fh:
+            raw = fh.read()
+    except OSError:
+        return None
+    if len(raw) != 192:
+        return None
+    a, b, c, d = (int.from_bytes(raw[i:i + 48], "big")
+                  for i in range(0, 192, 48))
+    point = (Fq2(a, b), Fq2(c, d))
+    if max(a, b, c, d) >= Q or not g2_on_curve(point):
+        return None
+    return point
+
+
+def _g2_cache_store(path: str, point) -> None:
+    """Atomic tmp+rename write; cache misses must never break signing."""
+    x, y = point
+    raw = b"".join(v.to_bytes(48, "big") for v in (x.a, x.b, y.a, y.b))
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(raw)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def hash_to_g2(message: bytes, dst: bytes = _G2_DST):
     """Deterministic map to the r-torsion of E'(Fq2).
 
     NOT the IETF SSWU ciphersuite; a sound simple construction for the
-    simulator: derive x candidates from H(message || ctr), solve
+    simulator: derive x candidates from H(dst || message || ctr), solve
     y^2 = x^3 + 4(u+1), clear the cofactor.
+
+    The cofactor clearing is the dominant cost (~a full-width ec_mul),
+    so results are disk-cached keyed on (message, dst) when the
+    ``POS_G2_CACHE_DIR`` environment knob names a directory — repeated
+    runs over the same message population (chaos episodes, CI smoke
+    jobs) skip straight to the stored point. Corrupt or truncated
+    cache entries fail closed into recomputation.
     """
+    path = _g2_cache_path(message, dst)
+    if path is not None:
+        cached = _g2_cache_load(path)
+        if cached is not None:
+            return cached
     ctr = 0
     while True:
-        seed = hashlib.sha256(b"blsg2" + message + ctr.to_bytes(4, "little"))
+        seed = hashlib.sha256(dst + message + ctr.to_bytes(4, "little"))
         d0 = seed.digest()
         d1 = hashlib.sha256(d0).digest()
         d2 = hashlib.sha256(d1).digest()
@@ -455,6 +512,8 @@ def hash_to_g2(message: bytes):
                 y = -y
             point = ec_mul((x, y), G2_COFACTOR)
             if point is not None:
+                if path is not None:
+                    _g2_cache_store(path, point)
                 return point
         ctr += 1
 

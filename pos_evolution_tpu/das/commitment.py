@@ -44,6 +44,11 @@ class CellCommitmentScheme:
     """Contract every scheme implements over an (n_cells, cell_bytes) grid."""
 
     name = "abstract"
+    # capability flag: schemes that fold a whole sampled set into ONE
+    # opening proof (kzg/) set this True and additionally implement
+    # ``prove_aggregate``/``verify_aggregate``; branch-based schemes
+    # leave it False and serve per-cell branches
+    aggregates = False
 
     def cell_leaves(self, cells: np.ndarray) -> np.ndarray:
         """(n, 32) leaf values the commitment tree/polynomial is built over."""
@@ -132,6 +137,10 @@ def register_scheme(cls) -> type:
 
 
 def get_scheme(name: str = "merkle") -> CellCommitmentScheme:
+    if name == "kzg" and name not in _SCHEMES:
+        # lazy self-registration: the kzg package costs import time
+        # (field/curve constants), so it only loads when asked for
+        import pos_evolution_tpu.kzg.scheme  # noqa: F401
     try:
         return _SCHEMES[name]()
     except KeyError:

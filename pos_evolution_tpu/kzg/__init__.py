@@ -1,0 +1,33 @@
+"""KZG polynomial commitments for the DAS grid (DESIGN.md §23).
+
+The package splits along the repo's standard host/device seam:
+
+- ``fr.py`` — the BLS12-381 *scalar* field Fr as vectorized Montgomery
+  limb arithmetic: a pure-Python-int oracle, a batched NumPy host twin
+  and a jitted JAX device twin, bit-identical by construction.
+- ``ntt.py`` — batched radix-2 NTT/INTT over the 2^32 root-of-unity
+  subgroup of Fr*, dispatched through the ``ExecutionBackend`` seam
+  (``fr_ntt``) with the same mode/stats ladder as
+  ``ops/merkle_device.py``.
+- ``curve.py`` — inversion-free Jacobian group arithmetic on Python
+  ints (the oracle's affine ``ec_mul`` inverts per step — minutes per
+  MSM; this is milliseconds) plus a Pippenger multi-scalar multiply.
+- ``setup.py`` — the deterministic *insecure* powers-of-tau setup
+  (tau derived from a public seed; fine for a simulator, see DESIGN.md).
+- ``aggregate.py`` — the two-group-element multiproof (the polynomial
+  multiproofs recipe): all cells a client committee samples from one
+  block fold into (W, W') and verify with ONE pairing equation.
+- ``scheme.py`` — ``KzgCellScheme``, registered as ``"kzg"`` in the
+  ``das/commitment.py`` registry.
+"""
+
+__all__ = ["KzgCellScheme"]
+
+
+def __getattr__(name):
+    # lazy: importing the package for the field engine alone must not
+    # drag the curve/setup modules (and their import-time constants) in
+    if name == "KzgCellScheme":
+        from pos_evolution_tpu.kzg.scheme import KzgCellScheme
+        return KzgCellScheme
+    raise AttributeError(name)

@@ -1,0 +1,329 @@
+"""Two-group-element KZG multiproof: one opening proof per committee.
+
+The polynomial-multiproofs recipe (the arxiv 2604.16559 shape): a block
+carries blobs committed as C_b = [f_b(tau)]G1; a client committee
+samples cells, each cell being f_b restricted to one size-m coset of
+the evaluation domain. ALL sampled (blob, cell) claims fold into TWO
+G1 elements:
+
+    h(X)  = sum_i r_i * (f_{b_i}(X) - I_i(X)) / Z_i(X),     W  = [h(tau)]
+    L(X)  = sum_i gamma_i * (f_{b_i}(X) - I_i(s))
+            - Z_T(s) * h(X),        gamma_i = r_i * Z_T(s) / Z_i(s)
+    W' = [L(tau) / (tau - s)]
+
+with r_i and the second challenge s Fiat-Shamir-derived (s *after* W —
+the order matters for soundness), Z_i(X) = X^m - z_i the coset
+vanishing polynomial and Z_T the product over distinct sampled cosets.
+Since L(s) = 0 by construction, the verifier checks
+
+    e(F + s*W', [1]_2) == e(W', [tau]_2),
+    F = sum_b (sum_{i in b} gamma_i) C_b - [sum_i gamma_i I_i(s)]G
+        - Z_T(s) W
+
+— ONE pairing equation regardless of how many cells the committee
+sampled, 96 proof bytes against ~depth*32 per sample for the Merkle
+branches. (A naive "ship sum r_i*pi_i" single-aggregate is forgeable —
+the prover can decompose any polynomial across the quotient and
+remainder; the second challenge point s is what pins every I_i.)
+
+Verifier field work: I_i(s) by coset-barycentric evaluation,
+ell_j(s) = (s^m - z) * x_j / (m * z * (s - x_j)), batched host Fr.
+The pairing itself dispatches: the numpy backend pins the exact oracle
+(``pairings_equal``); the jax backend packs both sides of the equation
+into one doubled Miller scan (``ops/pairing.py`` lane packing, the
+``fast_aggregate_verify_batch`` trick).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from functools import lru_cache
+
+import numpy as np
+
+from pos_evolution_tpu.crypto.bls12_381 import (
+    R as _R,
+)
+from pos_evolution_tpu.crypto.bls12_381 import (
+    g1_compress,
+    g1_decompress,
+    pairings_equal,
+)
+from pos_evolution_tpu.kzg import curve, fr, ntt
+
+__all__ = ["prove", "verify", "PROOF_TAG", "proof_n_bytes"]
+
+PROOF_TAG = b"pevkzgagg1"
+
+
+# --- Fiat-Shamir --------------------------------------------------------------
+
+def _transcript(n_cells: int, m: int, wire_commitments, claims) -> bytes:
+    h = hashlib.sha256()
+    h.update(PROOF_TAG)
+    h.update(int(n_cells).to_bytes(4, "little"))
+    h.update(int(m).to_bytes(4, "little"))
+    h.update(len(wire_commitments).to_bytes(4, "little"))
+    for wc in wire_commitments:
+        h.update(bytes(wc))
+    h.update(len(claims).to_bytes(4, "little"))
+    for blob, cell, values in claims:
+        h.update(int(blob).to_bytes(4, "little"))
+        h.update(int(cell).to_bytes(4, "little"))
+        for v in values:
+            h.update(int(v).to_bytes(32, "little"))
+    return h.digest()
+
+
+def _challenge(t0: bytes, label: bytes, extra: bytes = b"") -> int:
+    d = hashlib.sha256(t0 + label + extra).digest()
+    return int.from_bytes(d, "little") % _R
+
+
+def _rs(t0: bytes, n: int) -> list[int]:
+    return [_challenge(t0, b"r", i.to_bytes(4, "little"))
+            for i in range(n)]
+
+
+# --- domain / coset helpers (ints) --------------------------------------------
+
+@lru_cache(maxsize=32)
+def _coset_geometry(n_cells: int, m: int):
+    """(z per cell, coset points per cell) for the N = n_cells*m domain
+    with cell i's chunk j sitting at domain index i + n_cells*j."""
+    n = n_cells * m
+    dom = ntt.domain(n)
+    zs = tuple(dom[(c * m) % n] for c in range(n_cells))
+    points = tuple(tuple(dom[(c + n_cells * j) % n] for j in range(m))
+                   for c in range(n_cells))
+    return zs, points
+
+
+def _interp_coeffs(values, cell: int, n_cells: int, m: int) -> list[int]:
+    """Degree-<m coefficients of the polynomial through cell ``cell``'s
+    coset evaluations: size-m INTT (values live on w^c * H in chunk
+    order) then the X -> X/w^c coordinate twist."""
+    b = fr.decode(ntt.ntt_host(fr.encode(values), inverse=True))
+    n = n_cells * m
+    dom = ntt.domain(n)
+    w_c_inv = pow(dom[cell % n], -1, _R)
+    out, tw = [], 1
+    for t in range(m):
+        out.append(b[t] * tw % _R)
+        tw = tw * w_c_inv % _R
+    return out
+
+
+def _div_xm_z(p: list[int], z: int, m: int) -> tuple[list[int], list[int]]:
+    """(quotient, remainder) of p by X^m - z: the top-down block
+    recurrence q_t = p_{t+m} + z * q_{t+m}, O(len(p)) int muls."""
+    n = len(p)
+    q = [0] * max(n - m, 0)
+    for t in range(n - m - 1, -1, -1):
+        q[t] = (p[t + m] + (z * q[t + m] if t + m < n - m else 0)) % _R
+    rem = [(p[j] + z * q[j]) % _R if j < len(q) else p[j] % _R
+           for j in range(min(m, n))]
+    return q, rem
+
+
+def _poly_eval(p, x: int) -> int:
+    acc = 0
+    for c in reversed(p):
+        acc = (acc * x + c) % _R
+    return acc
+
+
+# --- prover -------------------------------------------------------------------
+
+def prove(setup, n_cells: int, m: int, blobs, claims) -> dict:
+    """Aggregate opening proof for one committee's sampled cells.
+
+    blobs:  [(wire_commitment bytes32, point affine, coeffs list[int])]
+            — one entry per distinct blob polynomial, coeffs length
+            N = n_cells * m.
+    claims: [(blob_index, cell_id, values tuple[int] len m)].
+    Returns {"points": [48B compressed C_b ...], "w": 48B, "wp": 48B}.
+    """
+    n = n_cells * m
+    claims = sorted(((int(b), int(c), tuple(int(v) for v in values))
+                     for b, c, values in claims), key=lambda t: t[:2])
+    wires = [bytes(wc) for wc, _pt, _cf in blobs]
+    t0 = _transcript(n_cells, m, wires, claims)
+    rs = _rs(t0, len(claims))
+    zs, _pts = _coset_geometry(n_cells, m)
+
+    # h(X) = sum r_i * (f_i - I_i) / Z_i  — honest data divides exactly
+    h = [0] * (n - m)
+    for (blob, cell, values), r_i in zip(claims, rs):
+        coeffs = blobs[blob][2]
+        a = _interp_coeffs(values, cell, n_cells, m)
+        num = [(coeffs[t] - (a[t] if t < m else 0)) % _R for t in range(n)]
+        q, rem = _div_xm_z(num, zs[cell], m)
+        assert not any(rem), "claim values do not lie on the polynomial"
+        for t in range(n - m):
+            h[t] = (h[t] + r_i * q[t]) % _R
+    w_point = curve.g1_lincomb(setup.powers_g1[: n - m], h)
+    w_comp = g1_compress(w_point)
+
+    s = _challenge(t0, b"s", w_comp)
+    zt_s = 1
+    for z in sorted({zs[cell] for _b, cell, _v in claims}):
+        zt_s = zt_s * (pow(s, m, _R) - z) % _R
+    gammas = [r_i * zt_s * pow(pow(s, m, _R) - zs[cell], -1, _R) % _R
+              for (_b, cell, _v), r_i in zip(claims, rs)]
+
+    # L(X) = sum gamma_i f_i(X) - [sum gamma_i I_i(s)] - Z_T(s) h(X)
+    big_l = [0] * n
+    const = 0
+    for (blob, cell, values), g in zip(claims, gammas):
+        coeffs = blobs[blob][2]
+        for t in range(n):
+            big_l[t] = (big_l[t] + g * coeffs[t]) % _R
+        a = _interp_coeffs(values, cell, n_cells, m)
+        const = (const + g * _poly_eval(a, s)) % _R
+    big_l[0] = (big_l[0] - const) % _R
+    for t in range(n - m):
+        big_l[t] = (big_l[t] - zt_s * h[t]) % _R
+    assert _poly_eval(big_l, s) == 0, "L(s) must vanish by construction"
+
+    # W' = [L(tau) / (tau - s)]: synthetic division by (X - s)
+    wp = [0] * (n - 1)
+    carry = 0
+    for t in range(n - 2, -1, -1):
+        carry = (big_l[t + 1] + s * carry) % _R
+        wp[t] = carry
+    wp_point = curve.g1_lincomb(setup.powers_g1[: n - 1], wp)
+
+    return {
+        "points": [g1_compress(pt) for _wc, pt, _cf in blobs],
+        "w": w_comp,
+        "wp": g1_compress(wp_point),
+    }
+
+
+def proof_n_bytes(proof: dict) -> int:
+    return (sum(len(p) for p in proof["points"])
+            + len(proof["w"]) + len(proof["wp"]))
+
+
+# --- verifier -----------------------------------------------------------------
+
+def _decompress_checked(comp: bytes):
+    """48B -> affine point, subgroup-checked on the fast Jacobian path
+    (the oracle's affine r-torsion check inverts per step)."""
+    p = g1_decompress(bytes(comp))
+    if p is not None and curve.jac_mul(p, _R)[2] != 0:
+        raise ValueError("point not in the r-torsion subgroup")
+    return p
+
+
+def verify(setup, n_cells: int, m: int, wire_commitments, claims,
+           proof: dict, wire_bind) -> bool:
+    """Check an aggregate proof. ``wire_commitments``: 32-byte wire
+    commitment per blob index; ``claims`` as in :func:`prove`;
+    ``wire_bind(compressed_point) -> bytes32`` is the scheme's binding
+    hash (the sidecar commitment field is 32 bytes; the proof ships the
+    real 48-byte points, bound by hash)."""
+    try:
+        n = n_cells * m
+        claims = sorted(((int(b), int(c), tuple(int(v) % _R for v in values))
+                         for b, c, values in claims), key=lambda t: t[:2])
+        wires = [bytes(wc) for wc in wire_commitments]
+        if len(proof["points"]) != len(wires):
+            return False
+        points = []
+        for comp, wc in zip(proof["points"], wires):
+            if wire_bind(bytes(comp)) != wc:
+                return False                    # hash binding broken
+            points.append(_decompress_checked(comp))
+        w_point = _decompress_checked(proof["w"])
+        wp_point = _decompress_checked(proof["wp"])
+
+        t0 = _transcript(n_cells, m, wires, claims)
+        rs = _rs(t0, len(claims))
+        s = _challenge(t0, b"s", bytes(proof["w"]))
+        zs, pts = _coset_geometry(n_cells, m)
+        s_m = pow(s, m, _R)
+        zt_s = 1
+        for z in sorted({zs[cell] for _b, cell, _v in claims}):
+            zt_s = zt_s * (s_m - z) % _R
+        if zt_s == 0:                           # s hit the domain: 2^-224
+            return False
+
+        # I_i(s) by coset barycentric + the gamma-weighted commitment fold
+        m_inv = pow(m, -1, _R)
+        per_blob: dict[int, int] = {}
+        const = 0
+        for (blob, cell, values), r_i in zip(claims, rs):
+            z = zs[cell]
+            g = r_i * zt_s % _R * pow(s_m - z, -1, _R) % _R
+            per_blob[blob] = (per_blob.get(blob, 0) + g) % _R
+            acc = 0
+            for x_j, v in zip(pts[cell], values):
+                d = (s - x_j) % _R
+                if d == 0:
+                    return False
+                acc = (acc + v * x_j % _R * pow(d, -1, _R)) % _R
+            i_s = (s_m - z) * m_inv % _R * pow(z, -1, _R) % _R * acc % _R
+            const = (const + g * i_s) % _R
+
+        from pos_evolution_tpu.crypto.bls12_381 import G1_GEN
+        f_pts = [points[b] for b in per_blob]
+        f_scs = [per_blob[b] for b in per_blob]
+        f_pts += [G1_GEN, w_point, wp_point]
+        f_scs += [(-const) % _R, (-zt_s) % _R, s]
+        lhs = curve.g1_lincomb(f_pts, f_scs)    # F + s*W'
+        return _pairing_check(lhs, wp_point, setup)
+    except (ValueError, KeyError, IndexError, TypeError):
+        return False
+
+
+def _pairing_check(lhs, wp_point, setup) -> bool:
+    """e(lhs, [1]_2) == e(W', [tau]_2), backend-dispatched: oracle
+    pairings on numpy, the doubled-Miller-scan lane packing on jax."""
+    from pos_evolution_tpu.backend import get_backend
+    if getattr(get_backend(), "name", "numpy") == "jax":
+        try:
+            return bool(_pairing_check_device(lhs, wp_point, setup))
+        except Exception:   # pragma: no cover - broken jax degrades
+            pass
+    return pairings_equal([(lhs, setup.g2_one)], [(wp_point, setup.g2_tau)])
+
+
+@lru_cache(maxsize=1)
+def _device_pairing_kernel():
+    import jax
+    import jax.numpy as jnp
+
+    from pos_evolution_tpu.ops.pairing import (
+        final_exponentiation,
+        g2_neg,
+        miller_loop,
+    )
+    from pos_evolution_tpu.ops.tower import alg_eq, alg_one, fq12_mul
+
+    def kernel(g1s, g2s, infs):
+        # both pairing sides ride ONE 63-step Miller scan (lane packing,
+        # the fast_aggregate_verify_batch trick), then a product + one
+        # final exponentiation decides the equation
+        fs = miller_loop(g1s, jnp.concatenate(
+            [g2s[:1], g2_neg(g2s[1:])], axis=0), infs)
+        f = fq12_mul(fs[:1], fs[1:])
+        return alg_eq(final_exponentiation(f), alg_one(12, f.shape[:-2]))
+
+    return jax.jit(kernel)
+
+
+def _pairing_check_device(lhs, wp_point, setup) -> bool:
+    import jax.numpy as jnp
+
+    from pos_evolution_tpu.ops.pairing import (
+        g1_affine_encode,
+        g2_affine_encode,
+    )
+    g1s = jnp.asarray(np.stack([g1_affine_encode(lhs),
+                                g1_affine_encode(wp_point)]))
+    g2s = jnp.asarray(np.stack([g2_affine_encode(setup.g2_one),
+                                g2_affine_encode(setup.g2_tau)]))
+    infs = jnp.asarray(np.array([lhs is None, wp_point is None]))
+    return bool(np.asarray(_device_pairing_kernel()(g1s, g2s, infs))[0])

@@ -1,0 +1,337 @@
+"""BLS12-381 scalar field Fr as vectorized Montgomery limb arithmetic.
+
+``ops/fp.py`` covers the *base* field Fq for the pairing; commitments
+need the 255-bit *scalar* field Fr (polynomial coefficients, NTT
+twiddles, opening challenges). Same limb idiom — radix 2^12 digits in
+32-bit lanes, log-depth carry resolution — but **Montgomery** instead
+of Barrett: an NTT chains millions of multiplies by precomputable
+constants, and Montgomery's reduction is two truncated convolutions
+against fixed vectors (no quotient-window bookkeeping).
+
+Representation: 22 limbs x 12 bits (264 >= 255). Montgomery radix
+R = 2^264. Residues live lazily in [0, 2r); REDC keeps them there
+(4r < R so the standard t < 2r bound holds), one conditional subtract
+canonicalizes.
+
+The module carries THREE implementations, bit-identical by test
+(tests/test_kzg.py):
+
+- the **oracle**: plain Python ints mod r — ground truth;
+- the **host twin**: batched NumPy int64 over ``[..., 22]`` digit
+  vectors (the reference backend and the small-batch path);
+- the **device twin**: jitted JAX int32 reusing ``ops/fp.py``'s generic
+  digit plumbing (``conv_digits`` / ``carry_norm`` / ``sub_digits``).
+
+Montgomery REDC, formulated without the sequential CIOS loop (the same
+reasoning as fp.py's no-32-step-loop rule): with T = a*b,
+
+    m = (T mod R) * n' mod R        (one truncated convolution)
+    t = (T + m*r) / R               (one convolution, exact shift)
+
+— every step a log-depth batched op. Column sums <= 22*(2^12-1)^2
+< 2^29, inside int32.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "MODULUS", "BITS", "MASK", "L", "R_MONT",
+    "to_limbs", "from_limbs", "to_mont_int", "from_mont_int",
+    "encode", "decode", "encode_int", "decode_int",
+    "mont_mul", "mont_add", "mont_sub", "mont_neg", "mont_canon",
+    "mont_inv", "mont_pow", "batch_inv",
+    "ONE_M", "ZERO",
+]
+
+# the prime order of the BLS12-381 G1/G2 subgroups (crypto/bls12_381.R)
+MODULUS = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+BITS = 12
+MASK = (1 << BITS) - 1
+L = 22                      # 22 * 12 = 264 bits >= 255
+R_MONT = 1 << (BITS * L)    # Montgomery radix 2^264; 4r < R_MONT
+
+# n' = -r^(-1) mod R  (the REDC constant)
+_NPRIME = (-pow(MODULUS, -1, R_MONT)) % R_MONT
+# R^2 mod r (to_mont multiplier)
+_R2 = R_MONT * R_MONT % MODULUS
+
+
+def to_limbs(x: int, n: int = L) -> np.ndarray:
+    """Python int -> little-endian base-2^12 digit vector (int64)."""
+    assert 0 <= x
+    out = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= BITS
+    assert x == 0, "value does not fit in the limb vector"
+    return out
+
+
+def from_limbs(v) -> int:
+    out = 0
+    for i, d in enumerate(np.asarray(v).reshape(-1).tolist()):
+        out += int(d) << (BITS * i)
+    return out
+
+
+P = to_limbs(MODULUS)
+TWO_P = to_limbs(2 * MODULUS)
+NP = to_limbs(_NPRIME)
+R2 = to_limbs(_R2)
+ZERO = np.zeros(L, dtype=np.int64)
+ONE_M = to_limbs(R_MONT % MODULUS)       # 1 in Montgomery form
+
+
+def to_mont_int(x: int) -> int:
+    return x * R_MONT % MODULUS
+
+
+def from_mont_int(x: int) -> int:
+    return x * pow(R_MONT, -1, MODULUS) % MODULUS
+
+
+# --- host digit plumbing (NumPy int64, batch-leading [..., n]) ----------------
+
+def _conv_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Full digit-space product: [..., m] x [n] or [..., n] ->
+    [..., m+n-1] column sums. b broadcasts like a second batch operand."""
+    a = np.asarray(a, dtype=np.int64)
+    b = np.asarray(b, dtype=np.int64)
+    m, n = a.shape[-1], b.shape[-1]
+    outer = a[..., :, None] * b[..., None, :]
+    out = np.zeros(np.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+                   + (m + n - 1,), dtype=np.int64)
+    for j in range(n):                      # n is small/static (<= 22)
+        out[..., j:j + m] += outer[..., :, j]
+    return out
+
+
+def _carry_np(x: np.ndarray, out_len: int) -> np.ndarray:
+    """Normalize non-negative digit sums to canonical digits < 2^12 over
+    ``out_len`` limbs (value must fit; carries past the top are dropped
+    only when the caller guarantees they are zero). Host twin of
+    fp.carry_norm — folds until fixpoint, same canonical result."""
+    x = np.asarray(x, dtype=np.int64)
+    pad = out_len - x.shape[-1]
+    if pad > 0:
+        x = np.concatenate(
+            [x, np.zeros(x.shape[:-1] + (pad,), dtype=np.int64)], axis=-1)
+    elif pad < 0:
+        raise ValueError("_carry_np cannot truncate")
+    while (x >> BITS).any():
+        c = x >> BITS
+        x = (x & MASK)
+        x[..., 1:] += c[..., :-1]
+    return x
+
+
+def _sub_np(x: np.ndarray, y: np.ndarray):
+    """(x - y mod 2^(12*len), underflow) over canonical digit vectors."""
+    x = np.asarray(x, dtype=np.int64)
+    y = np.broadcast_to(np.asarray(y, dtype=np.int64), x.shape)
+    n = x.shape[-1]
+    d = x - y
+    borrow = np.zeros(x.shape[:-1], dtype=np.int64)
+    out = np.empty_like(d)
+    for i in range(n):                      # n static and small
+        t = d[..., i] - borrow
+        borrow = (t < 0).astype(np.int64)
+        out[..., i] = t + (borrow << BITS)
+    return out, borrow.astype(bool)
+
+
+def _cond_sub_np(x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    d, uf = _sub_np(x, y)
+    return np.where(uf[..., None], x, d)
+
+
+# --- host field ops: Montgomery residues in [0, 2r) ---------------------------
+
+def mont_mul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """REDC(a * b): inputs/outputs Montgomery residues in [0, 2r).
+    (2r)^2 < R*r, so t = (T + m*r)/R < 2r without any final subtract."""
+    t = _carry_np(_conv_np(a, b), 2 * L)
+    m = _carry_np(_conv_np(t[..., :L], NP), 2 * L)[..., :L]
+    u = _conv_np(m, P)
+    u = np.concatenate(
+        [u, np.zeros(u.shape[:-1] + (2 * L + 1 - u.shape[-1],),
+                     dtype=np.int64)], axis=-1)
+    u[..., :2 * L] += t
+    u = _carry_np(u, 2 * L + 1)
+    # low L digits are exactly zero (u ≡ 0 mod R); the shift is a slice
+    return u[..., L:2 * L]
+
+
+def mont_add(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    s = _carry_np(np.asarray(a, dtype=np.int64)
+                  + np.asarray(b, dtype=np.int64), L)
+    return _cond_sub_np(s, TWO_P)
+
+
+def mont_sub(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    d, uf = _sub_np(np.asarray(a, dtype=np.int64), b)
+    wrapped = _carry_np(d + TWO_P, L + 1)[..., :L]
+    return np.where(uf[..., None], wrapped, d)
+
+
+def mont_neg(a: np.ndarray) -> np.ndarray:
+    return mont_sub(np.broadcast_to(ZERO, np.asarray(a).shape), a)
+
+
+def mont_canon(a: np.ndarray) -> np.ndarray:
+    """[0, 2r) -> [0, r): canonical digits for equality/serialization."""
+    return _cond_sub_np(np.asarray(a, dtype=np.int64), P)
+
+
+_EXP_BITS = [(MODULUS - 2) >> i & 1
+             for i in range(MODULUS.bit_length())][::-1]
+
+
+def mont_pow(a: np.ndarray, e_bits=None) -> np.ndarray:
+    """Square-and-multiply over a static bit string (default r-2:
+    inversion by Fermat; 0 -> 0 by that convention)."""
+    bits = _EXP_BITS if e_bits is None else e_bits
+    acc = np.broadcast_to(ONE_M, np.asarray(a).shape).astype(np.int64)
+    for bit in bits:
+        acc = mont_mul(acc, acc)
+        if bit:
+            acc = mont_mul(acc, a)
+    return acc
+
+
+mont_inv = mont_pow
+
+
+def batch_inv(a: np.ndarray) -> np.ndarray:
+    """Montgomery batch inversion over the last-but-one axis: [..., n, L]
+    -> elementwise inverses with ONE Fermat inversion total (log-depth
+    Hillis-Steele prefix products + a backward sweep). Raises on zero —
+    callers invert challenge offsets that are nonzero with overwhelming
+    probability, and a silent 0^-1 = 0 would forge-verify."""
+    a = np.asarray(a, dtype=np.int64)
+    n = a.shape[-2]
+    if (mont_canon(a) == 0).all(axis=-1).any():
+        raise ZeroDivisionError("batch_inv of zero element")
+    prefix = a.copy()                       # prefix[i] = a[0]*...*a[i]
+    shift = 1
+    while shift < n:
+        prefix[..., shift:, :] = mont_mul(prefix[..., shift:, :],
+                                          prefix[..., :n - shift, :])
+        shift *= 2
+    total_inv = mont_inv(prefix[..., n - 1, :])
+    out = np.empty_like(a)
+    for i in range(n - 1, 0, -1):           # n small (<= domain size)
+        out[..., i, :] = mont_mul(total_inv, prefix[..., i - 1, :])
+        total_inv = mont_mul(total_inv, a[..., i, :])
+    out[..., 0, :] = total_inv
+    return out
+
+
+# --- element <-> limb encodes (host arrays) -----------------------------------
+
+def encode_int(x: int) -> np.ndarray:
+    """Canonical int -> Montgomery limb vector."""
+    return mont_mul(to_limbs(x % MODULUS), R2)
+
+
+def decode_int(v: np.ndarray) -> int:
+    """Montgomery limb vector -> canonical int."""
+    one = np.zeros(L, dtype=np.int64)
+    one[0] = 1
+    return from_limbs(mont_canon(mont_mul(np.asarray(v, dtype=np.int64),
+                                          one)))
+
+
+def encode(xs) -> np.ndarray:
+    """Iterable of ints -> [n, L] Montgomery limbs (vectorized REDC)."""
+    arr = np.stack([to_limbs(int(x) % MODULUS) for x in xs])
+    return mont_mul(arr, R2)
+
+
+def decode(v: np.ndarray) -> list[int]:
+    """[..., L] Montgomery limbs -> canonical ints."""
+    one = np.zeros(L, dtype=np.int64)
+    one[0] = 1
+    canon = mont_canon(mont_mul(np.asarray(v, dtype=np.int64), one))
+    flat = canon.reshape(-1, L)
+    return [from_limbs(row) for row in flat]
+
+
+# --- device twin (jitted JAX int32, fp.py digit plumbing) ---------------------
+#
+# Imported lazily: the numpy backend must never pull jax in. The device
+# functions mirror the host ones digit for digit; the differential tests
+# pin host == device == oracle on canonical outputs.
+
+_DEV = None
+
+
+def _device():
+    global _DEV
+    if _DEV is None:
+        import jax
+
+        from pos_evolution_tpu.backend.jax_init import ensure_x64
+        ensure_x64()
+        import jax.numpy as jnp
+
+        from pos_evolution_tpu.ops import fp
+
+        p_c = P.astype(np.int32)
+        two_p_c = TWO_P.astype(np.int32)
+        np_c = NP.astype(np.int32)
+        one_m_c = ONE_M.astype(np.int32)
+
+        def mul(a, b):
+            t = fp.carry_norm(fp.conv_digits(a, b), 2 * L)
+            m = fp.carry_norm(
+                fp.conv_digits(t[..., :L], jnp.asarray(np_c)),
+                2 * L)[..., :L]
+            u = fp.conv_digits(m, jnp.asarray(p_c))
+            u = jnp.pad(u, [(0, 0)] * (u.ndim - 1)
+                        + [(0, 2 * L + 1 - u.shape[-1])])
+            u = u.at[..., :2 * L].add(t)
+            return fp.carry_norm(u, 2 * L + 1)[..., L:2 * L]
+
+        def add(a, b):
+            s = fp.carry_norm(a + b, L)
+            return fp.cond_sub(s, two_p_c)
+
+        def sub(a, b):
+            d, uf = fp.sub_digits(a, b)
+            wrapped = fp.carry_norm(d + jnp.asarray(two_p_c),
+                                    L + 1)[..., :L]
+            return jnp.where(uf[..., None], wrapped, d)
+
+        def canon_(a):
+            return fp.cond_sub(a, p_c)
+
+        _bits = np.asarray(_EXP_BITS, dtype=bool)
+
+        def inv(a):
+            acc = jnp.broadcast_to(jnp.asarray(one_m_c),
+                                   a.shape).astype(jnp.int32)
+
+            def step(acc, bit):
+                acc = mul(acc, acc)
+                return jnp.where(bit, mul(acc, a), acc), None
+
+            acc, _ = jax.lax.scan(step, acc, jnp.asarray(_bits))
+            return acc
+
+        _DEV = {
+            "mul": mul, "add": add, "sub": sub, "canon": canon_,
+            "inv": inv,
+            "mul_jit": jax.jit(mul), "add_jit": jax.jit(add),
+            "canon_jit": jax.jit(canon_),
+        }
+    return _DEV
+
+
+def device_ops() -> dict:
+    """The jitted device twin: dict of mul/add/sub/canon/inv closures
+    over int32 limb arrays (ntt.py composes them into the NTT kernel)."""
+    return _device()

@@ -1,0 +1,219 @@
+"""Batched radix-2 NTT/INTT over the 2^32 root-of-unity subgroup of Fr.
+
+r - 1 has 2-adicity 32, so Fr* contains a 2^32-element subgroup of
+roots of unity; omega = 7^((r-1)/2^32) generates it (7 is a quadratic
+non-residue mod r, hence a generator up to odd part). Every power-of-two
+domain size n <= 2^32 uses omega_n = omega^(2^32/n).
+
+Shapes: ``[..., n, fr.L]`` Montgomery limb vectors, transformed along
+the -2 axis; batch dims lead. Decimation-in-time Cooley-Tukey with a
+precomputed bit-reversal gather and per-stage twiddle tables (Montgomery
+constants, host numpy — a jnp constant inside a trace would leak, same
+rule as fp._conv_selector).
+
+Dispatch follows the ``ops/merkle_device.py`` seam: spec-level callers
+use :func:`ntt`/:func:`intt`, which route through the thread's
+``ExecutionBackend`` (``fr_ntt``); the jax backend runs the jitted
+device kernel with a host fallback, the numpy backend pins the host
+twin. Locked stats counters record where each transform actually ran.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import lru_cache
+
+import numpy as np
+
+from pos_evolution_tpu.kzg import fr
+
+__all__ = [
+    "OMEGA_2_32", "domain", "root_of_unity",
+    "ntt", "intt", "ntt_host", "ntt_device",
+    "stats", "reset_stats",
+]
+
+# generator of the full 2^32 subgroup
+OMEGA_2_32 = pow(7, (fr.MODULUS - 1) >> 32, fr.MODULUS)
+
+_STATS = {"host_ntts": 0, "device_ntts": 0, "fallback_host": 0}
+_STATS_LOCK = threading.Lock()
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        _STATS[key] += n
+
+
+def stats() -> dict:
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def reset_stats() -> None:
+    with _STATS_LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+@lru_cache(maxsize=64)
+def root_of_unity(n: int) -> int:
+    """Primitive n-th root of unity (n a power of two <= 2^32)."""
+    assert n & (n - 1) == 0 and 0 < n <= (1 << 32), n
+    return pow(OMEGA_2_32, (1 << 32) // n, fr.MODULUS)
+
+
+@lru_cache(maxsize=64)
+def domain(n: int) -> tuple[int, ...]:
+    """The evaluation domain (1, w, w^2, ..., w^(n-1)) as ints."""
+    w = root_of_unity(n)
+    out, acc = [], 1
+    for _ in range(n):
+        out.append(acc)
+        acc = acc * w % fr.MODULUS
+    return tuple(out)
+
+
+@lru_cache(maxsize=64)
+def _plan(n: int, inverse: bool):
+    """(bit-reversal gather, per-stage twiddle tables, n^-1 scale) —
+    host numpy Montgomery constants shared by both twins."""
+    assert n & (n - 1) == 0 and n >= 1
+    logn = n.bit_length() - 1
+    rev = np.zeros(n, dtype=np.int64)
+    for i in range(n):
+        rev[i] = int(format(i, f"0{logn}b")[::-1], 2) if logn else 0
+    w = root_of_unity(n)
+    if inverse:
+        w = pow(w, -1, fr.MODULUS)
+    tables = []
+    for s in range(logn):
+        m2 = 1 << s                          # butterfly half-width
+        step = n // (2 * m2)
+        tw = [pow(w, step * j, fr.MODULUS) for j in range(m2)]
+        tables.append(fr.encode(tw))
+    scale = fr.encode([pow(n, -1, fr.MODULUS)])[0] if inverse else None
+    return rev, tuple(tables), scale
+
+
+def _transform(x, plan, ops, asarray):
+    """The shared Cooley-Tukey ladder, parameterized over the field-op
+    set (host numpy or jitted device closures)."""
+    rev, tables, scale = plan
+    n = x.shape[-2]
+    x = x[..., rev, :]
+    for tw in tables:
+        m2 = tw.shape[0]
+        shp = x.shape[:-2] + (n // (2 * m2), 2, m2, fr.L)
+        x = x.reshape(shp)
+        a = x[..., 0, :, :]
+        b = ops["mul"](x[..., 1, :, :], asarray(tw))
+        x = _stack2(ops, a, b)
+        x = x.reshape(x.shape[:-4] + (n, fr.L))
+    if scale is not None:
+        x = ops["mul"](x, asarray(scale))
+    return x
+
+
+def _stack2(ops, a, b):
+    """[(a+b), (a-b)] back into the [..., blocks, 2, m2, L] layout."""
+    hi = ops["add"](a, b)
+    lo = ops["sub"](a, b)
+    return ops["stack"]([hi, lo])
+
+
+_HOST_OPS = {
+    "mul": fr.mont_mul,
+    "add": fr.mont_add,
+    "sub": fr.mont_sub,
+    "stack": lambda xs: np.stack(xs, axis=-3),
+}
+
+
+def ntt_host(values: np.ndarray, inverse: bool = False) -> np.ndarray:
+    """Host-NumPy transform: [..., n, L] Montgomery limbs -> same shape.
+    Forward maps coefficients to evaluations on ``domain(n)``; inverse
+    undoes it (with the n^-1 scale)."""
+    values = np.asarray(values, dtype=np.int64)
+    return _transform(values, _plan(values.shape[-2], bool(inverse)),
+                      _HOST_OPS, lambda c: c)
+
+
+@lru_cache(maxsize=32)
+def _device_kernel(n: int, inverse: bool):
+    import jax
+    import jax.numpy as jnp
+
+    dev = fr.device_ops()
+    plan = _plan(n, inverse)
+    ops = {
+        "mul": dev["mul"], "add": dev["add"], "sub": dev["sub"],
+        "stack": lambda xs: jnp.stack(xs, axis=-3),
+    }
+
+    def kernel(x):
+        return _transform(x, plan, ops,
+                          lambda c: jnp.asarray(c.astype(np.int32)))
+
+    return jax.jit(kernel)
+
+
+def ntt_device(values: np.ndarray, inverse: bool = False) -> np.ndarray:
+    """Jitted JAX transform — bit-identical to :func:`ntt_host` (the
+    device twin shares the host plan's twiddle constants digit for
+    digit). Kernels are memoized per (n, inverse): no fresh jit per
+    call (analysis/ PEV rule)."""
+    import jax.numpy as jnp
+
+    values = np.ascontiguousarray(values)
+    kernel = _device_kernel(values.shape[-2], bool(inverse))
+    out = kernel(jnp.asarray(values.astype(np.int32)))
+    return np.asarray(out).astype(np.int64)
+
+
+# --- backend seam -------------------------------------------------------------
+
+def ntt(values: np.ndarray, inverse: bool = False) -> np.ndarray:
+    """Transform through the thread's ``ExecutionBackend``: the numpy
+    backend pins the host twin, the jax backend runs the device kernel
+    (with a loud-once host fallback, merkle_device-style)."""
+    from pos_evolution_tpu.backend import get_backend
+    backend = get_backend()
+    fn = getattr(backend, "fr_ntt", None)
+    if fn is None:
+        _bump("host_ntts")
+        return ntt_host(values, inverse)
+    return fn(values, inverse)
+
+
+def intt(values: np.ndarray) -> np.ndarray:
+    return ntt(values, inverse=True)
+
+
+def fr_ntt_host_entry(values, inverse):
+    """numpy_backend.fr_ntt: pinned host path (the reference oracle
+    backend must not pick up device state)."""
+    _bump("host_ntts")
+    return ntt_host(values, inverse)
+
+
+_FELL_BACK = False
+
+
+def fr_ntt_device_entry(values, inverse):
+    """jax_backend.fr_ntt: device kernel with one-shot warned host
+    fallback (same ladder posture as ops/merkle_device.py — a broken
+    jax install degrades, never crashes the serving path)."""
+    global _FELL_BACK
+    try:
+        out = ntt_device(values, inverse)
+        _bump("device_ntts")
+        return out
+    except Exception as e:  # pragma: no cover - exercised only sans jax
+        _bump("fallback_host")
+        if not _FELL_BACK:
+            _FELL_BACK = True
+            import warnings
+            warnings.warn(f"fr_ntt device path failed ({e!r}); "
+                          "falling back to host NTT", RuntimeWarning)
+        return ntt_host(values, inverse)

@@ -1,0 +1,237 @@
+"""``KzgCellScheme``: pairing-backed cell commitments behind the DAS seam.
+
+A blob's extended grid (n_cells x cell_bytes) IS a polynomial: every
+16-byte column chunk packs little-endian into one Fr element (< 2^128
+< r, trivially canonical), cell i's chunk j sitting at domain index
+i + n_cells*j of the size-N = n_cells*m evaluation domain. That layout
+makes each cell exactly the restriction of f to one size-m *coset*
+w^i * H (H the order-m subgroup), so per-cell openings have the cheap
+vanishing polynomial X^m - w^(i*m) and the committee-wide aggregate of
+``kzg/aggregate.py`` applies directly.
+
+The coefficient form comes from ONE batched INTT through the
+``ExecutionBackend`` seam (``kzg/ntt.py``) and the commitment MSM runs
+on the backend too (host Pippenger on numpy, the per-lane
+double-and-add device kernel on jax) — commit is bit-identical either
+way, which tests/test_kzg.py pins on randomized blobs.
+
+Wire format: sidecar commitments are pinned SSZ ``Bytes32``, a KZG
+commitment is a 48-byte G1 point. The scheme therefore publishes
+``wire_bind(point) = sha256(tag || compressed_point)`` as the 32-byte
+wire commitment; aggregate proofs ship the real points and every
+verifier checks the hash binding before the pairing — binding under
+collision resistance, no container/graffiti layout change.
+
+Erasure availability is untouched: the GF(2^8) ``reconstruct_check``
+stays the low-degree/extension check in ``BlobStore``; KZG binds the
+grid content to the 32-byte commitment the graffiti digest covers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from pos_evolution_tpu.config import cfg
+from pos_evolution_tpu.crypto.bls12_381 import R as _R
+from pos_evolution_tpu.crypto.bls12_381 import g1_compress
+from pos_evolution_tpu.das.commitment import (
+    CellCommitmentScheme,
+    register_scheme,
+)
+from pos_evolution_tpu.kzg import aggregate, curve, fr, ntt
+from pos_evolution_tpu.kzg.setup import trusted_setup
+
+__all__ = ["KzgCellScheme", "CHUNK_BYTES"]
+
+# bytes per Fr element: 16 < 31 keeps every chunk canonically < r AND
+# the domain small (N = n_cells * cell_bytes/16)
+CHUNK_BYTES = 16
+
+_WIRE_TAG = b"pev-kzg-wire-v1"
+
+
+class KzgCellScheme(CellCommitmentScheme):
+    """KZG commitments + aggregated multiproofs for the DAS cell grid."""
+
+    name = "kzg"
+    # capability flag: DasServer/serve front serve ONE aggregate proof
+    # per (block, sampled set) instead of per-cell merkle branches
+    aggregates = True
+
+    def __init__(self):
+        # commit memo: grid digest -> (point, compressed, coeffs, wire).
+        # One scheme instance is shared engine-wide (every view group's
+        # BlobStore + the DAS server), so the memo collapses the
+        # per-group commitment recomputation AND the serve tier's
+        # proof builds onto one MSM per distinct blob. Locked: the
+        # serve tier hits this from worker threads.
+        self._memo: OrderedDict = OrderedDict()
+        self._memo_lock = threading.Lock()
+        self._memo_cap = 256
+
+    # -- geometry --------------------------------------------------------------
+
+    @staticmethod
+    def geometry() -> tuple[int, int, int]:
+        """(n_cells, m, N) for the active config; loud on bad shapes."""
+        c = cfg()
+        n_cells = 2 * c.das_cells_per_blob
+        if c.das_cell_bytes % CHUNK_BYTES:
+            raise ValueError("das_cell_bytes must be a multiple of "
+                             f"{CHUNK_BYTES} for the kzg scheme")
+        m = c.das_cell_bytes // CHUNK_BYTES
+        if m & (m - 1) or n_cells & (n_cells - 1):
+            raise ValueError("kzg scheme needs power-of-two cell count "
+                             "and chunks per cell")
+        return n_cells, m, n_cells * m
+
+    @staticmethod
+    def depth_for(n_cells: int) -> int:
+        return 0            # no branch walk: proofs are aggregates
+
+    def setup(self):
+        n_cells, m, n = self.geometry()
+        return trusted_setup(n, cfg().kzg_setup_seed)
+
+    # -- wire binding ----------------------------------------------------------
+
+    @staticmethod
+    def wire_bind(compressed_point: bytes) -> bytes:
+        """48-byte G1 point -> the 32-byte wire commitment the sidecar
+        container / graffiti digest carry."""
+        return hashlib.sha256(_WIRE_TAG + bytes(compressed_point)).digest()
+
+    @staticmethod
+    def cell_values(cell: np.ndarray) -> tuple:
+        """One cell row (cell_bytes,) u8 -> its m Fr evaluations."""
+        raw = np.ascontiguousarray(cell, dtype=np.uint8).tobytes()
+        return tuple(int.from_bytes(raw[o:o + CHUNK_BYTES], "little")
+                     for o in range(0, len(raw), CHUNK_BYTES))
+
+    # -- commit ----------------------------------------------------------------
+
+    def commit_full(self, cells: np.ndarray):
+        """(point, compressed, coeffs, wire_commitment) for a grid,
+        memoized by content digest — commit is called once per view
+        group per sidecar and again on the serving path."""
+        grid = np.ascontiguousarray(cells, dtype=np.uint8)
+        n_cells, m, n = self.geometry()
+        if grid.shape != (n_cells, cfg().das_cell_bytes):
+            raise ValueError(f"grid shape {grid.shape} does not match "
+                             f"the das config")
+        key = (n_cells, m, hashlib.sha256(grid.tobytes()).digest())
+        with self._memo_lock:
+            hit = self._memo.get(key)
+            if hit is not None:
+                self._memo.move_to_end(key)
+                return hit
+        evals = np.zeros(n, dtype=object)
+        chunks = grid.reshape(n_cells, m, CHUNK_BYTES)
+        for i in range(n_cells):
+            for j in range(m):
+                evals[i + n_cells * j] = int.from_bytes(
+                    chunks[i, j].tobytes(), "little")
+        coeffs_mont = ntt.intt(fr.encode(evals.tolist()))
+        coeffs = fr.decode(coeffs_mont)
+        point = self._msm(coeffs)
+        comp = g1_compress(point)
+        out = (point, comp, tuple(coeffs), self.wire_bind(comp))
+        with self._memo_lock:
+            self._memo[key] = out
+            self._memo.move_to_end(key)
+            while len(self._memo) > self._memo_cap:
+                self._memo.popitem(last=False)
+        return out
+
+    def _msm(self, coeffs):
+        """Commitment MSM through the backend seam: host Pippenger on
+        numpy, the device double-and-add kernel on jax (bit-identical)."""
+        from pos_evolution_tpu.backend import get_backend
+        setup = self.setup()
+        dev = getattr(get_backend(), "g1_msm", None)
+        if dev is not None:
+            return dev(setup, coeffs)
+        return curve.g1_lincomb(setup.powers_g1[:len(coeffs)], coeffs)
+
+    def commit(self, cells: np.ndarray) -> bytes:
+        return self.commit_full(cells)[3]
+
+    # -- single-blob proofs (CellCommitmentScheme contract) --------------------
+
+    def prove_cells(self, cells: np.ndarray, indices) -> list[bytes]:
+        """Aggregate proof for a batch of this one blob's cells,
+        encoded as the interface's opaque list[bytes]."""
+        point, comp, coeffs, wire = self.commit_full(cells)
+        n_cells, m, _n = self.geometry()
+        claims = [(0, int(i), self.cell_values(cells[int(i)]))
+                  for i in indices]
+        proof = aggregate.prove(self.setup(), n_cells, m,
+                                [(wire, point, list(coeffs))], claims)
+        return self.encode_proof(proof)
+
+    def verify_cells(self, commitment: bytes, cells: np.ndarray, indices,
+                     proof: list[bytes]) -> bool:
+        """Check sampled cells of one blob against its 32-byte wire
+        commitment via the aggregate pairing equation."""
+        n_cells, m, _n = self.geometry()
+        try:
+            decoded = self.decode_proof(proof)
+        except (ValueError, IndexError):
+            return False
+        claims = [(0, int(i), self.cell_values(cells[j]))
+                  for j, i in enumerate(indices)]
+        return aggregate.verify(self.setup(), n_cells, m,
+                                [bytes(commitment)], claims, decoded,
+                                self.wire_bind)
+
+    # -- committee aggregates (DasServer / serve tier) -------------------------
+
+    def prove_aggregate(self, grids, samples) -> dict:
+        """One proof for everything a committee sampled from one block.
+        grids: per-blob cell grids; samples: [(blob, cell), ...]."""
+        n_cells, m, _n = self.geometry()
+        blobs = []
+        for grid in grids:
+            point, _comp, coeffs, wire = self.commit_full(grid)
+            blobs.append((wire, point, list(coeffs)))
+        claims = [(int(b), int(c),
+                   self.cell_values(np.asarray(grids[int(b)])[int(c)]))
+                  for b, c in samples]
+        return aggregate.prove(self.setup(), n_cells, m, blobs, claims)
+
+    def verify_aggregate(self, wire_commitments, samples, cells,
+                         proof: dict) -> bool:
+        """Committee-side check: sampled cell bytes + per-blob wire
+        commitments + the (points, W, W') proof -> one pairing verdict."""
+        n_cells, m, _n = self.geometry()
+        claims = [(int(b), int(c), self.cell_values(np.asarray(cells[j])))
+                  for j, (b, c) in enumerate(samples)]
+        return aggregate.verify(self.setup(), n_cells, m,
+                                [bytes(wc) for wc in wire_commitments],
+                                claims, proof, self.wire_bind)
+
+    # -- proof wire encoding ---------------------------------------------------
+
+    @staticmethod
+    def encode_proof(proof: dict) -> list[bytes]:
+        return ([aggregate.PROOF_TAG]
+                + [bytes(p) for p in proof["points"]]
+                + [bytes(proof["w"]), bytes(proof["wp"])])
+
+    @staticmethod
+    def decode_proof(parts: list[bytes]) -> dict:
+        parts = [bytes(p) for p in parts]
+        if len(parts) < 4 or parts[0] != aggregate.PROOF_TAG:
+            raise ValueError("malformed kzg aggregate proof")
+        return {"points": parts[1:-2], "w": parts[-2], "wp": parts[-1]}
+
+    @staticmethod
+    def proof_n_bytes(proof: dict) -> int:
+        return aggregate.proof_n_bytes(proof)
+
+
+register_scheme(KzgCellScheme)

@@ -1,0 +1,91 @@
+"""Deterministic insecure-but-sound trusted setup (powers of tau).
+
+A real KZG deployment gets its structured reference string from a
+multi-party ceremony precisely so that NOBODY knows tau. A simulator
+has the opposite need: every node (and every resumed checkpoint) must
+regenerate the identical setup from the chain config alone. So tau is
+derived from a public seed — **insecure** (anyone can forge openings if
+they bother to read this file) but **sound** in the cryptographic
+sense: the commitment scheme's binding argument only needs the SRS to
+be well-formed powers [tau^j]G, which this is. DESIGN.md §23 spells out
+why that is the honest posture for a reproduction.
+
+Group elements come from the existing oracle (``crypto/bls12_381.py``
+generators + encodings); the per-power scalar muls run on the
+inversion-free Jacobian path (``kzg/curve.py``) with one batch
+normalization, so a fresh 128-power setup is milliseconds, not minutes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from functools import lru_cache
+
+import numpy as np
+
+from pos_evolution_tpu.crypto.bls12_381 import (
+    G1_GEN,
+    G2_GEN,
+    R,
+    ec_mul,
+    g1_compress,
+)
+from pos_evolution_tpu.kzg import curve
+
+__all__ = ["TrustedSetup", "trusted_setup", "tau_from_seed"]
+
+
+def tau_from_seed(seed: int) -> int:
+    """The toxic waste, in the open: tau = H("pos-evo-kzg-tau" || seed)
+    reduced mod r (nonzero by construction for every practical seed)."""
+    d = hashlib.sha256(b"pos-evo-kzg-tau" + int(seed).to_bytes(8, "little"))
+    tau = int.from_bytes(d.digest(), "little") % R
+    return tau if tau > 1 else tau + 2
+
+
+@dataclass(frozen=True)
+class TrustedSetup:
+    """Powers of tau: [tau^j]G1 for j < n, plus [1]G2 and [tau]G2 (the
+    only G2 elements the two-element multiproof check needs)."""
+
+    n: int
+    seed: int
+    powers_g1: tuple            # n affine G1 points (ints)
+    g2_one: tuple               # G2 affine (Fq2 pair)
+    g2_tau: tuple
+
+    @property
+    def powers_g1_compressed(self) -> tuple:
+        return tuple(g1_compress(p) for p in self.powers_g1)
+
+    def device_encoding(self):
+        """[n, 2, 32] int32 limb array + [n] inf mask for the device
+        MSM kernel (ops/pairing.py encodings), memoized."""
+        enc = _device_encoding(self.n, self.seed)
+        return enc
+
+
+@lru_cache(maxsize=8)
+def trusted_setup(n: int, seed: int = 0) -> TrustedSetup:
+    """The (n, seed)-keyed setup, memoized per process: ROADMAP's
+    config3b lesson — never regenerate an identical SRS twice."""
+    tau = tau_from_seed(seed)
+    jac = []
+    t = 1
+    for _ in range(n):
+        jac.append(curve.jac_mul(curve.to_jac(G1_GEN), t))
+        t = t * tau % R
+    powers = tuple(curve.batch_to_affine(jac))
+    g2_tau = ec_mul(G2_GEN, tau)
+    return TrustedSetup(n=n, seed=int(seed), powers_g1=powers,
+                        g2_one=G2_GEN, g2_tau=g2_tau)
+
+
+@lru_cache(maxsize=8)
+def _device_encoding(n: int, seed: int):
+    from pos_evolution_tpu.ops.pairing import g1_affine_encode
+    setup = trusted_setup(n, seed)
+    enc = np.stack([g1_affine_encode(p) for p in setup.powers_g1])
+    inf = np.array([p is None for p in setup.powers_g1], dtype=bool)
+    return enc, inf

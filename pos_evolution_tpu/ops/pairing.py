@@ -374,3 +374,75 @@ def fast_aggregate_verify_batch(pk_table: jax.Array,
     f = fq12_mul(fs[:b], fs[b:])
     ok = alg_eq(final_exponentiation(f), alg_one(12, f.shape[:-2]))
     return ok & ~pk_inf & ~sig_inf
+
+
+# --- G1 multi-scalar multiply (kzg commit path) -------------------------------
+
+
+from functools import lru_cache  # noqa: E402
+
+
+@lru_cache(maxsize=8)
+def _g1_msm_kernel(n: int):
+    """Jitted fixed-shape MSM: every SRS power runs its own 255-step
+    double-and-add lane in parallel ([n] lanes x [32] limbs — the
+    batched-lane shape every kernel here uses), then a second scan
+    folds the lanes sequentially into one point. Traced once per
+    domain size n (lru_cache — the PEV no-fresh-jit-per-call rule).
+
+    Both reductions are lax.scans on purpose: the unified Jacobian add
+    costs XLA ~80 s of CPU compile PER INSTANCE, so a log-depth
+    unrolled lane tree (6 more instances at n=64) blows the one-time
+    compile past 10 minutes.  Two scan bodies keep it to one
+    double+add instance and one fold-add instance (~4 min cold, cached
+    for the process); the n-step sequential fold is runtime noise next
+    to the 255-step bit scan."""
+
+    @jax.jit
+    def kernel(points, inf, bits):
+        # affine -> per-lane Jacobian addend: Z = 1, or 0 for infinity
+        # lanes so the unified add treats them as the identity
+        one = jnp.broadcast_to(jnp.asarray(np.asarray(fp.ONE)), (n, fp.L))
+        z = _sel(~inf, one, jnp.zeros((n, fp.L), jnp.int32))
+        pj = jnp.concatenate([points, z[:, None, :]], axis=-2)
+
+        def step(acc, bit_col):
+            acc = g1_double_jac(acc)
+            cand = g1_add_jac(acc, pj)
+            return _sel(bit_col, cand, acc), None
+
+        acc0 = jnp.zeros((n, 3, fp.L), jnp.int32)
+        lanes, _ = jax.lax.scan(step, acc0, bits)
+
+        def fold(acc, lane):
+            return g1_add_jac(acc, lane[None]), None
+
+        total, _ = jax.lax.scan(fold, lanes[:1], lanes[1:])
+        aff, is_inf = g1_to_affine(total[0])
+        return fp.canon(aff), is_inf
+
+    return kernel
+
+
+def g1_msm_device_entry(setup, coeffs):
+    """Backend entry for ``kzg/scheme.py``'s commitment MSM:
+    sum_j coeffs[j] * setup.powers_g1[j] on device, returned as oracle
+    affine ints (or None) — bit-identical to the host Pippenger path
+    (``kzg/curve.py:g1_lincomb``), which tests pin on random blobs."""
+    scalars = [int(s) % oracle.R for s in coeffs]
+    n = len(scalars)
+    if n == 0 or n > setup.n:
+        raise ValueError(f"msm size {n} vs setup of {setup.n} powers")
+    enc, inf = setup.device_encoding()
+    nbits = oracle.R.bit_length()                  # 255, MSB first
+    bits = np.zeros((nbits, n), dtype=bool)
+    for j, s in enumerate(scalars):
+        for i in range(nbits):
+            if (s >> (nbits - 1 - i)) & 1:
+                bits[i, j] = True
+    aff, is_inf = _g1_msm_kernel(n)(
+        jnp.asarray(enc[:n]), jnp.asarray(inf[:n]), jnp.asarray(bits))
+    if bool(is_inf):
+        return None
+    a = np.asarray(aff)
+    return (fp.from_limbs(a[0]), fp.from_limbs(a[1]))

@@ -27,12 +27,15 @@ worker thread.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import math
 import socket
 import struct
 import threading
 import time
+
+import numpy as np
 
 # through the package __init__ (NOT das.server directly): the das
 # package controls its own submodule import order, which keeps the
@@ -66,6 +69,7 @@ METHOD_TIERS = {
     "lc_update": TIER_INTERACTIVE,
     "stats": TIER_INTERACTIVE,
     "das_cells": TIER_BULK,
+    "das_aggregate": TIER_BULK,
 }
 
 _LEN = struct.Struct(">I")
@@ -194,6 +198,7 @@ class ServeFront:
         self.brownout = brownout or BrownoutController()
         self.breaker = breaker or CircuitBreaker()
         self._threads: list[threading.Thread] = []
+        self._active_cfg = None      # captured at start(), see there
         self._conns: list[_Conn] = []
         self._conn_lock = threading.Lock()
         self._listener: socket.socket | None = None
@@ -220,6 +225,12 @@ class ServeFront:
     # -- lifecycle -------------------------------------------------------------
 
     def start(self) -> tuple[str, int]:
+        from pos_evolution_tpu.config import cfg
+        # capture the owning thread's active config: worker threads get
+        # their own thread-local, and scheme handlers that read cfg()
+        # (the kzg commit/aggregate geometry) must see the composition
+        # the front was started under, not the defaults
+        self._active_cfg = cfg()
         self.started_at = time.monotonic()
         lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -487,6 +498,13 @@ class ServeFront:
     # -- workers ---------------------------------------------------------------
 
     def _worker_loop(self, worker_id: int) -> None:
+        from pos_evolution_tpu.config import use_config
+        with contextlib.ExitStack() as stack:
+            if self._active_cfg is not None:
+                stack.enter_context(use_config(self._active_cfg))
+            self._worker_body(worker_id)
+
+    def _worker_body(self, worker_id: int) -> None:
         while not self._stopping.is_set():
             item = self.queue.take(timeout=0.25)
             if item is None:
@@ -524,9 +542,9 @@ class ServeFront:
             conn.reply({"id": req["id"], "status": "timeout"})
             return
         # the circuit breaker guards the BACKING STORE, so only the
-        # method that touches it consults it — head/finality answer
+        # methods that touch it consult it — head/finality answer
         # from the in-memory view even while the store is down
-        backed = method == "das_cells"
+        backed = method in ("das_cells", "das_aggregate")
         if backed:
             allowed, retry_s = self.breaker.allow()
             if not allowed:
@@ -635,16 +653,17 @@ class ServeFront:
                 fast[1][method] = (b',"status":"ok","result":' + enc
                                    + b',"served_by":-1}')
             return hit
+        if method == "das_aggregate":
+            return self._das_aggregate(view, params, expires_at)
         assert method == "das_cells"
         return self._das_cells(view, params, expires_at)
 
-    def _das_cells(self, view, params: dict, expires_at: float) -> dict:
+    def _parse_das_params(self, view, params: dict):
         try:
             root = bytes.fromhex(params["block_root"])
             samples = [(int(b), int(c)) for b, c in params["samples"]]
         except (KeyError, TypeError, ValueError) as e:
-            raise _BadRequest(f"malformed das_cells params: {e}") \
-                from None
+            raise _BadRequest(f"malformed das params: {e}") from None
         if len(samples) > MAX_SAMPLES_PER_REQUEST:
             # also bounds the RESPONSE size under the frame cap — a
             # huge sample list must be an honest refusal, not a reply
@@ -656,13 +675,58 @@ class ServeFront:
         if sidecars is None:
             raise _BadRequest(f"block {root.hex()[:16]} not in the "
                               f"serving window")
+        for blob, cell in samples:
+            if not (0 <= blob < len(sidecars) and 0 <= cell < view.n_cells):
+                raise _BadRequest(f"sample ({blob}, {cell}) outside the "
+                                  f"grid")
+        return root, samples, sidecars
+
+    def _das_aggregate(self, view, params: dict, expires_at: float) -> dict:
+        """One aggregated opening proof for the request's whole sampled
+        set (kzg-style schemes) — the response ships |proof| bytes total
+        instead of depth*32 bytes per sample."""
+        scheme = self.das.scheme
+        if not getattr(scheme, "aggregates", False):
+            raise _BadRequest(
+                f"scheme {scheme.name!r} serves per-cell branches; "
+                f"use das_cells")
+        root, samples, sidecars = self._parse_das_params(view, params)
+        # canonical coords: the proof covers the deduped sorted set (the
+        # transcript is order-sensitive, so server and client must agree)
+        coords = tuple(sorted(set(samples)))
+        if time.monotonic() >= expires_at:
+            raise _Expired()
+        if self.chaos is not None:
+            self.chaos.maybe_backing_fault()
+        proof = self.das.build_aggregate_proof(root, sidecars, coords)
+        grids = {b for b, _ in coords}
+        cells_out = [
+            bytes(np.ascontiguousarray(sidecars[b].cells,
+                                       dtype=np.uint8)[c]).hex()
+            for b, c in coords]
+        return {
+            "block_root": root.hex(),
+            "scheme": scheme.name,
+            "commitments": [bytes(sc.commitment).hex() for sc in sidecars],
+            "samples": [[int(b), int(c)] for b, c in coords],
+            "cells": cells_out,
+            "proof": [p.hex() for p in scheme.encode_proof(proof)],
+            "proof_bytes": int(scheme.proof_n_bytes(proof)),
+            "n_cells": int(view.n_cells),
+            "blobs_opened": len(grids),
+        }
+
+    def _das_cells(self, view, params: dict, expires_at: float) -> dict:
+        if getattr(self.das.scheme, "aggregates", False):
+            # an aggregate scheme has no per-cell branch walk to serve —
+            # honest refusal, not an AttributeError in a worker
+            raise _BadRequest(
+                f"scheme {self.das.scheme.name!r} serves aggregated "
+                f"proofs; use das_aggregate")
+        root, samples, sidecars = self._parse_das_params(view, params)
         cells_out, branches_out = [], []
         cache = self.das.proof_cache
         for blob, cell in samples:
-            if not (0 <= blob < len(sidecars)
-                    and 0 <= cell < view.n_cells):
-                raise _BadRequest(f"sample ({blob}, {cell}) outside the "
-                                  f"grid")
             hit = cache.get((root, blob, cell))
             if hit is _MISS:
                 # budget check before the (comparatively) expensive

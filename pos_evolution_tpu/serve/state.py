@@ -45,6 +45,9 @@ class ServeView:
     # ``.cells`` (n_cells, cell_bytes) and ``.commitment`` (32 bytes)
     sidecars: dict = field(default_factory=dict)
     n_cells: int = 0
+    # the cell-commitment scheme serving this window ("merkle"/"kzg"):
+    # remote clients pick das_cells vs das_aggregate from this
+    scheme: str = "merkle"
 
     def head_summary(self) -> dict:
         return {
@@ -59,6 +62,7 @@ class ServeView:
             # (serve/loadgen.discover_targets) instead of in-process
             # introspection (ISSUE 13 / ROADMAP item 3 remainder)
             "n_cells": int(self.n_cells),
+            "scheme": self.scheme,
             "das_blobs": {r.hex(): len(cars)
                           for r, cars in self.sidecars.items()},
         }

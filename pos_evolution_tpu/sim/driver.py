@@ -393,9 +393,11 @@ class Simulation:
         # the engine is passed again to ``resume`` (sidecar payloads are
         # seeded pure functions of the chain, so a resumed run
         # regenerates them bit-identically).
-        if das is True:
+        if das is True or isinstance(das, str):
+            # das="kzg"/"merkle" picks the cell-commitment scheme
+            # (das/commitment.py registry); True keeps the default
             from pos_evolution_tpu.das import BlobEngine
-            das = BlobEngine()
+            das = BlobEngine(scheme="merkle" if das is True else das)
         self.das = das
         self.blob_archive: dict[bytes, list] = {}
         self.das_server = None
@@ -1281,7 +1283,9 @@ class Simulation:
             finalized_root=bytes(store.finalized_checkpoint.root),
             update_ssz=update_ssz, update_root=update_root,
             sidecars=sidecars,
-            n_cells=2 * self.cfg.das_cells_per_blob))
+            n_cells=2 * self.cfg.das_cells_per_blob,
+            scheme=(self.das.scheme.name if self.das is not None
+                    else "merkle")))
 
     def flush_light_clients(self) -> None:
         """Serve one off-chain finality update for the serving group's

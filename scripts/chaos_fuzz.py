@@ -69,7 +69,8 @@ _D_DENSE, _D_SERVE = 14, 15
 
 def episode_config(seed: int, episode: int, n_validators: int = 64,
                    n_slots: int = 24, doctor: bool = False,
-                   variant: str = "gasper", serve: bool = False) -> dict:
+                   variant: str = "gasper", serve: bool = False,
+                   scheme: str = "merkle") -> dict:
     """Derive one episode's full composition from (seed, episode) alone
     (the protocol variant is part of the composition: every episode
     replays under the variant that produced it)."""
@@ -83,6 +84,11 @@ def episode_config(seed: int, episode: int, n_validators: int = 64,
         "n_slots": int(n_slots),
         "n_groups": 2,
         "variant": VARIANTS[variant]().describe(),
+        # cell-commitment scheme for the serve composition's DAS engine
+        # ("merkle"/"kzg") — part of the replayable composition, and of
+        # the checkpoint's engine.describe() fingerprint, so a resume
+        # under the other scheme refuses loudly
+        "scheme": str(scheme),
         "monitors": {"accountable_broadcast": True,
                      # a <1/3-Byzantine faulted run legitimately trails
                      # 2-3 epochs post-GST (see DESIGN.md §13); the bound
@@ -311,7 +317,8 @@ def run_episode(cfg: dict, events_path: str | None = None,
             sim = Simulation(cfg["n_validators"], schedule=schedule,
                              telemetry=telemetry, adversaries=adversaries,
                              monitors=monitors, variant=variant,
-                             das=True if serve_cfg else None,
+                             das=(cfg.get("scheme", "merkle")
+                                  if serve_cfg else None),
                              serve=serve_state)
             checkpoint = sim.checkpoint()
         if bundle_dir is not None:
@@ -420,7 +427,8 @@ _DENSE_SCENARIOS = ("equivocator_faulted", "withholder", "splitvoter",
 def episode_config_dense(seed: int, episode: int, n_validators: int = 576,
                          n_epochs: int = 4, slots_per_epoch: int = 8,
                          mesh: str | None = None, doctor: bool = False,
-                         scenario: str | None = None) -> dict:
+                         scenario: str | None = None,
+                         scheme: str = "merkle") -> dict:
     """One DENSE episode's composition from (seed, episode) alone: a
     scenario (which vectorized strategy + network shape), a seeded
     ``DenseFaultPlan``, and the expectation the verdict is judged
@@ -485,6 +493,10 @@ def episode_config_dense(seed: int, episode: int, n_validators: int = 576,
         "slots_per_epoch": int(slots_per_epoch),
         "n_groups": 2 if two_view else 1,
         "mesh": mesh, "scenario": scenario,
+        # recorded for composition completeness/replay parity with the
+        # serve episodes; dense sims carry no blob sidecars, so the
+        # cell-commitment scheme is inert here
+        "scheme": str(scheme),
         "faults": faults, "adversaries": adversaries,
         "monitors": {"bound_epochs": 2 if scenario == "balancer" else 4,
                      "parity_every": 2},
@@ -643,7 +655,7 @@ def _dense_expectations(cfg: dict, result: dict) -> dict:
 def fuzz_dense(episodes: int, seed: int, n_validators: int, n_epochs: int,
                out_dir: str, mesh: str | None = None, doctor: bool = False,
                step_timeout: float | None = None,
-               history: str | None = None) -> dict:
+               history: str | None = None, scheme: str = "merkle") -> dict:
     """The dense episode matrix: every episode is a sharded adversarial
     run with the full dense monitor stack; bundles are replayable via
     ``--replay`` exactly like spec bundles."""
@@ -660,7 +672,7 @@ def fuzz_dense(episodes: int, seed: int, n_validators: int, n_epochs: int,
     n_blocks = n_slots_total = n_violations = 0
     for ep in range(episodes):
         cfg = episode_config_dense(seed, ep, n_validators, n_epochs,
-                                   mesh=mesh, doctor=doctor)
+                                   mesh=mesh, doctor=doctor, scheme=scheme)
         inflight = os.path.join(out_dir, f"inflight_ep{ep}")
         result = wd.step(f"dense_episode_{ep}", run_dense_episode, cfg,
                          bundle_dir=inflight)
@@ -859,7 +871,8 @@ def replay_bundle(bundle: str) -> dict:
 def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
          out_dir: str, doctor: bool = False, do_shrink: bool = True,
          step_timeout: float | None = None, episode_indices=None,
-         variant: str = "gasper", serve: bool = False) -> dict:
+         variant: str = "gasper", serve: bool = False,
+         scheme: str = "merkle") -> dict:
     from pos_evolution_tpu.utils.watchdog import Watchdog
     os.makedirs(out_dir, exist_ok=True)
     wd = Watchdog(path=os.path.join(out_dir, "chaos_partial.json"),
@@ -870,7 +883,7 @@ def fuzz(episodes: int, seed: int, n_validators: int, n_slots: int,
                else episode_indices)
     for ep in indices:
         cfg = episode_config(seed, ep, n_validators, n_slots, doctor=doctor,
-                             variant=variant, serve=serve)
+                             variant=variant, serve=serve, scheme=scheme)
         # incremental flush (ISSUE 10): config + start checkpoint +
         # streamed events land in an inflight dir BEFORE the run, so a
         # crashed/killed episode leaves a --resume-bundle artifact
@@ -983,6 +996,11 @@ def main(argv=None) -> int:
                          "open-loop loadgen to every episode; the "
                          "SLO/goodput outcome joins the verdict and a "
                          "wrong served proof fails the episode")
+    ap.add_argument("--scheme", choices=("merkle", "kzg"), default="merkle",
+                    help="cell-commitment scheme for serve episodes' DAS "
+                         "engine (DESIGN.md §23); recorded in every "
+                         "episode composition and checkpoint fingerprint "
+                         "so cross-scheme resume refuses loudly")
     ap.add_argument("--serve-mp", action="store_true",
                     help="run the MULTI-PROCESS serving chaos scenario "
                          "instead of episodes: a supervised worker pool "
@@ -1031,7 +1049,7 @@ def main(argv=None) -> int:
                              args.dense_epochs, args.out, mesh=args.mesh,
                              doctor=args.doctor,
                              step_timeout=args.step_timeout,
-                             history=args.history)
+                             history=args.history, scheme=args.scheme)
         print(json.dumps({k: summary[k] for k in
                           ("mode", "episodes", "violating", "accountable",
                            "incidents", "scenarios", "run_s")}, indent=1))
@@ -1065,7 +1083,7 @@ def main(argv=None) -> int:
                            args.slots, out_dir, doctor=args.doctor,
                            do_shrink=not args.no_shrink,
                            step_timeout=args.step_timeout, variant=name,
-                           serve=args.serve)
+                           serve=args.serve, scheme=args.scheme)
             keys = ["variant", "episodes", "violating", "accountable",
                     "incidents"]
             row = {k: summary[k] for k in keys}

@@ -11,13 +11,22 @@ is one ``ExecutionBackend`` batch kernel per served block.
 Usage:
     python scripts/das_demo.py [--clients 100000] [--epochs 3]
         [--validators 64] [--samples N] [--backend numpy|jax]
-        [--events events.jsonl] [--json bench_das.json]
-        [--history bench_history.jsonl] [--seed 3]
+        [--scheme merkle|kzg] [--events events.jsonl]
+        [--json bench_das.json] [--history bench_history.jsonl]
+        [--seed 3]
+
+``--scheme kzg`` swaps the cell commitments to the pairing-backed
+``KzgCellScheme`` (kzg/, DESIGN.md §23): the population is answered by
+ONE aggregated opening proof per served block instead of per-cell
+merkle branches, and the emission becomes ``bench_kzg`` (gated by
+``scripts/perf_gate.py --history --kind bench_kzg``) with the served
+proof-bytes-per-sample cut asserted against the 128-byte merkle
+baseline.
 
 ``--events`` records the run for ``scripts/run_report.py`` (the "DAS
-serving" section); ``--json`` writes a ``bench_das`` emission
-(telemetry counts + serving latency summary) and ``--history`` appends
-it to a ``profiling/history.py`` time-series so
+serving" section); ``--json`` writes a ``bench_das``/``bench_kzg``
+emission (telemetry counts + serving latency summary) and ``--history``
+appends it to a ``profiling/history.py`` time-series so
 ``scripts/perf_gate.py --history --kind bench_das`` bands it.
 """
 
@@ -43,6 +52,9 @@ def main(argv=None) -> int:
                     help="samples per client per block "
                          "(default: cfg.das_samples_per_client)")
     ap.add_argument("--backend", choices=("numpy", "jax"), default="numpy")
+    ap.add_argument("--scheme", choices=("merkle", "kzg"), default="merkle",
+                    help="cell-commitment scheme (kzg = aggregated "
+                         "multiproofs, one opening per served block)")
     ap.add_argument("--seed", type=int, default=3)
     ap.add_argument("--events", help="telemetry JSONL output path")
     ap.add_argument("--json", help="write the bench_das emission here")
@@ -64,8 +76,10 @@ def main(argv=None) -> int:
         telemetry.install_jax_runtime()
 
         print(f"== DAS serving demo: {args.clients} sampling clients, "
-              f"{args.validators} validators, backend={args.backend} ==")
-        sim = Simulation(args.validators, das=True, telemetry=telemetry)
+              f"{args.validators} validators, backend={args.backend}, "
+              f"scheme={args.scheme} ==")
+        sim = Simulation(args.validators, das=args.scheme,
+                         telemetry=telemetry)
         sim.attach_das_clients(args.clients,
                                samples_per_client=args.samples,
                                seed=args.seed)
@@ -103,8 +117,26 @@ def main(argv=None) -> int:
         assert failures == 0, "honest chain must verify clean"
         assert serves[-1]["clients_all_ok"] == args.clients
 
+        # proof-bytes accounting (both schemes emit it; the kzg run
+        # asserts the aggregate's cut against the merkle baseline)
+        proof_bytes = sum(e.get("proof_bytes", 0) for e in serves)
+        bytes_per_sample = proof_bytes / max(total_samples, 1)
+        merkle_depth = max(int(2 * c.das_cells_per_blob - 1).bit_length(), 0)
+        merkle_bps = float(merkle_depth * 32)
+        print(f"served proof bytes/sample: {bytes_per_sample:.4f} "
+              f"(merkle branch baseline: {merkle_bps:.0f})")
+        if args.scheme == "kzg":
+            assert all(e.get("aggregated") for e in serves), \
+                "kzg serves must be aggregated"
+            assert bytes_per_sample * 4 <= merkle_bps, (
+                f"aggregated proofs must cut served bytes/sample >= 4x vs "
+                f"merkle ({bytes_per_sample:.4f} vs {merkle_bps:.0f})")
+
         emission = {
-            "metric": "bench_das",
+            "metric": "bench_das" if args.scheme == "merkle" else "bench_kzg",
+            "scheme": args.scheme,
+            "proof_bytes_per_sample": round(bytes_per_sample, 4),
+            "merkle_bytes_per_sample": merkle_bps,
             "backend": args.backend,
             "clients": args.clients,
             "validators": args.validators,
@@ -137,8 +169,9 @@ def main(argv=None) -> int:
             print(f"record   -> {path}")
         if args.history:
             from pos_evolution_tpu.profiling import history
-            history.append_entry(args.history, emission, kind="bench_das")
-            print(f"history  -> {args.history} (kind=bench_das)")
+            kind = emission["metric"]
+            history.append_entry(args.history, emission, kind=kind)
+            print(f"history  -> {args.history} (kind={kind})")
         if args.events:
             telemetry.close()
             print(f"events   -> {args.events}\n  next: "

@@ -257,8 +257,17 @@ def build_report(events: list[dict], top_ops: dict | None = None,
             "p50_ms": round(_percentile(p50s, 50), 4),
             "p95_ms": round(_percentile(p95s, 50), 4),
             "worst_p95_ms": round(max(p95s), 4) if p95s else None,
-            "scheme": (attach.get("engine") or {}).get("scheme"),
+            "scheme": (das_events[-1].get("scheme")
+                       or (attach.get("engine") or {}).get("scheme")),
+            "aggregated": das_events[-1].get("aggregated"),
         }
+        # served proof bytes per sample: the aggregation win (kzg serves
+        # one multiproof per block; merkle serves a branch per cell)
+        proof_bytes = sum(e.get("proof_bytes", 0) for e in das_events)
+        if proof_bytes and das_serving["samples_total"]:
+            das_serving["proof_bytes_total"] = proof_bytes
+            das_serving["proof_bytes_per_sample"] = round(
+                proof_bytes / das_serving["samples_total"], 4)
 
     # -- serving (serve/ RPC tier: serve_attach + serve_summary events) -------
     serve_events = by_type.get("serve_summary", [])
@@ -795,6 +804,11 @@ def to_markdown(report: dict) -> str:
         if d.get("cache_hit_rate") is not None:
             md.append(f"- proof-path cache hit rate: "
                       f"**{d['cache_hit_rate']:.1%}**")
+        if d.get("proof_bytes_per_sample") is not None:
+            agg = " (one aggregated multiproof per served block)" \
+                if d.get("aggregated") else ""
+            md.append(f"- served proof bytes/sample: "
+                      f"**{d['proof_bytes_per_sample']}**{agg}")
         md.append(f"- sample verification failures: {d['verify_failures']} "
                   f"(clients fully satisfied at last serve: "
                   f"{d['clients_all_ok_final']})")

@@ -1,0 +1,459 @@
+"""KZG polynomial-commitment subsystem tests (kzg/, DESIGN.md §23).
+
+Pins, in order: the Fr Montgomery engine against the pure-int oracle
+(host AND jitted device twin, bit-identical), the batched NTT/INTT
+(roundtrip, evaluation-on-domain oracle, host<->device identity, the
+backend seam's stats), the G1 commitment path (naive-MSM oracle, wire
+binding, engine-wide memo), single-blob cell proofs with corrupted-cell
+/ wrong-commitment / malformed-proof rejection, the aggregated
+committee multiproof with its forged-cell soundness negatives, the
+``hash_to_g2`` disk cache knob, the DasServer aggregate serving path
+(one pairing verdict per served block, proof-bytes accounting, cache
+reuse, corruption attribution), and the checkpoint/resume scheme
+fingerprint refusal.  The device commitment MSM differential is
+``slow``-marked: its one-time XLA CPU compile dominates (~4 min), the
+not-slow NTT differential carries the tier-1 host<->device bit.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pos_evolution_tpu.crypto import bls12_381 as bls
+from pos_evolution_tpu.kzg import aggregate, curve, fr, ntt
+from pos_evolution_tpu.kzg.scheme import KzgCellScheme
+from pos_evolution_tpu.kzg.setup import trusted_setup
+
+pytestmark = pytest.mark.usefixtures("minimal_cfg")
+
+R = fr.MODULUS
+
+
+def _rand_ints(rng, n):
+    return [int.from_bytes(rng.bytes(32), "little") % R for _ in range(n)]
+
+
+# --- Fr Montgomery engine -----------------------------------------------------
+
+class TestFrField:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(0)
+        xs = _rand_ints(rng, 64) + [0, 1, R - 1]
+        assert fr.decode(fr.encode(xs)) == xs
+
+    def test_host_ops_match_int_oracle(self):
+        rng = np.random.default_rng(1)
+        xs, ys = _rand_ints(rng, 32), _rand_ints(rng, 32)
+        a, b = fr.encode(xs), fr.encode(ys)
+        assert fr.decode(fr.mont_mul(a, b)) == \
+            [x * y % R for x, y in zip(xs, ys)]
+        assert fr.decode(fr.mont_add(a, b)) == \
+            [(x + y) % R for x, y in zip(xs, ys)]
+        assert fr.decode(fr.mont_sub(a, b)) == \
+            [(x - y) % R for x, y in zip(xs, ys)]
+        assert fr.decode(fr.mont_neg(a)) == [(-x) % R for x in xs]
+
+    def test_batch_inv_matches_fermat(self):
+        rng = np.random.default_rng(2)
+        xs = _rand_ints(rng, 16)
+        xs = [x or 1 for x in xs]
+        inv = fr.decode(fr.batch_inv(fr.encode(xs)))
+        assert inv == [pow(x, R - 2, R) for x in xs]
+        assert all(x * v % R == 1 for x, v in zip(xs, inv))
+
+    def test_device_twin_bit_identical(self):
+        """Every device field op reproduces the host limbs digit for
+        digit on randomized lazy-domain inputs."""
+        jax = pytest.importorskip("jax")
+        jnp = jax.numpy
+        dev = fr.device_ops()
+        rng = np.random.default_rng(3)
+        xs, ys = _rand_ints(rng, 24), _rand_ints(rng, 24)
+        a, b = fr.encode(xs), fr.encode(ys)
+        aj = jnp.asarray(a.astype(np.int32))
+        bj = jnp.asarray(b.astype(np.int32))
+        for name, host in (("mul", fr.mont_mul), ("add", fr.mont_add),
+                           ("sub", fr.mont_sub)):
+            got = np.asarray(dev[name](aj, bj)).astype(np.int64)
+            np.testing.assert_array_equal(got, host(a, b), err_msg=name)
+        got_canon = np.asarray(dev["canon"](aj)).astype(np.int64)
+        np.testing.assert_array_equal(got_canon, fr.mont_canon(a))
+        inv = np.asarray(dev["inv"](aj)).astype(np.int64)
+        assert fr.decode(fr.mont_canon(inv)) == \
+            [pow(x, R - 2, R) for x in xs]
+
+
+# --- NTT ----------------------------------------------------------------------
+
+class TestNtt:
+    @pytest.mark.parametrize("n", [1, 2, 8, 64])
+    def test_roundtrip(self, n):
+        rng = np.random.default_rng(n)
+        xs = _rand_ints(rng, n)
+        enc = fr.encode(xs)
+        back = ntt.ntt_host(ntt.ntt_host(enc), inverse=True)
+        assert fr.decode(back) == xs
+
+    def test_forward_is_evaluation_on_domain(self):
+        """The convention every consumer relies on: forward NTT of
+        coefficients = evaluations at domain(n)[i], pure-int oracle."""
+        n = 16
+        rng = np.random.default_rng(7)
+        coeffs = _rand_ints(rng, n)
+        evals = fr.decode(ntt.ntt_host(fr.encode(coeffs)))
+        dom = ntt.domain(n)
+        for i in (0, 1, 5, n - 1):
+            want = sum(c * pow(dom[i], j, R) for j, c in enumerate(coeffs))
+            assert evals[i] == want % R
+
+    def test_host_device_bit_identical(self):
+        pytest.importorskip("jax")
+        rng = np.random.default_rng(11)
+        for n in (8, 64):
+            enc = fr.encode(_rand_ints(rng, n))
+            for inverse in (False, True):
+                h = ntt.ntt_host(enc, inverse)
+                d = ntt.ntt_device(enc, inverse)
+                np.testing.assert_array_equal(d, h,
+                                              err_msg=f"n={n} inv={inverse}")
+
+    def test_backend_seam_and_stats(self):
+        from pos_evolution_tpu.backend import set_backend
+        rng = np.random.default_rng(13)
+        enc = fr.encode(_rand_ints(rng, 8))
+        ntt.reset_stats()
+        try:
+            set_backend("numpy")
+            out_h = ntt.ntt(enc)
+            assert ntt.stats()["host_ntts"] == 1
+            set_backend("jax")
+            out_d = ntt.ntt(enc)
+            s = ntt.stats()
+            assert s["device_ntts"] + s["fallback_host"] == 1
+            np.testing.assert_array_equal(out_d, out_h)
+        finally:
+            set_backend("numpy")
+            ntt.reset_stats()
+
+
+# --- commitment path ----------------------------------------------------------
+
+class TestCommit:
+    def test_lincomb_matches_naive_oracle(self):
+        setup = trusted_setup(8, seed=5)
+        rng = np.random.default_rng(17)
+        scalars = _rand_ints(rng, 8)
+        got = curve.g1_lincomb(setup.powers_g1, scalars)
+        acc = None
+        for p, s in zip(setup.powers_g1, scalars):
+            acc = bls.ec_add(acc, bls.ec_mul(p, s))
+        assert got == acc
+        assert curve.g1_lincomb(setup.powers_g1, [0] * 8) is None
+
+    def test_setup_is_deterministic_and_on_curve(self):
+        a = trusted_setup(4, seed=9)
+        b = trusted_setup(4, seed=9)
+        assert a.powers_g1 == b.powers_g1
+        assert trusted_setup(4, seed=10).powers_g1 != a.powers_g1
+        assert all(bls.g1_on_curve(p) for p in a.powers_g1)
+
+    def test_commit_wire_binding_and_memo(self):
+        from pos_evolution_tpu.config import cfg
+        s = KzgCellScheme()
+        n_cells, m, _n = s.geometry()
+        rng = np.random.default_rng(19)
+        grid = rng.integers(0, 256, (n_cells, cfg().das_cell_bytes),
+                            dtype=np.uint8)
+        point, comp, coeffs, wire = s.commit_full(grid)
+        assert s.commit(grid) == wire == s.wire_bind(comp)
+        assert len(wire) == 32 and len(comp) == 48
+        assert bls.g1_decompress(comp) == point
+        assert len(s._memo) == 1          # second commit hit the memo
+        # the evaluations really are the blob bytes: decode via INTT
+        evals = fr.decode(ntt.ntt_host(fr.encode(list(coeffs))))
+        assert evals[0] == s.cell_values(grid[0])[0]
+
+
+# --- single-blob proofs (CellCommitmentScheme contract) -----------------------
+
+class TestCellProofs:
+    @pytest.fixture()
+    def blob(self):
+        from pos_evolution_tpu.config import cfg
+        s = KzgCellScheme()
+        n_cells, _m, _n = s.geometry()
+        rng = np.random.default_rng(23)
+        grid = rng.integers(0, 256, (n_cells, cfg().das_cell_bytes),
+                            dtype=np.uint8)
+        return s, grid, s.commit(grid)
+
+    def test_honest_proof_verifies(self, blob):
+        s, grid, wire = blob
+        idx = [0, 3, 7]
+        proof = s.prove_cells(grid, idx)
+        assert s.verify_cells(wire, grid[idx], idx, proof)
+
+    def test_corrupted_cell_rejected(self, blob):
+        s, grid, wire = blob
+        idx = [0, 3, 7]
+        proof = s.prove_cells(grid, idx)
+        bad = grid[idx].copy()
+        bad[1, 0] ^= 0x01
+        assert not s.verify_cells(wire, bad, idx, proof)
+
+    def test_wrong_commitment_rejected(self, blob):
+        s, grid, wire = blob
+        idx = [2, 5]
+        proof = s.prove_cells(grid, idx)
+        assert not s.verify_cells(b"\x00" * 32, grid[idx], idx, proof)
+
+    def test_malformed_proof_rejected(self, blob):
+        s, grid, wire = blob
+        idx = [1]
+        proof = s.prove_cells(grid, idx)
+        assert not s.verify_cells(wire, grid[idx], idx, [])
+        assert not s.verify_cells(wire, grid[idx], idx,
+                                  [b"not-the-tag"] + proof[1:])
+        garbled = proof[:-1] + [b"\xff" * 48]
+        assert not s.verify_cells(wire, grid[idx], idx, garbled)
+
+
+# --- aggregated committee multiproofs -----------------------------------------
+
+class TestAggregate:
+    @pytest.fixture()
+    def committee(self):
+        from pos_evolution_tpu.config import cfg
+        s = KzgCellScheme()
+        n_cells, _m, _n = s.geometry()
+        rng = np.random.default_rng(29)
+        grids = [rng.integers(0, 256, (n_cells, cfg().das_cell_bytes),
+                              dtype=np.uint8) for _ in range(2)]
+        wires = [s.commit(g) for g in grids]
+        samples = [(0, 0), (0, 5), (1, 2), (1, n_cells - 1)]
+        cells = [grids[b][c] for b, c in samples]
+        proof = s.prove_aggregate(grids, samples)
+        return s, grids, wires, samples, cells, proof
+
+    def test_honest_aggregate_verifies(self, committee):
+        s, grids, wires, samples, cells, proof = committee
+        assert s.verify_aggregate(wires, samples, cells, proof)
+        # the aggregation win itself: one opening for the whole set
+        assert s.proof_n_bytes(proof) == 48 * (len(grids) + 2)
+
+    def test_forged_cell_in_aggregate_rejected(self, committee):
+        """The soundness bit: an attacker serving one corrupted cell
+        inside an otherwise-honest aggregate cannot pass the pairing
+        check, whichever cell it is."""
+        s, grids, wires, samples, cells, proof = committee
+        for j in range(len(cells)):
+            forged = [c.copy() for c in cells]
+            forged[j] = forged[j].copy()
+            forged[j][0] ^= 0xA5
+            assert not s.verify_aggregate(wires, samples, forged, proof)
+
+    def test_swapped_samples_rejected(self, committee):
+        s, grids, wires, samples, cells, proof = committee
+        swapped = [samples[1], samples[0]] + samples[2:]
+        assert not s.verify_aggregate(wires, swapped, cells, proof)
+
+    def test_tampered_proof_points_rejected(self, committee):
+        s, grids, wires, samples, cells, proof = committee
+        for key in ("w", "wp"):
+            bad = dict(proof)
+            bad[key] = bytes(proof["points"][0])
+            assert not s.verify_aggregate(wires, samples, cells, bad)
+        bad = dict(proof)
+        bad["points"] = list(proof["points"][::-1])
+        assert not s.verify_aggregate(wires, samples, cells, bad)
+
+    def test_wrong_wire_commitment_rejected(self, committee):
+        s, grids, wires, samples, cells, proof = committee
+        assert not s.verify_aggregate([wires[1], wires[0]], samples,
+                                      cells, proof)
+
+    def test_proof_encoding_roundtrip(self, committee):
+        s, grids, wires, samples, cells, proof = committee
+        parts = s.encode_proof(proof)
+        assert s.decode_proof(parts) == proof
+        with pytest.raises(ValueError):
+            s.decode_proof(parts[1:])
+
+
+# --- device commitment MSM (compile-dominated differential) -------------------
+
+@pytest.mark.slow
+class TestDeviceMsm:
+    def test_commit_host_device_bit_identical(self):
+        pytest.importorskip("jax")
+        from pos_evolution_tpu.backend import set_backend
+        from pos_evolution_tpu.config import cfg
+        rng = np.random.default_rng(31)
+        s = KzgCellScheme()
+        n_cells, _m, _n = s.geometry()
+        grids = [rng.integers(0, 256, (n_cells, cfg().das_cell_bytes),
+                              dtype=np.uint8) for _ in range(2)]
+        try:
+            set_backend("numpy")
+            host = [KzgCellScheme().commit(g) for g in grids]
+            set_backend("jax")
+            dev = [KzgCellScheme().commit(g) for g in grids]
+        finally:
+            set_backend("numpy")
+        assert host == dev
+
+
+# --- hash_to_g2 disk cache (POS_G2_CACHE_DIR knob) ----------------------------
+
+class TestG2DiskCache:
+    def test_cache_hit_corruption_and_dst_keying(self, tmp_path,
+                                                 monkeypatch):
+        msg = b"g2-cache-test"
+        ref = bls.hash_to_g2(msg)            # knob unset: no disk IO
+        assert not list(tmp_path.iterdir())
+        monkeypatch.setenv("POS_G2_CACHE_DIR", str(tmp_path))
+        assert bls.hash_to_g2(msg) == ref    # miss -> compute + store
+        files = list(tmp_path.iterdir())
+        assert len(files) == 1 and files[0].suffix == ".bin"
+        assert bls.hash_to_g2(msg) == ref    # hit -> loaded point
+        # dst participates in both the hash derivation and the cache key
+        other = bls.hash_to_g2(msg, dst=b"other-dst")
+        assert other != ref and len(list(tmp_path.iterdir())) == 2
+        # corruption fails closed into recomputation
+        for f in tmp_path.iterdir():
+            f.write_bytes(b"\x00" * 192)
+        assert bls.hash_to_g2(msg) == ref
+        for f in tmp_path.iterdir():
+            f.write_bytes(b"short")
+        assert bls.hash_to_g2(msg, dst=b"other-dst") == other
+
+
+# --- aggregate serving (DasServer + checkpoint fingerprint) -------------------
+
+class TestKzgServing:
+    def test_serve_samples_aggregate_path(self):
+        from pos_evolution_tpu.das import (
+            BlobEngine,
+            DasServer,
+            SamplingClientPopulation,
+        )
+        from pos_evolution_tpu.telemetry.registry import MetricsRegistry
+        eng = BlobEngine(scheme="kzg", seed=4)
+        grids, coms, _ = eng.build_for(2, b"\x07" * 32)
+
+        class _FakeSidecar:
+            def __init__(self, cells, commitment):
+                self.cells = cells
+                self.commitment = commitment
+
+        sidecars = [_FakeSidecar(g, co) for g, co in zip(grids, coms)]
+        registry = MetricsRegistry()
+        server = DasServer(eng.scheme, registry=registry)
+        pop = SamplingClientPopulation(500, samples_per_client=4, seed=1)
+        s1 = server.serve_samples(b"\x07" * 32, sidecars, pop)
+        assert s1["scheme"] == "kzg" and s1["aggregated"]
+        assert s1["failed"] == 0 and s1["clients_all_ok"] == 500
+        assert s1["samples"] == 2000
+        # ONE aggregate proof for the whole block's sampled set, so the
+        # per-sample wire cost collapses vs the 128-byte merkle branch
+        assert s1["proof_bytes"] == s1["proof_bytes_per_sample"] * 2000
+        assert s1["proof_bytes_per_sample"] * 4 <= 128
+        # same block again: the aggregate comes straight from the cache
+        s2 = server.serve_samples(b"\x07" * 32, sidecars, pop)
+        assert s2["cache_hits"] > 0 and s2["failed"] == 0
+        counts = registry.counts()
+        assert counts["das_aggregate_proofs_total"] >= 1
+        assert counts["das_aggregate_proof_bytes_total"] >= s1["proof_bytes"]
+        # a corrupted served cell fails the single pairing verdict and
+        # is attributed to every sampling client
+        bad = np.asarray(grids[0]).copy()
+        bad[:, 0] ^= 0xFF
+        sidecars[0].cells = bad
+        s3 = DasServer(eng.scheme, registry=registry).serve_samples(
+            b"\x08" * 32, sidecars, pop)
+        assert s3["failed"] > 0 and s3["clients_all_ok"] == 0
+
+    def test_das_aggregate_rpc_and_loadgen_verify(self):
+        from pos_evolution_tpu.config import cfg
+        from pos_evolution_tpu.das import BlobEngine
+        from pos_evolution_tpu.serve import (
+            ServeClient,
+            ServeFront,
+            ServeView,
+            ServingState,
+        )
+        from pos_evolution_tpu.serve.loadgen import LoadGenerator
+        from pos_evolution_tpu.telemetry.registry import MetricsRegistry
+        eng = BlobEngine(scheme="kzg", seed=4)
+        root = b"\x07" * 32
+        grids, coms, _ = eng.build_for(2, root)
+
+        class _Sidecar:
+            def __init__(self, cells, commitment):
+                self.cells, self.commitment = cells, commitment
+
+        view = ServeView(
+            slot=2, head_root=root, head_slot=2,
+            justified_epoch=0, justified_root=b"\x00" * 32,
+            finalized_epoch=0, finalized_root=b"\x00" * 32,
+            update_ssz=b"\x01\x02", update_root=b"\x03" * 32,
+            sidecars={root: [_Sidecar(g, c)
+                             for g, c in zip(grids, coms)]},
+            n_cells=2 * cfg().das_cells_per_blob, scheme="kzg")
+        state = ServingState()
+        state.publish(view)
+        front = ServeFront(state, scheme=eng.scheme,
+                           registry=MetricsRegistry(), workers=2)
+        addr = front.start()
+        try:
+            cli = ServeClient(addr, connections=2)
+            # the head summary advertises the scheme: remote loadgen
+            # clients pick das_aggregate vs das_cells from it
+            head = cli.request("head", deadline_s=2.0)
+            assert head.ok and head.result["scheme"] == "kzg"
+            res = cli.request("das_aggregate", {
+                "block_root": root.hex(),
+                "samples": [[0, 1], [1, 3], [0, 1], [1, 15]]},
+                deadline_s=5.0)
+            assert res.ok, res.error
+            r = res.result
+            assert r["scheme"] == "kzg" and r["blobs_opened"] == 2
+            assert r["samples"] == [[0, 1], [1, 3], [1, 15]]  # canonical
+            assert r["proof_bytes"] == 48 * 4
+            lg = LoadGenerator.__new__(LoadGenerator)
+            lg._agg_memo = {}
+            assert lg._verify_agg_many([r]) == (1, 0)
+            # a tampered served cell fails the client-side pairing check
+            forged = dict(r)
+            forged["cells"] = list(r["cells"])
+            forged["cells"][0] = bytes(
+                b ^ 0xA5 for b in bytes.fromhex(r["cells"][0])).hex()
+            assert lg._verify_agg_many([forged]) == (0, 1)
+            # per-cell branch method is honestly refused on a kzg view
+            cells_res = cli.request("das_cells", {
+                "block_root": root.hex(), "samples": [[0, 1]]},
+                deadline_s=2.0)
+            assert cells_res.status == "error"
+            assert "das_aggregate" in cells_res.error
+            cli.close()
+        finally:
+            front.stop()
+
+    def test_resume_refuses_scheme_mismatch(self):
+        from pos_evolution_tpu.das import BlobEngine
+        from pos_evolution_tpu.sim import Simulation
+        sim = Simulation(16, das=BlobEngine(n_blobs=1, scheme="kzg"))
+        sim.run_until_slot(3)
+        assert sim.das.describe()["scheme"] == "kzg"
+        blob = sim.checkpoint()
+        # the scheme name is part of the engine fingerprint: resuming a
+        # kzg chain with a merkle engine must refuse loudly
+        with pytest.raises(ValueError, match="does not match"):
+            Simulation.resume(blob, das=BlobEngine(n_blobs=1,
+                                                   scheme="merkle"))
+        twin = Simulation.resume(blob, das=sim.das)
+        twin.run_until_slot(5)
+        sim.run_until_slot(5)
+        from pos_evolution_tpu.specs import forkchoice as fc
+        assert fc.get_head(twin.store()) == fc.get_head(sim.store())
