@@ -39,6 +39,12 @@ STATELESS_DECISION = (
     "pos_evolution_tpu/ops/*.py",
     "pos_evolution_tpu/variants/*.py",
     "pos_evolution_tpu/ssz/*.py",
+    # ISSUE 18: the trace-sampling decision (sample/trace_id) must be a
+    # pure function of (seed, request ordinal) — a wall-clock or RNG
+    # leak here would desynchronize client and server span identities.
+    # Span *recording* timestamps legitimately read the clock, which is
+    # exactly the "decision" (not "strict") contract.
+    "pos_evolution_tpu/telemetry/tracing.py",
 )
 
 # PEV003: modules whose loops are per-slot / per-message hot paths where

@@ -30,7 +30,13 @@ from pos_evolution_tpu.profiling.attribution import (
     ProfiledRegion,
     attribute_to_spans,
     group_by_jit,
+    group_by_shard_map,
     innermost_jit,
+)
+from pos_evolution_tpu.profiling.phases import (
+    DENSE_PHASES,
+    NULL_TIMER,
+    PhaseTimer,
 )
 from pos_evolution_tpu.profiling.history import (
     HISTORY_SCHEMA_VERSION,
@@ -48,7 +54,9 @@ from pos_evolution_tpu.profiling.xplane import (
 )
 
 __all__ = [
-    "ProfiledRegion", "attribute_to_spans", "group_by_jit", "innermost_jit",
+    "ProfiledRegion", "attribute_to_spans", "group_by_jit",
+    "group_by_shard_map", "innermost_jit",
+    "PhaseTimer", "NULL_TIMER", "DENSE_PHASES",
     "HISTORY_SCHEMA_VERSION", "append_entry", "band_verdicts",
     "read_history", "robust_band",
     "encode_xspace", "parse_xspace", "summarize_path", "summarize_xplane",

@@ -97,6 +97,67 @@ def group_by_jit(planes, device_only: bool = True,
     return out
 
 
+# shard_map regions show up in op scope paths either as a literal
+# ``shard_map`` frame or as the traced body's synthesized jit frame
+# (``jit(shmap_body)`` / ``shmap_body``), depending on the JAX version
+# and whether the body was a named function.
+_SHMAP_MARKERS = ("shard_map", "shmap_body")
+
+
+def shard_map_region(op_name: str) -> str | None:
+    """The attribution key for an op dispatched from inside a
+    ``shard_map`` region: ``<enclosing jit>/shard_map`` (or bare
+    ``shard_map`` when unjitted), None for ops outside any region. The
+    enclosing-jit prefix keeps two shard_map call sites (the vote pass
+    vs the epoch sweep) distinct in the table."""
+    frames = op_frames(op_name)
+    marker_at = next((i for i, f in enumerate(frames)
+                      if any(m in f for m in _SHMAP_MARKERS)), None)
+    if marker_at is None:
+        return None
+    jits = _JIT_RE.findall("/".join(frames[:marker_at]))
+    # the body's own synthesized jit(shmap_body) frame is the marker,
+    # not the region's caller — filter marker-ish names out
+    jits = [j for j in jits if not any(m in j for m in _SHMAP_MARKERS)]
+    outer = jits[-1] if jits else None
+    return f"{outer}/shard_map" if outer else "shard_map"
+
+
+def group_by_shard_map(planes, device_only: bool = True,
+                       exclude_ops=frozenset()) -> dict[str, dict]:
+    """Aggregate a ``parse_xspace`` result by ``shard_map`` region:
+    ``{region: {"total_ms", "count", "ops": {op: [ms, count]}}}``, with
+    every op outside a region under ``"unsharded"``. The table is a
+    partition of the (filtered) trace, same contract as
+    ``group_by_jit`` — region time vs unsharded time sums to the trace
+    total, so the sharded share of an epoch is one division away."""
+    chosen = xplane.select_planes(planes, device_only)
+    out: dict[str, dict] = {}
+    key_of: dict[str, str] = {}
+    for _, _, op, _, dur in xplane.iter_ops(chosen):
+        key = key_of.get(op)
+        if key is None:
+            if is_python_frame(op) or op in exclude_ops:
+                key_of[op] = ""
+                continue
+            key = key_of[op] = shard_map_region(op) or "unsharded"
+        elif not key:
+            continue
+        row = out.setdefault(key, {"total_ms": 0.0, "count": 0, "ops": {}})
+        ms = dur / 1e9
+        row["total_ms"] += ms
+        row["count"] += 1
+        cell = row["ops"].setdefault(op, [0.0, 0])
+        cell[0] += ms
+        cell[1] += 1
+    for row in out.values():
+        row["total_ms"] = round(row["total_ms"], 4)
+        row["ops"] = {k: [round(v[0], 4), v[1]]
+                      for k, v in sorted(row["ops"].items(),
+                                         key=lambda kv: -kv[1][0])}
+    return out
+
+
 def attribute_to_spans(planes, span_names, device_only: bool = True,
                        exclude_ops=frozenset()) -> dict:
     """Fold device op time onto telemetry span / region names.
@@ -181,6 +242,7 @@ class ProfiledRegion:
         self.planes: list[dict] = []
         self.top_ops: dict = {}
         self.by_jit: dict = {}
+        self.by_shard_map: dict = {}
         self.attribution: dict = {}
         self.error: str | None = None
         self._bus_mark = 0
@@ -239,6 +301,8 @@ class ProfiledRegion:
                     # view keeps it: there it reads as a total, not work)
                     self.by_jit = group_by_jit(self.planes,
                                                exclude_ops={self.name})
+                    self.by_shard_map = group_by_shard_map(
+                        self.planes, exclude_ops={self.name})
                     self.attribution = attribute_to_spans(
                         self.planes, self._region_span_names(),
                         exclude_ops={self.name})
@@ -254,6 +318,9 @@ class ProfiledRegion:
                 "by_jit": {k: {"total_ms": v["total_ms"],
                                "count": v["count"]}
                            for k, v in self.by_jit.items()},
+                "by_shard_map": {k: {"total_ms": v["total_ms"],
+                                     "count": v["count"]}
+                                 for k, v in self.by_shard_map.items()},
                 "attribution": self.attribution,
             }
             if self.error is not None:

@@ -1,0 +1,252 @@
+"""Dense-driver phase profiler (ISSUE 18 leg c).
+
+``DenseSimulation.run_slot`` is one host loop dispatching a handful of
+device programs (vote kernel, head descent, epoch sweep) around genuine
+host work (committee masks, monitors, the audit walk, checkpoint
+gathers). ROADMAP item 5 (<1s mainnet epoch) names its levers by phase
+— so the first requirement is a per-slot phase budget that accounts for
+(almost) all of the slot wall, cheap enough to leave on.
+
+The two-rate design:
+
+- **every slot** is phase-timed with bare ``perf_counter`` pairs — two
+  clock reads and a dict add per phase, well under the <2% steady-state
+  overhead budget. But JAX dispatch is async: an unfenced phase that
+  launches device work charges only its dispatch cost, and the device
+  time surfaces in whichever later phase first blocks. Honest *between
+  phases*, misleading *within* one; so
+- **sampled slots** (every ``sample_every``-th) additionally fence each
+  phase with ``jax.block_until_ready`` on the arrays the phase
+  produced, so the sampled breakdown charges device time to the phase
+  that dispatched it. Fencing serializes the pipeline — that cost is
+  confined to sampled slots by construction, which is what keeps the
+  steady-state overhead small while the sampled budget stays honest.
+
+``NULL_TIMER`` is the disabled twin: same interface, empty bodies — the
+driver always threads a timer so the instrumented path has no branches,
+and the uninstrumented twin run (the overhead pin in CI) differs only
+by which timer it got.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["PhaseTimer", "NULL_TIMER", "DENSE_PHASES"]
+
+# The slot taxonomy (DESIGN.md "Fleet observability"): every section of
+# ``run_slot`` belongs to exactly one of these, so the budget is a
+# partition of the slot wall and ``unaccounted`` measures instrumentation
+# drift, not workload.
+DENSE_PHASES = (
+    "epoch_sweep",        # _epoch_boundary: process_epoch over the views
+    "shuffle",            # _start_epoch: committee shuffle + assignment
+    "vote_pass",          # _head: the masked vote-weights kernel
+    "head_descent",       # _head: head_from_buckets descent
+    "vote_apply",         # _deliver_batch/_apply_batch vote landing
+    "aggregate_verify",   # _verify_slot committee aggregates
+    "monitors",           # dense monitor sweep over the tallies
+    "host_audit",         # head_host_walk parity check
+    "checkpoint_capture",    # supervision tick: device->host gather
+    "checkpoint_serialize",  # supervision tick: npz on writer thread
+    "record",             # metrics/telemetry bookkeeping
+)
+
+
+class _Phase:
+    """One timed section; re-entered phases accumulate."""
+
+    __slots__ = ("timer", "name")
+
+    def __init__(self, timer: "PhaseTimer", name: str):
+        self.timer = timer
+        self.name = name
+
+    def __enter__(self) -> "_Phase":
+        self.timer._stack.append((self.name, time.perf_counter()))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        name, t0 = self.timer._stack.pop()
+        self.timer._charge(name, time.perf_counter() - t0)
+
+
+class _NullPhase:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+
+_NULL_PHASE = _NullPhase()
+
+
+class PhaseTimer:
+    """Accumulating per-phase wall timer with sampled device fencing.
+
+    >>> pt = PhaseTimer(sample_every=16, registry=reg, bus=bus)
+    >>> pt.begin_slot(s)
+    >>> with pt.phase("vote_pass"):
+    ...     out = kernel(...)
+    ...     pt.fence(out)          # block_until_ready at sampled slots
+    >>> pt.end_slot(s)
+    >>> pt.summary()["accounted_pct"]
+    """
+
+    enabled = True
+
+    def __init__(self, sample_every: int = 16, registry=None, bus=None):
+        self.sample_every = max(int(sample_every), 1)
+        self.registry = registry
+        self.bus = bus
+        self.sampled = False
+        self._stack: list[tuple[str, float]] = []
+        self._slot_t0 = 0.0
+        self._slot_acc: dict[str, float] = {}
+        # all-slots / sampled-slots accumulators: {phase: [seconds, n]}
+        self.totals: dict[str, list] = {}
+        self.sampled_totals: dict[str, list] = {}
+        self.slots = 0
+        self.sampled_slots = 0
+        self.wall_s = 0.0
+        self.sampled_wall_s = 0.0
+        # off-loop work (the supervision writer thread's checkpoint
+        # serialization) overlaps the slot wall, so it is charged here —
+        # NOT into the slot partition, or accounted_pct could top 100
+        self._async_lock = threading.Lock()
+        self.async_totals: dict[str, list] = {}
+        self._hist = (registry.histogram(
+            "dense_phase_ms",
+            "per-phase slot time at sampled (fenced) slots, ms")
+            if registry is not None else None)
+
+    # -- slot lifecycle --------------------------------------------------------
+
+    def begin_slot(self, slot: int) -> None:
+        self.sampled = (slot % self.sample_every) == 0
+        self._slot_acc = {}
+        self._slot_t0 = time.perf_counter()
+
+    def phase(self, name: str) -> _Phase:
+        return _Phase(self, name)
+
+    def _charge(self, name: str, dt: float) -> None:
+        self._slot_acc[name] = self._slot_acc.get(name, 0.0) + dt
+
+    def charge_async(self, name: str, dt: float) -> None:
+        """Charge work that ran OFF the slot loop (another thread) —
+        thread-safe, kept out of the slot-wall partition."""
+        with self._async_lock:
+            row = self.async_totals.setdefault(name, [0.0, 0])
+            row[0] += dt
+            row[1] += 1
+
+    def fence(self, *arrays) -> None:
+        """Synchronize the open phase with the device work it
+        dispatched — sampled slots only, so the steady state never
+        serializes the pipeline. Accepts anything
+        ``jax.block_until_ready`` does (pytrees included); no-jax
+        environments and host-only arrays fall through silently."""
+        if not self.sampled:
+            return
+        try:
+            import jax
+            jax.block_until_ready([a for a in arrays if a is not None])
+        except Exception:
+            pass  # pev: ignore[PEV005] — fencing is best-effort
+            # instrumentation; a host-only run must not die for it
+
+    def end_slot(self, slot: int) -> None:
+        wall = time.perf_counter() - self._slot_t0
+        self.slots += 1
+        self.wall_s += wall
+        for name, dt in self._slot_acc.items():
+            row = self.totals.setdefault(name, [0.0, 0])
+            row[0] += dt
+            row[1] += 1
+        if not self.sampled:
+            return
+        self.sampled_slots += 1
+        self.sampled_wall_s += wall
+        for name, dt in self._slot_acc.items():
+            row = self.sampled_totals.setdefault(name, [0.0, 0])
+            row[0] += dt
+            row[1] += 1
+            if self._hist is not None:
+                self._hist.observe(dt, phase=name)
+        if self.bus is not None:
+            try:
+                self.bus.emit(
+                    "dense_phase", slot=slot,
+                    wall_ms=round(wall * 1e3, 4),
+                    phases={n: round(dt * 1e3, 4)
+                            for n, dt in sorted(self._slot_acc.items())},
+                    accounted_pct=round(
+                        100.0 * sum(self._slot_acc.values()) / wall, 2)
+                    if wall > 0 else None)
+            except Exception:
+                pass  # a closed bus must not kill the slot it observed
+
+    # -- results ---------------------------------------------------------------
+
+    def summary(self) -> dict:
+        def table(acc: dict, wall: float) -> dict:
+            return {
+                name: {"total_ms": round(sec * 1e3, 3), "count": n,
+                       "share_pct": (round(100.0 * sec / wall, 2)
+                                     if wall > 0 else None)}
+                for name, (sec, n) in sorted(acc.items())
+            }
+
+        accounted = sum(sec for sec, _ in self.sampled_totals.values())
+        with self._async_lock:
+            async_phases = {
+                name: {"total_ms": round(sec * 1e3, 3), "count": n}
+                for name, (sec, n) in sorted(self.async_totals.items())}
+        return {
+            "sample_every": self.sample_every,
+            "slots": self.slots,
+            "sampled_slots": self.sampled_slots,
+            "wall_ms": round(self.wall_s * 1e3, 3),
+            "sampled_wall_ms": round(self.sampled_wall_s * 1e3, 3),
+            "phases": table(self.totals, self.wall_s),
+            "sampled_phases": table(self.sampled_totals,
+                                    self.sampled_wall_s),
+            "accounted_pct": (round(
+                100.0 * accounted / self.sampled_wall_s, 2)
+                if self.sampled_wall_s > 0 else None),
+            "async_phases": async_phases,
+        }
+
+
+class _NullTimer:
+    """The disabled twin: identical surface, empty bodies. Class-level
+    ``enabled`` lets call sites skip building fence arguments."""
+
+    enabled = False
+    sampled = False
+
+    def begin_slot(self, slot: int) -> None:
+        pass
+
+    def phase(self, name: str) -> _NullPhase:
+        return _NULL_PHASE
+
+    def fence(self, *arrays) -> None:
+        pass
+
+    def charge_async(self, name: str, dt: float) -> None:
+        pass
+
+    def end_slot(self, slot: int) -> None:
+        pass
+
+    def summary(self) -> dict | None:
+        return None
+
+
+NULL_TIMER = _NullTimer()

@@ -46,6 +46,8 @@ from bisect import bisect_right
 import numpy as np
 
 from pos_evolution_tpu.serve.loadgen import LoadGenerator
+from pos_evolution_tpu.telemetry import fleet, tracing
+from pos_evolution_tpu.telemetry.tracing import record_span
 
 __all__ = ["Balancer", "SwarmLoadGenerator"]
 
@@ -55,30 +57,74 @@ _LEN = struct.Struct(">I")
 class Balancer:
     """Seeded weighted choice over fronts, biased by shared-segment
     health. ``slot_map[j]`` lists the health-board slots (worker front
-    ids) serving front ``j``; with no board every front weighs 1.0."""
+    ids) serving front ``j``; with no board every front weighs 1.0.
+
+    With a ``metrics_dir``, the fleet metrics pipeline adds a second,
+    slower bias input: per-worker error fractions read from the beat
+    snapshots (``telemetry/fleet.py``). The health board says a worker
+    is *alive*; the metrics say whether it has been *answering well* —
+    a worker timing out most of its requests still beats on time, and
+    only its counters betray it."""
 
     STALE_S = 3.0
 
     def __init__(self, n_fronts: int, board=None,
                  slot_map: list[list[int]] | None = None,
-                 refresh_s: float = 0.2):
+                 refresh_s: float = 0.2,
+                 metrics_dir: str | None = None,
+                 metrics_refresh_s: float = 1.0):
         assert n_fronts > 0
         self.n_fronts = int(n_fronts)
         self.board = board
         self.slot_map = slot_map or [[j] for j in range(self.n_fronts)]
         assert len(self.slot_map) == self.n_fronts
         self.refresh_s = float(refresh_s)
+        self.metrics_dir = metrics_dir
+        self.metrics_refresh_s = float(metrics_refresh_s)
         self._lock = threading.Lock()
         self._at = -float("inf")
+        self._bias_at = -float("inf")
+        self._bias: dict[int, float] = {}
         # cumulative weights as a plain list: ``pick`` runs once per
         # arrival at 20k+/s, where a numpy scalar searchsorted costs
         # more than the whole frame encode — bisect is ~10x cheaper
         self._cum = [(j + 1) / self.n_fronts
                      for j in range(self.n_fronts)]
         self.refreshes = 0
+        self.metrics_refreshes = 0
+
+    def _metrics_bias(self) -> dict[int, float]:
+        """Per-worker weight multiplier in [0.25, 1.0] from the fleet
+        snapshot directory, cached for ``metrics_refresh_s`` (a scan
+        rereads every snapshot file — far too heavy per refresh, let
+        alone per pick). Workers with too few observed requests get no
+        bias: early noise must not starve a cold worker."""
+        if self.metrics_dir is None:
+            return {}
+        now = time.monotonic()
+        if now - self._bias_at < self.metrics_refresh_s:
+            return self._bias
+        self._bias_at = now
+        self.metrics_refreshes += 1
+        agg = fleet.FleetAggregator.from_dir(self.metrics_dir)
+        bias: dict[int, float] = {}
+        for w, by_status in agg.worker_status_totals(
+                "serve_requests_total").items():
+            total = sum(by_status.values())
+            if total < 32:
+                continue
+            bad = total - by_status.get("ok", 0) - by_status.get(
+                "shed", 0)  # shed is honest load control, not illness
+            try:
+                bias[int(w)] = max(0.25, 1.0 - 2.0 * bad / total)
+            except ValueError:
+                continue
+        self._bias = bias
+        return bias
 
     def _weights(self) -> np.ndarray:
         rows = {r["front"]: r for r in self.board.read_health()}
+        bias = self._metrics_bias()
         w = np.zeros(self.n_fronts)
         for j, slots in enumerate(self.slot_map):
             live = [rows[s] for s in slots
@@ -92,6 +138,10 @@ class Balancer:
             depth = sum(r["depth"] for r in live) / len(live)
             w[j] = len(live) * (0.3 if browned == len(live) else 1.0) \
                 / (1.0 + depth / 64.0)
+            if bias:
+                mult = [bias[s] for s in slots if s in bias]
+                if mult:
+                    w[j] *= sum(mult) / len(mult)
         if w.sum() <= 0:
             w[:] = 1.0
         return w
@@ -311,9 +361,13 @@ class SwarmLoadGenerator(LoadGenerator):
         self._retry_cond = threading.Condition()
         self._stopping = False
         self.resends = 0
+        self.shed_retries = 0
         self.lost = 0
         self.lost_by_reason: dict[str, int] = {}
         self.by_front = [0] * len(self.addrs)
+        # arrival index -> trace id for sampled arrivals: written only
+        # by the dispatcher, read by reader threads at resolution time
+        self._traced: dict[int, str] = {}
 
     # -- connections -----------------------------------------------------------
 
@@ -384,6 +438,10 @@ class SwarmLoadGenerator(LoadGenerator):
                         and self.verify_update is not None:
                     self._update_results.append(result)
             done = len(self.records) >= self.n
+        trace = self._traced.get(i)
+        if trace is not None:
+            record_span(trace, "client", time.time() - latency,
+                        latency * 1e3, status=status, tier=tier)
         if done:
             with self._done:
                 self._done.notify_all()
@@ -402,6 +460,8 @@ class SwarmLoadGenerator(LoadGenerator):
             retry_s = float(resp.get("retry_after_ms", 1.0)) / 1e3
             due = now + retry_s
             if due < deadline_abs and resends < self.max_resends:
+                with self._lock:
+                    self.shed_retries += 1
                 with self._retry_cond:
                     heapq.heappush(self._retry_heap,
                                    (due, i, tier, sched, deadline_abs,
@@ -496,13 +556,17 @@ class SwarmLoadGenerator(LoadGenerator):
 
     # -- the dispatcher --------------------------------------------------------
 
-    def _encode(self, i: int, targets: dict) -> tuple[bytes, int, str,
-                                                      float]:
+    def _encode(self, i: int, targets: dict,
+                trace: str | None = None) -> tuple[bytes, int, str,
+                                                   float]:
         method, params, deadline, tier = self._build(i, targets)
-        body = json.dumps(
-            {"id": i + 1, "method": method, "params": params,
-             "deadline_ms": round(deadline * 1e3, 3), "tier": tier},
-            separators=(",", ":")).encode()
+        obj = {"id": i + 1, "method": method, "params": params,
+               "deadline_ms": round(deadline * 1e3, 3), "tier": tier}
+        if trace is not None:
+            # trace member FIRST: traced frames must miss the servers'
+            # byte-scan fast path (see serve/protocol.py)
+            obj = {"trace": {"id": trace, "s": 1}, **obj}
+        body = json.dumps(obj, separators=(",", ":")).encode()
         return body, tier, method, deadline
 
     def run(self) -> dict:
@@ -530,6 +594,9 @@ class SwarmLoadGenerator(LoadGenerator):
         by_front = self.by_front
         pack = _LEN.pack
         monotonic = time.monotonic
+        trace_rate = self.trace_rate
+        trace_seed = self.trace_seed
+        t_sample, t_id = tracing.sample, tracing.trace_id
         t_start = monotonic() + 0.05
         max_deadline = max(self.interactive_deadline_s,
                            self.bulk_deadline_s)
@@ -556,17 +623,34 @@ class SwarmLoadGenerator(LoadGenerator):
                 continue
             if now - sched > 0.005:
                 late += 1
+            trace = None
+            if trace_rate > 0.0 and t_sample(trace_seed, i, trace_rate):
+                trace = t_id(trace_seed, i)
+                # single-writer: only this dispatch loop inserts; reader
+                # threads .get() a key only after its send, and dict
+                # item assignment is atomic  # pev: ignore[PEV101]
+                self._traced[i] = trace
             if is_bulk[i]:
                 targets = targets_fn()
-                body, tier, method, deadline = self._encode(i, targets)
+                body, tier, method, deadline = self._encode(i, targets,
+                                                            trace)
                 deadline_abs = sched + deadline + 0.25
             else:
                 r = pick1[i]
                 method = ("head" if r < 0.4 else
                           "finality" if r < 0.7 else "lc_update")
                 body = tmpl[method] % (i + 1)
+                if trace is not None:
+                    # splice the trace member in FRONT of the prebuilt
+                    # template bytes — traced frames must fall off the
+                    # servers' byte-scan fast path (serve/protocol.py)
+                    body = (b'{"trace":{"id":"' + trace.encode()
+                            + b'","s":1},' + body[1:])
                 tier, deadline_abs = 0, sched + idl_abs
             front = pick_front(front_draw[i])
+            if trace is not None:
+                record_span(trace, "balancer_pick", time.time(), 0.0,
+                            front=front, method=method)
             if monotonic() < self._front_down[front]:
                 # known-dark front: rotate to the next one rather than
                 # paying a guaranteed connection refusal
@@ -641,7 +725,11 @@ class SwarmLoadGenerator(LoadGenerator):
         out["fronts"] = len(self.addrs)
         out["by_front"] = list(self.by_front)
         out["resends"] = self.resends
+        out["shed_retries"] = self.shed_retries
         out["lost"] = self.lost
         out["lost_by_reason"] = dict(self.lost_by_reason)
+        out["traced"] = len(self._traced)
         out["balancer_refreshes"] = self.balancer.refreshes
+        out["balancer_metrics_refreshes"] = \
+            self.balancer.metrics_refreshes
         return out

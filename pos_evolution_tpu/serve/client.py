@@ -30,6 +30,7 @@ import threading
 import time
 
 from pos_evolution_tpu.serve.protocol import ProtocolError, recv_frame, send_frame
+from pos_evolution_tpu.telemetry.tracing import record_span
 
 __all__ = ["ServeClient", "ClientResult"]
 
@@ -192,10 +193,28 @@ class ServeClient:
 
     def request(self, method: str, params: dict | None = None,
                 deadline_s: float = 1.0, tier: int = 1,
-                hedge_ms: float | None = None) -> ClientResult:
+                hedge_ms: float | None = None,
+                trace: str | None = None) -> ClientResult:
         """One logical request under one deadline budget: send, hedge
         once per attempt after ``hedge_ms``, honor retry-after on shed,
-        give up (honestly) when the budget is gone."""
+        give up (honestly) when the budget is gone. ``trace`` (a sampled
+        trace id from ``telemetry/tracing.py``) rides every frame of
+        this logical request — primary, hedge, retry — and records one
+        client-side span over the whole state machine."""
+        t_wall = time.time()
+        res = self._request(method, params, deadline_s, tier, hedge_ms,
+                            trace)
+        if trace is not None:
+            record_span(trace, "client", t_wall, res.latency_s * 1e3,
+                        method=method, status=res.status,
+                        attempts=res.attempts, hedges=res.hedges,
+                        retries=res.retries)
+        return res
+
+    def _request(self, method: str, params: dict | None,
+                 deadline_s: float, tier: int,
+                 hedge_ms: float | None,
+                 trace: str | None) -> ClientResult:
         t_start = time.monotonic()
         expires = t_start + float(deadline_s)
         hedge_ms = self.hedge_ms if hedge_ms is None else hedge_ms
@@ -214,7 +233,7 @@ class ServeClient:
                     error=(last or {}).get("error"))
             attempts += 1
             resp, hedged = self._attempt(method, params, remaining, tier,
-                                         hedge_ms)
+                                         hedge_ms, trace=trace)
             hedges += hedged
             if resp is None or resp.get("error") == "connection lost":
                 continue  # channel died — next attempt reconnects
@@ -256,14 +275,15 @@ class ServeClient:
                 retries += 1
 
     def _attempt(self, method, params, budget_s, tier,
-                 hedge_ms) -> tuple[dict | None, int]:
+                 hedge_ms, trace=None) -> tuple[dict | None, int]:
         """One wire attempt: primary send + at most one hedge. The
         primary and the hedge share ONE event, so whichever response
         lands first wakes the caller — no polling."""
         t0 = time.monotonic()
         deadline = t0 + budget_s
         event = threading.Event()
-        primary = self._post(method, params, budget_s, tier, event=event)
+        primary = self._post(method, params, budget_s, tier, event=event,
+                             trace=trace)
         if primary is None:
             return None, 0
         ch0, rid0, slot0, idx0 = primary
@@ -278,7 +298,14 @@ class ServeClient:
                 # the primary — same-channel duplicates inherit the
                 # exact stall they exist to route around
                 hedge = self._post(method, params, remaining, tier,
-                                   event=event, index=idx0 + 1)
+                                   event=event, index=idx0 + 1,
+                                   trace=trace)
+                if hedge is not None and trace is not None:
+                    # instant marker: when (and why) the duplicate left
+                    record_span(trace, "hedge_sent", time.time(), 0.0,
+                                method=method,
+                                after_ms=round(
+                                    (time.monotonic() - t0) * 1e3, 3))
             event.wait(max(deadline - time.monotonic(), 0.0))
         # prefer a real answer over a transport error: a died primary
         # channel writes {"status": "error", "error": "connection lost"}
@@ -295,14 +322,23 @@ class ServeClient:
 
     def _post(self, method, params, budget_s, tier,
               event: threading.Event | None = None,
-              index: int | None = None):
+              index: int | None = None, trace: str | None = None):
         """Send one frame; returns (channel, id, slot, channel_index).
         ``index`` pins the starting pool slot (hedges pass the
         primary's index + 1 so the duplicate takes another socket);
         None draws from the round-robin."""
         rid = _next_id()
-        frame = {"id": rid, "method": method, "params": params or {},
-                 "deadline_ms": round(budget_s * 1e3, 3), "tier": tier}
+        if trace is not None:
+            # trace FIRST: a traced frame must not match the servers'
+            # byte-scan fast path (protocol.py's envelope contract)
+            frame = {"trace": {"id": trace, "s": 1}, "id": rid,
+                     "method": method, "params": params or {},
+                     "deadline_ms": round(budget_s * 1e3, 3),
+                     "tier": tier}
+        else:
+            frame = {"id": rid, "method": method, "params": params or {},
+                     "deadline_ms": round(budget_s * 1e3, 3),
+                     "tier": tier}
         base = next(self._rr) if index is None else index
         for probe in range(self.n_connections):
             idx = (base + probe) % self.n_connections

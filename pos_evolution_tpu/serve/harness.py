@@ -26,7 +26,16 @@ The verdict bar (what "the plane survives chaos" means here):
 - **supervision**: every armed kill shows up in the pool's interruption
   ledger as a crash, every wedge is caught by hang detection, and every
   respawned worker serves from the CURRENT shared-memory generation
-  (a respawn that serves a stale view is a silent fork).
+  (a respawn that serves a stale view is a silent fork);
+- **observability**: the fleet metrics scraped off the admission-exempt
+  ``metrics`` RPC agree with the loadgen's own ledger — per-worker
+  request counts sum to the arrivals actually sent, within resends,
+  shed retries, and the beat-interval a SIGKILLed incarnation loses.
+
+With ``trace_rate > 0`` a seeded fraction of arrivals carry a trace id
+end to end (``telemetry/tracing.py``); every process in the plane —
+this one included — writes its spans to ``<run_dir>/trace/`` for
+``scripts/trace_merge.py`` to stitch into one Chrome trace.
 """
 
 from __future__ import annotations
@@ -41,9 +50,15 @@ import time
 from pos_evolution_tpu.config import cfg
 from pos_evolution_tpu.serve.balancer import Balancer, SwarmLoadGenerator
 from pos_evolution_tpu.serve.chaos import FdExhaustSwarm, ServeChaos
+from pos_evolution_tpu.serve.protocol import (
+    ProtocolError,
+    recv_frame,
+    send_frame,
+)
 from pos_evolution_tpu.serve.shm import ShmViewBoard
 from pos_evolution_tpu.serve.state import ServeView
 from pos_evolution_tpu.serve.workers import WorkerPool, worker_spec
+from pos_evolution_tpu.telemetry import tracing
 
 __all__ = ["run_mp_scenario"]
 
@@ -56,6 +71,25 @@ class _Sidecar:
     def __init__(self, cells, commitment):
         self.cells = cells
         self.commitment = commitment
+
+
+def _scrape_metrics(addrs: list[tuple[str, int]]) -> dict | None:
+    """One ``metrics`` RPC against the first front that answers: the
+    fleet view is the same whichever worker serves it (every worker
+    aggregates the shared snapshot directory)."""
+    for addr in addrs:
+        try:
+            with socket.create_connection(addr, timeout=3.0) as s:
+                s.settimeout(3.0)
+                send_frame(s, {"id": 1, "method": "metrics",
+                               "params": {}, "deadline_ms": 2500.0,
+                               "tier": 0})
+                resp = recv_frame(s)
+        except (OSError, ProtocolError):
+            continue
+        if isinstance(resp, dict) and resp.get("status") == "ok":
+            return resp.get("result")
+    return None
 
 
 def _free_ports(n: int) -> list[int]:
@@ -95,7 +129,9 @@ def run_mp_scenario(
         backoff_s: float = 0.15, backoff_cap_s: float = 1.0,
         conns_per_front: int = 4, slo_ms: float = 300.0,
         ready_grace_s: float = 8.0, worker_threads: int = 2,
-        run_dir: str | None = None, events_bus=None) -> dict:
+        run_dir: str | None = None, events_bus=None,
+        trace_rate: float = 0.0, trace_seed: int | None = None,
+        trace_dir: str | None = None) -> dict:
     """Run one seeded multi-process serving scenario end to end.
 
     ``kills`` / ``wedges`` are process-level injections: SIGKILLs
@@ -112,6 +148,18 @@ def run_mp_scenario(
     os.makedirs(run_dir, exist_ok=True)
     lock_path = os.path.join(run_dir, "board.lock")
     duration_s = arrivals / float(rate)
+    if trace_rate <= 0.0:
+        trace_dir = None
+    else:
+        # an explicit trace_dir lets two phases (steady + chaos, each
+        # with its own run_dir so their fleet snapshots never mix) pour
+        # spans into ONE directory for a single merged timeline
+        if trace_dir is None:
+            trace_dir = os.path.join(run_dir, "trace")
+        os.makedirs(trace_dir, exist_ok=True)
+        # the harness process records the client-side spans (dispatch,
+        # balancer pick, resolution) — workers install their own sinks
+        tracing.install_buffer(trace_dir, proc="loadgen")
 
     from pos_evolution_tpu.das import BlobEngine
     engine = BlobEngine(seed=seed + 11)
@@ -141,6 +189,7 @@ def run_mp_scenario(
             worker_spec(
                 i, ports[i % n_fronts], board.name, lock_path, run_dir,
                 threads=worker_threads, config=cfg_dict,
+                trace_dir=trace_dir,
                 chaos=({"wedge_windows": wedge_map[i]}
                        if i in wedge_map else None))
             for i in range(n_workers)]
@@ -185,7 +234,8 @@ def run_mp_scenario(
 
         slot_map = [[i for i in range(n_workers) if i % n_fronts == j]
                     for j in range(n_fronts)]
-        balancer = Balancer(n_fronts, board=board, slot_map=slot_map)
+        balancer = Balancer(n_fronts, board=board, slot_map=slot_map,
+                            metrics_dir=run_dir)
         targets = {"roots": [root.hex()],
                    "n_cells": n_blobs * cfg().das_cells_per_blob,
                    "n_blobs": {root.hex(): n_blobs}}
@@ -194,7 +244,8 @@ def run_mp_scenario(
             balancer=balancer, conns_per_front=conns_per_front,
             seed=seed, bulk_fraction=bulk_fraction,
             samples_per_request=samples_per_request,
-            targets_fn=lambda: targets)
+            targets_fn=lambda: targets,
+            trace_rate=trace_rate, trace_seed=trace_seed)
 
         if kills > 0:
             chaos.arm_worker_kills(time.monotonic(), duration_s, kills,
@@ -248,6 +299,21 @@ def run_mp_scenario(
             loris.stop()
             result["fd_exhaust"] = {"connected": loris.connected,
                                     "refused": loris.refused}
+        # fleet scrape (ISSUE 18 leg a): after settle every surviving
+        # worker has flushed ≥1 beat since the last response, so the
+        # merged registry is the plane's complete request ledger (less
+        # at most one beat-interval per SIGKILLed incarnation)
+        time.sleep(0.4)  # one beat + slack: let the final beats land
+        scraped = _scrape_metrics([("127.0.0.1", p) for p in ports])
+        if scraped is not None:
+            result["fleet"] = scraped.get("fleet")
+            result["fleet_prometheus"] = scraped.get("prometheus")
+        if trace_dir is not None:
+            buf = tracing.get_buffer()
+            if buf is not None:
+                buf.flush()
+            result["trace_dir"] = trace_dir
+        result["beat_s"] = 0.25
         result["verdict"] = _judge(result, kills, wedges, slo_ms)
     finally:
         stop_pub.set()
@@ -303,9 +369,39 @@ def _judge(result: dict, kills: int, wedges: int, slo_ms: float) -> dict:
         "respawned_on_current_generation": current,
         "live_workers": len(live_rows),
     }
+    # fleet-consistency (ISSUE 18 leg a): the per-worker request
+    # counters scraped off the metrics RPC must sum to what the loadgen
+    # actually sent. Over-count allowance: resends and shed retries put
+    # the same arrival on a second worker; the scrape itself counts
+    # once. Under-count allowance: a ``lost`` arrival may never have
+    # reached a worker, and each SIGKILLed incarnation keeps only its
+    # last beat-flushed counts (≤ ~2 beat-intervals of its share of
+    # the arrival rate).
+    fleet_view = result.get("fleet")
+    if fleet_view is not None:
+        by_worker = fleet_view.get("requests_by_worker") or {}
+        fleet_sum = sum(float(v) for v in by_worker.values())
+        arrivals = result["arrivals"]
+        incarnations_killed = (kills_delivered
+                               + by_reason.get("hang", 0)
+                               + by_reason.get("rss", 0))
+        kill_slack = (incarnations_killed * result["rate"]
+                      / max(result["workers"], 1)
+                      * 2.0 * result.get("beat_s", 0.25))
+        lo = arrivals - load.get("lost", 0) - kill_slack - 8
+        hi = (arrivals + load.get("resends", 0)
+              + load.get("shed_retries", 0) + kill_slack + 8)
+        verdict["fleet_requests_by_worker"] = dict(by_worker)
+        verdict["fleet_requests_total"] = fleet_sum
+        verdict["fleet_window"] = [round(lo, 1), round(hi, 1)]
+        verdict["fleet_workers_reporting"] = len(by_worker)
+        verdict["fleet_consistent"] = bool(lo <= fleet_sum <= hi)
+    else:
+        verdict["fleet_consistent"] = False
     verdict["ok"] = bool(
         verdict["records_match_schedule"] and verdict["goodput_ok"]
         and verdict["slo_ok"] and verdict["integrity_ok"]
         and verdict["kills_detected"] and verdict["wedges_detected"]
-        and verdict["respawned_on_current_generation"])
+        and verdict["respawned_on_current_generation"]
+        and verdict["fleet_consistent"])
     return verdict

@@ -8,7 +8,17 @@ can be pipelined on one connection and demultiplexed by ``id``.
 Request envelope::
 
     {"id": <int>, "method": "<name>", "params": {...},
-     "deadline_ms": <float remaining budget>, "tier": <int advisory>}
+     "deadline_ms": <float remaining budget>, "tier": <int advisory>,
+     "trace": {"id": "<16-hex>", "s": 1}?}
+
+``trace`` is OPTIONAL and backward-compatible (JSON objects ignore
+unknown members): a client that sampled the request for end-to-end
+tracing (``telemetry/tracing.py``) attaches its deterministic trace id;
+servers record queue-wait/service/backing spans under that id and
+otherwise treat the request identically. Clients serialize the trace
+member FIRST so traced frames fall off the servers' byte-scan fast path
+(the traced request must take the fully-observed queue path), while
+untraced frames stay byte-identical to the pre-trace protocol.
 
 Response envelope::
 

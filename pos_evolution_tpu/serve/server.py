@@ -30,6 +30,7 @@ from __future__ import annotations
 import contextlib
 import json
 import math
+import os
 import socket
 import struct
 import threading
@@ -54,6 +55,7 @@ from pos_evolution_tpu.serve.protocol import (
     send_frame,
 )
 from pos_evolution_tpu.serve.state import ServingState
+from pos_evolution_tpu.telemetry.tracing import record_span
 
 __all__ = ["ServeFront", "TIER_INTERACTIVE", "TIER_BULK", "METHOD_TIERS"]
 
@@ -68,6 +70,7 @@ METHOD_TIERS = {
     "finality": TIER_INTERACTIVE,
     "lc_update": TIER_INTERACTIVE,
     "stats": TIER_INTERACTIVE,
+    "metrics": TIER_INTERACTIVE,
     "das_cells": TIER_BULK,
     "das_aggregate": TIER_BULK,
 }
@@ -158,7 +161,9 @@ class ServeFront:
                  breaker: CircuitBreaker | None = None,
                  read_timeout_s: float = 2.0, max_connections: int = 512,
                  default_deadline_ms: float = 1000.0, chaos=None,
-                 reuse_port: bool = False, ident: str | None = None):
+                 reuse_port: bool = False, ident: str | None = None,
+                 metrics_dir: str | None = None,
+                 worker_id: int | None = None):
         self.state = state
         self.registry = registry
         self.workers = int(workers)
@@ -172,6 +177,11 @@ class ServeFront:
         # multi-process plane's listener strategy (serve/workers.py)
         self.reuse_port = bool(reuse_port)
         self.ident = ident
+        # fleet observability (ISSUE 18): the ``metrics`` RPC aggregates
+        # sibling snapshot files under metrics_dir on top of this
+        # process's live registry (labelled worker_id)
+        self.metrics_dir = metrics_dir
+        self.worker_id = worker_id
         # per-view interactive response cache: head/finality/lc_update
         # answers are pure functions of the published view, so the hex
         # walks run once per (view, method), not once per request
@@ -444,6 +454,22 @@ class ServeFront:
                         "error": f"unknown method {str(method)[:64]!r}"})
             return
         arrival = time.monotonic()
+        if method == "metrics":
+            # admission-exempt introspection: answered from memory on
+            # the reader thread — never queued, never breaker-gated —
+            # so the fleet stays observable through overload and
+            # backing outages (the whole point of a metrics scrape)
+            self._count("serve_requests_total", "requests by status",
+                        method=method, status="ok")
+            self._record_latency(TIER_INTERACTIVE,
+                                 time.monotonic() - arrival, "ok")
+            conn.reply({"id": req["id"], "status": "ok",
+                        "result": self._metrics_payload(),
+                        "served_by": -1})
+            return
+        trace = req.get("trace")
+        traced = (trace.get("id")
+                  if isinstance(trace, dict) and trace.get("s") else None)
         # interactive fast path: when the per-view response cache
         # already holds this method's answer, serve it straight from
         # the reader — a queue hop (condvar wakeup + worker context
@@ -451,7 +477,11 @@ class ServeFront:
         # 20k+/s on a shared core that overhead IS the capacity limit.
         # The FIRST request per (view, method) still takes the full
         # admission path and populates the cache; bulk always queues.
-        if tier == TIER_INTERACTIVE and method != "stats":
+        # A TRACED request always queues too: its spans (queue wait,
+        # service) are the observation, and sampled traffic is rare
+        # enough that skipping the template costs nothing measurable.
+        if tier == TIER_INTERACTIVE and method != "stats" \
+                and traced is None:
             if method == "ping":
                 tail = self._PING_TAIL
             else:
@@ -533,12 +563,22 @@ class ServeFront:
         if tier == TIER_INTERACTIVE:
             self.brownout.observe_interactive_wait(wait_s)
         method = req["method"]
+        trace = req.get("trace")
+        traced = (trace.get("id")
+                  if isinstance(trace, dict) and trace.get("s") else None)
+        if traced is not None:
+            record_span(traced, "queue_wait", time.time() - wait_s,
+                        wait_s * 1e3, tid=worker_id, method=method)
         if now >= expires_at:
             # deadline propagation: the client stopped waiting —
             # touching the backing store now would be pure waste
             self._count("serve_requests_total", "requests by status",
                         method=method, status="timeout")
             self._record_latency(tier, now - arrival, "timeout")
+            if traced is not None:
+                record_span(traced, "service", time.time(), 0.0,
+                            tid=worker_id, method=method,
+                            status="timeout")
             conn.reply({"id": req["id"], "status": "timeout"})
             return
         # the circuit breaker guards the BACKING STORE, so only the
@@ -560,7 +600,8 @@ class ServeFront:
         t0 = time.monotonic()
         try:
             result = self._handle(method, req.get("params") or {},
-                                  expires_at)
+                                  expires_at, trace=traced,
+                                  tid=worker_id)
             if backed:
                 self.breaker.record_success()
             status = "ok"
@@ -604,6 +645,10 @@ class ServeFront:
         self._count("serve_requests_total", "requests by status",
                     method=method, status=status)
         self._record_latency(tier, wait_s + service_s, status)
+        if traced is not None:
+            record_span(traced, "service", time.time() - service_s,
+                        service_s * 1e3, tid=worker_id, method=method,
+                        status=status, worker=self.worker_id)
         conn.reply(resp)
 
     # -- handlers --------------------------------------------------------------
@@ -617,11 +662,16 @@ class ServeFront:
             raise _NotReady("no serving view published yet")
         return view
 
-    def _handle(self, method: str, params: dict, expires_at: float):
+    def _handle(self, method: str, params: dict, expires_at: float,
+                trace: str | None = None, tid: int = 0):
         if method == "ping":
             return {}
         if method == "stats":
             return self.summary()
+        if method == "metrics":
+            # normally answered on the reader thread; reachable here
+            # only through in-process calls — same memory-served payload
+            return self._metrics_payload()
         view = self._view()
         if method in ("head", "finality", "lc_update"):
             # identity-keyed per-view cache: these answers are pure
@@ -654,9 +704,11 @@ class ServeFront:
                                    + b',"served_by":-1}')
             return hit
         if method == "das_aggregate":
-            return self._das_aggregate(view, params, expires_at)
+            return self._das_aggregate(view, params, expires_at,
+                                       trace=trace, tid=tid)
         assert method == "das_cells"
-        return self._das_cells(view, params, expires_at)
+        return self._das_cells(view, params, expires_at,
+                               trace=trace, tid=tid)
 
     def _parse_das_params(self, view, params: dict):
         try:
@@ -681,7 +733,8 @@ class ServeFront:
                                   f"grid")
         return root, samples, sidecars
 
-    def _das_aggregate(self, view, params: dict, expires_at: float) -> dict:
+    def _das_aggregate(self, view, params: dict, expires_at: float,
+                       trace: str | None = None, tid: int = 0) -> dict:
         """One aggregated opening proof for the request's whole sampled
         set (kzg-style schemes) — the response ships |proof| bytes total
         instead of depth*32 bytes per sample."""
@@ -698,7 +751,17 @@ class ServeFront:
             raise _Expired()
         if self.chaos is not None:
             self.chaos.maybe_backing_fault()
+        leads0 = self.das._flight.leads
+        b_wall, b_t0 = time.time(), time.monotonic()
         proof = self.das.build_aggregate_proof(root, sidecars, coords)
+        if trace is not None:
+            # single-flight followers share the trace id AND the time
+            # range of the leader's build — the merged trace links them
+            record_span(trace, "backing", b_wall,
+                        (time.monotonic() - b_t0) * 1e3, tid=tid,
+                        kind="das_aggregate", block=root.hex()[:16],
+                        flight=("lead" if self.das._flight.leads > leads0
+                                else "follow"))
         grids = {b for b, _ in coords}
         cells_out = [
             bytes(np.ascontiguousarray(sidecars[b].cells,
@@ -716,7 +779,8 @@ class ServeFront:
             "blobs_opened": len(grids),
         }
 
-    def _das_cells(self, view, params: dict, expires_at: float) -> dict:
+    def _das_cells(self, view, params: dict, expires_at: float,
+                   trace: str | None = None, tid: int = 0) -> dict:
         if getattr(self.das.scheme, "aggregates", False):
             # an aggregate scheme has no per-cell branch walk to serve —
             # honest refusal, not an AttributeError in a worker
@@ -740,8 +804,19 @@ class ServeFront:
                 # path's failures should trip the breaker open)
                 if self.chaos is not None:
                     self.chaos.maybe_backing_fault()
+                leads0 = self.das._flight.leads
+                b_wall, b_t0 = time.time(), time.monotonic()
                 built = self.das.build_blob_proofs(root, blob,
                                                    sidecars[blob])
+                if trace is not None:
+                    record_span(
+                        trace, "backing", b_wall,
+                        (time.monotonic() - b_t0) * 1e3, tid=tid,
+                        kind="das_cells", block=root.hex()[:16],
+                        blob=blob,
+                        flight=("lead"
+                                if self.das._flight.leads > leads0
+                                else "follow"))
                 hit = built[cell]
             cell_bytes, branch = hit
             cells_out.append(bytes(cell_bytes).hex())
@@ -765,6 +840,40 @@ class ServeFront:
         return {"count": len(xs), "p50_ms": percentile_ms(xs, 50),
                 "p99_ms": percentile_ms(xs, 99),
                 "p999_ms": percentile_ms(xs, 99.9)}
+
+    def _metrics_payload(self) -> dict:
+        """The ``metrics`` RPC result: this process's LIVE registry plus
+        every sibling snapshot under ``metrics_dir``, merged with
+        per-worker labels (ISSUE 18 leg a). Served entirely from memory
+        + local files — no queue, no backing store, no breaker."""
+        from pos_evolution_tpu.telemetry import fleet
+        from pos_evolution_tpu.telemetry.registry import SNAPSHOT_VERSION
+        self._flush_fast_metrics()
+        agg = fleet.FleetAggregator()
+        own = None
+        if self.metrics_dir is not None:
+            if self.worker_id is not None:
+                # skip our OWN snapshot file: the live registry below is
+                # the same counters, fresher — merging both doubles them
+                own = os.path.abspath(fleet.snapshot_path(
+                    self.metrics_dir, self.worker_id, os.getpid()))
+            for path in fleet.discover_snapshots(self.metrics_dir):
+                if own is not None and os.path.abspath(path) == own:
+                    continue
+                agg.add(fleet.load_snapshot(path))
+        if self.registry is not None:
+            agg.add({
+                "v": SNAPSHOT_VERSION,
+                "worker": (self.worker_id if self.worker_id is not None
+                           else 0),
+                "pid": os.getpid(), "front": None, "generation": None,
+                "wall": time.time(),
+                "registry": self.registry.snapshot(),
+            })
+        return {
+            "fleet": agg.summary(),
+            "prometheus": agg.registry.to_prometheus(),
+        }
 
     def _flush_fast_metrics(self) -> None:
         """Fold fast-path tallies into the registry — one counter inc

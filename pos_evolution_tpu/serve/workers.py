@@ -61,7 +61,8 @@ def worker_spec(worker_id: int, port: int, board_name: str,
                 max_connections: int = 512,
                 default_deadline_ms: float = 1000.0,
                 brownout: dict | None = None, chaos: dict | None = None,
-                config: dict | None = None) -> dict:
+                config: dict | None = None,
+                trace_dir: str | None = None) -> dict:
     """The picklable worker description ``_worker_main`` boots from —
     plain data only (a spawn child shares no interpreter state): the
     scheme travels by registry NAME, the config by field dict, the
@@ -76,6 +77,7 @@ def worker_spec(worker_id: int, port: int, board_name: str,
         "board_name": board_name, "lock_path": lock_path,
         "heartbeat_path": os.path.join(run_dir, f"worker{worker_id}.hb"),
         "stats_path": os.path.join(run_dir, f"worker{worker_id}.stats"),
+        "run_dir": run_dir, "trace_dir": trace_dir,
         "scheme": scheme, "threads": int(threads),
         "beat_s": float(beat_s), "proof_cache": int(proof_cache),
         "max_depth": int(max_depth),
@@ -105,6 +107,7 @@ def _worker_main(spec: dict) -> None:
     from pos_evolution_tpu.serve.server import ServeFront
     from pos_evolution_tpu.serve.shm import ShmViewBoard
     from pos_evolution_tpu.serve.state import ServingState
+    from pos_evolution_tpu.telemetry import fleet, tracing
     from pos_evolution_tpu.telemetry.registry import MetricsRegistry
     from pos_evolution_tpu.utils.singleflight import ProcessFlight
     from pos_evolution_tpu.utils.watchdog import Heartbeat
@@ -134,7 +137,14 @@ def _worker_main(spec: dict) -> None:
         max_connections=spec["max_connections"],
         default_deadline_ms=spec["default_deadline_ms"],
         brownout=brownout, reuse_port=True,
-        ident=f"{os.getpid()}:{spec['worker_id']}")
+        ident=f"{os.getpid()}:{spec['worker_id']}",
+        metrics_dir=spec.get("run_dir"),
+        worker_id=spec["worker_id"])
+    # span sink for this process's server-side trace spans; the beat
+    # thread flushes it alongside the metrics snapshot
+    if spec.get("trace_dir"):
+        tracing.install_buffer(spec["trace_dir"],
+                               proc=f"worker{spec['worker_id']}")
     front.start()
 
     seen = {"generation": 0}
@@ -186,6 +196,24 @@ def _worker_main(spec: dict) -> None:
             # stopping — the supervisor sees the exit either way
             except Exception:  # pev: ignore[PEV005]
                 pass
+            # fleet metrics snapshot (ISSUE 18 leg a): atomic dump of
+            # this incarnation's registry, pid-named so a respawn never
+            # overwrites the corpse's last-flushed counts. OSError is
+            # survivable — a full disk must not kill the worker.
+            try:
+                fleet.write_snapshot(
+                    fleet.snapshot_path(spec["run_dir"],
+                                        spec["worker_id"], os.getpid()),
+                    registry, spec["worker_id"], os.getpid(),
+                    front=spec["front_id"],
+                    generation=seen["generation"])
+            except OSError:
+                registry.counter(
+                    "serve_fleet_snapshot_errors_total",
+                    "fleet metrics snapshots lost to I/O errors").inc()
+            buf = tracing.get_buffer()
+            if buf is not None:
+                buf.flush()
             _atomic_json(spec["stats_path"], {
                 "pid": os.getpid(), "worker": spec["worker_id"],
                 "generation": seen["generation"],
@@ -209,6 +237,18 @@ def _worker_main(spec: dict) -> None:
     stop.wait()
     front.stop()          # honest drain: queued work answers shed
     beater.join(timeout=2.0)
+    front._flush_fast_metrics()  # fold the last beat-interval's tallies
+    try:
+        fleet.write_snapshot(
+            fleet.snapshot_path(spec["run_dir"], spec["worker_id"],
+                                os.getpid()),
+            registry, spec["worker_id"], os.getpid(),
+            front=spec["front_id"], generation=seen["generation"])
+    except OSError:
+        pass
+    buf = tracing.get_buffer()
+    if buf is not None:
+        buf.flush()
     _atomic_json(spec["stats_path"], {
         "pid": os.getpid(), "worker": spec["worker_id"],
         "generation": seen["generation"], "unix": round(time.time(), 3),

@@ -70,6 +70,7 @@ import dataclasses
 import hashlib
 import io
 import json
+import time
 
 import numpy as np
 
@@ -126,7 +127,7 @@ class DenseSimulation:
                  verify_aggregates: bool = True, capacity: int = 256,
                  check_walk_every: int = 16, autocheckpoint=None,
                  n_groups: int = 1, fault_plan=None, adversaries=(),
-                 monitors=(), telemetry=None):
+                 monitors=(), telemetry=None, phase_profile=None):
         import jax.numpy as jnp
         self.cfg = cfg or mainnet_config()
         self.n = int(n_validators)
@@ -152,6 +153,18 @@ class DenseSimulation:
         self.adversaries = list(adversaries)
         self.monitors = list(monitors)
         self.telemetry = telemetry
+        # phase profiler (ISSUE 18 leg c): ``phase_profile=N`` fences
+        # every N-th slot; None/0 threads the disabled twin so the loop
+        # body stays branch-free either way
+        from pos_evolution_tpu.profiling.phases import (
+            NULL_TIMER,
+            PhaseTimer,
+        )
+        self.phases = (PhaseTimer(
+            sample_every=int(phase_profile),
+            registry=telemetry.registry if telemetry else None,
+            bus=telemetry.bus if telemetry else None)
+            if phase_profile else NULL_TIMER)
         self.monitor_violations: list[dict] = []
         # honest duty split: view group per validator (parity keeps the
         # shuffled committees near-balanced between the halves)
@@ -420,19 +433,26 @@ class DenseSimulation:
             rebuild_buckets,
         )
         view = self.views[g]
-        if self.mesh is not None:
-            from pos_evolution_tpu.parallel.sharded import vote_weights_for
-            buckets = vote_weights_for(self.mesh, self.capacity)(
-                view.msg_block, view.registry.effective_balance)
-        else:
-            buckets = rebuild_buckets(view.msg_block,
-                                      view.registry.effective_balance,
-                                      self.capacity)
-        head_idx, _ = head_from_buckets(
-            self._parent_d, self._real_d & view.vis_d, self._rank_d,
-            self._viable_d, jnp.int32(view.cur_just[1]), buckets,
-            jnp.int32(-1), jnp.int64(0), self.capacity)
-        return int(head_idx)
+        with self.phases.phase("vote_pass"):
+            if self.mesh is not None:
+                from pos_evolution_tpu.parallel.sharded import (
+                    vote_weights_for,
+                )
+                buckets = vote_weights_for(self.mesh, self.capacity)(
+                    view.msg_block, view.registry.effective_balance)
+            else:
+                buckets = rebuild_buckets(view.msg_block,
+                                          view.registry.effective_balance,
+                                          self.capacity)
+            self.phases.fence(buckets)
+        # the int() materialization blocks, so this phase is honestly
+        # fenced on EVERY slot, sampled or not
+        with self.phases.phase("head_descent"):
+            head_idx, _ = head_from_buckets(
+                self._parent_d, self._real_d & view.vis_d, self._rank_d,
+                self._viable_d, jnp.int32(view.cur_just[1]), buckets,
+                jnp.int32(-1), jnp.int64(0), self.capacity)
+            return int(head_idx)
 
     def head_host_walk(self, g: int = 0) -> bytes:
         """The spec-walk oracle: gather the view's message table,
@@ -688,125 +708,155 @@ class DenseSimulation:
 
     def run_slot(self) -> None:
         from pos_evolution_tpu.sim.dense_adversary import VoteBatch
+        pt = self.phases
         s = self.slot + 1
         epoch = s // self.S
+        pt.begin_slot(s)
         if s % self.S == 0 and s > 0:
-            for view in self.views:
-                self._epoch_boundary(view, epoch)
+            with pt.phase("epoch_sweep"):
+                for view in self.views:
+                    self._epoch_boundary(view, epoch)
+                pt.fence(*(v.registry.balance for v in self.views))
         if self._epoch_ready < epoch:
-            self._start_epoch(epoch)
+            # _start_epoch ends on np.asarray(perm) — host-materialized,
+            # so this phase is self-fencing
+            with pt.phase("shuffle"):
+                self._start_epoch(epoch)
         self._originated = []
-        # delayed cross-view block visibility lands at slot start
-        still = []
-        for idx, g, at_slot in self._pending_vis:
-            if at_slot <= s:
-                view = self.views[g]
-                view.vis_host[idx] = True
-                view.vis_d = view.vis_d.at[idx].set(True)
-            else:
-                still.append((idx, g, at_slot))
-        self._pending_vis = still
+        with pt.phase("record"):
+            # delayed cross-view block visibility lands at slot start
+            still = []
+            for idx, g, at_slot in self._pending_vis:
+                if at_slot <= s:
+                    view = self.views[g]
+                    view.vis_host[idx] = True
+                    view.vis_d = view.vis_d.at[idx].set(True)
+                else:
+                    still.append((idx, g, at_slot))
+            self._pending_vis = still
 
-        for adv in self.adversaries:
-            adv.before_propose(self, s)
+            for adv in self.adversaries:
+                adv.before_propose(self, s)
 
-        # --- per-view proposals -------------------------------------------
+        # --- per-view proposals (head queries charge vote_pass /
+        # head_descent inside _head; the block-tree bookkeeping around
+        # them is "record") -------------------------------------------------
         new_idx: list[int] = []
         for g in range(self.n_groups):
             head = self._head(g)
-            if self.n_groups == 1:
-                root = _hash(b"block", self.seed, s, self.roots[head])
-            else:
-                root = _hash(b"block", self.seed, s, self.roots[head], g)
-            visible_to = None
-            cross = self._cross_views(g)
-            if self.n_groups > 1:
-                visible_to = [g] + [h for h, d in cross if d == 0]
-            idx = self._append_block(root, head, s, visible_to=visible_to)
-            for h, d in cross:
-                if d > 0:
-                    self._pending_vis.append((idx, h, s + d))
-            if s % self.S == 0:
-                self.views[g].epoch_start_idx[epoch] = idx
-            new_idx.append(idx)
+            with pt.phase("record"):
+                if self.n_groups == 1:
+                    root = _hash(b"block", self.seed, s, self.roots[head])
+                else:
+                    root = _hash(b"block", self.seed, s,
+                                 self.roots[head], g)
+                visible_to = None
+                cross = self._cross_views(g)
+                if self.n_groups > 1:
+                    visible_to = [g] + [h for h, d in cross if d == 0]
+                idx = self._append_block(root, head, s,
+                                         visible_to=visible_to)
+                for h, d in cross:
+                    if d > 0:
+                        self._pending_vis.append((idx, h, s + d))
+                if s % self.S == 0:
+                    self.views[g].epoch_start_idx[epoch] = idx
+                new_idx.append(idx)
 
-        for adv in self.adversaries:
-            adv.on_proposals(self, s, new_idx)
+        with pt.phase("record"):
+            for adv in self.adversaries:
+                adv.on_proposals(self, s, new_idx)
 
         # --- votes: pending (delayed) first, then honest, then adversarial
-        landed_own = [np.zeros(self.n, dtype=bool)
-                      for _ in range(self.n_groups)]
-        for g, view in enumerate(self.views):
-            pending, view.pending = view.pending, []
-            for batch in pending:
+        with pt.phase("vote_apply"):
+            landed_own = [np.zeros(self.n, dtype=bool)
+                          for _ in range(self.n_groups)]
+            for g, view in enumerate(self.views):
+                pending, view.pending = view.pending, []
+                for batch in pending:
+                    self._originated.append((g, batch))
+                    land = self._deliver_batch(g, batch, s, epoch)
+                    if batch.block == new_idx[g]:
+                        landed_own[g] |= land
+            committee = self.committee_mask(s)
+            for g in range(self.n_groups):
+                duty = (committee & (self.group_of == g)
+                        & ~self.controlled_any)
+                batch = VoteBatch(duty, new_idx[g], epoch, views=(g,))
                 self._originated.append((g, batch))
-                land = self._deliver_batch(g, batch, s, epoch)
-                if batch.block == new_idx[g]:
-                    landed_own[g] |= land
-        committee = self.committee_mask(s)
-        for g in range(self.n_groups):
-            duty = committee & (self.group_of == g) & ~self.controlled_any
-            batch = VoteBatch(duty, new_idx[g], epoch, views=(g,))
-            self._originated.append((g, batch))
-            landed_own[g] |= self._deliver_batch(g, batch, s, epoch)
-            for h, delay in self._cross_views(g):
-                cross = VoteBatch(duty.copy(), new_idx[g], epoch,
-                                  views=(h,))
-                if delay == 0:
-                    self._originated.append((h, cross))
-                    self._deliver_batch(h, cross, s, epoch)
-                else:
-                    self.views[h].pending.append(cross)
-        for adv in self.adversaries:
-            for batch in adv.vote_batches(self, s, new_idx):
-                for g in range(self.n_groups):
-                    if batch.for_view(g):
-                        self._originated.append((g, batch))
-                        land = self._deliver_batch(g, batch, s, epoch)
-                        if batch.block == new_idx[g]:
-                            landed_own[g] |= land
+                landed_own[g] |= self._deliver_batch(g, batch, s, epoch)
+                for h, delay in self._cross_views(g):
+                    cross = VoteBatch(duty.copy(), new_idx[g], epoch,
+                                      views=(h,))
+                    if delay == 0:
+                        self._originated.append((h, cross))
+                        self._deliver_batch(h, cross, s, epoch)
+                    else:
+                        self.views[h].pending.append(cross)
+            for adv in self.adversaries:
+                for batch in adv.vote_batches(self, s, new_idx):
+                    for g in range(self.n_groups):
+                        if batch.for_view(g):
+                            self._originated.append((g, batch))
+                            land = self._deliver_batch(g, batch, s, epoch)
+                            if batch.block == new_idx[g]:
+                                landed_own[g] |= land
+            pt.fence(*(v.msg_block for v in self.views))
 
         if self.verify_aggregates:
-            for g in range(self.n_groups):
-                if landed_own[g].any():
-                    self._verify_slot(s % self.S, self.roots[new_idx[g]],
-                                      landed_own[g])
+            # _verify_slot materializes the ok vector — self-fencing
+            with pt.phase("aggregate_verify"):
+                for g in range(self.n_groups):
+                    if landed_own[g].any():
+                        self._verify_slot(s % self.S,
+                                          self.roots[new_idx[g]],
+                                          landed_own[g])
 
         self.slot = s
         self.view_heads = [self.roots[new_idx[g]]
                            for g in range(self.n_groups)]
 
         # --- monitors over the gathered tallies ---------------------------
-        for mon in self.monitors:
-            mon.on_votes(self, s, self._originated)
-        for mon in self.monitors:
-            for v in mon.on_slot_end(self, s):
-                v.setdefault("slot", s)
-                self.monitor_violations.append(v)
-                self._emit("monitor", **v)
+        with pt.phase("monitors"):
+            for mon in self.monitors:
+                mon.on_votes(self, s, self._originated)
+            for mon in self.monitors:
+                for v in mon.on_slot_end(self, s):
+                    v.setdefault("slot", s)
+                    self.monitor_violations.append(v)
+                    self._emit("monitor", **v)
 
         if self.check_walk_every and s % self.check_walk_every == 0:
             # device head vs independent host walk (not the proposed
-            # block: an adversary can legitimately move the head)
-            self.walk_checks.append(self.head_host_walk(0) ==
-                                    self.roots[self._head(0)])
-        m = {
-            "slot": s, "head_root": self.view_heads[0].hex()[:16],
-            "justified_epoch": self.views[0].cur_just[0],
-            "finalized_epoch": self.views[0].finalized[0],
-            "n_blocks": len(self.roots),
-        }
-        if self.n_groups > 1:
-            m["views"] = [{"head_root": self.view_heads[g].hex()[:16],
-                           "justified_epoch": self.views[g].cur_just[0],
-                           "finalized_epoch": self.views[g].finalized[0]}
-                          for g in range(self.n_groups)]
-        self.metrics.append(m)
-        self._emit("slot", slot=s, head_slot=s,
-                   justified_epoch=self.views[0].cur_just[0],
-                   finalized_epoch=self.views[0].finalized[0])
+            # block: an adversary can legitimately move the head). The
+            # head query charges its own phases; only the NumPy walk
+            # itself is the audit.
+            dev_head = self.roots[self._head(0)]
+            with pt.phase("host_audit"):
+                self.walk_checks.append(self.head_host_walk(0) ==
+                                        dev_head)
+        with pt.phase("record"):
+            m = {
+                "slot": s, "head_root": self.view_heads[0].hex()[:16],
+                "justified_epoch": self.views[0].cur_just[0],
+                "finalized_epoch": self.views[0].finalized[0],
+                "n_blocks": len(self.roots),
+            }
+            if self.n_groups > 1:
+                m["views"] = [
+                    {"head_root": self.view_heads[g].hex()[:16],
+                     "justified_epoch": self.views[g].cur_just[0],
+                     "finalized_epoch": self.views[g].finalized[0]}
+                    for g in range(self.n_groups)]
+            self.metrics.append(m)
+            self._emit("slot", slot=s, head_slot=s,
+                       justified_epoch=self.views[0].cur_just[0],
+                       finalized_epoch=self.views[0].finalized[0])
         if self.supervision is not None:
-            self.supervision.tick(self, s, self._checkpoint_async_capture)
+            with pt.phase("checkpoint_capture"):
+                self.supervision.tick(self, s,
+                                      self._checkpoint_async_capture)
+        pt.end_slot(s)
 
     def run_epochs(self, n_epochs: int) -> None:
         """Run through the first slot of epoch ``n_epochs`` (inclusive),
@@ -848,6 +898,8 @@ class DenseSimulation:
             out["monitor_violations"] = len(self.monitor_violations)
             out["violation_kinds"] = sorted(
                 {v["kind"] for v in self.monitor_violations})
+        if self.phases.enabled:
+            out["dense_phases"] = self.phases.summary()
         return out
 
     # -- checkpoint / resume (gather -> host -> re-shard) ----------------------
@@ -967,7 +1019,14 @@ class DenseSimulation:
         writer thread gets to it (the captured host copies are frozen —
         the loop mutating ``self`` no longer races the write)."""
         meta, cols = self._checkpoint_capture()
-        return lambda: self._checkpoint_serialize(meta, cols)
+
+        def job():
+            t0 = time.perf_counter()
+            data = self._checkpoint_serialize(meta, cols)
+            self.phases.charge_async("checkpoint_serialize",
+                                     time.perf_counter() - t0)
+            return data
+        return job
 
     @classmethod
     def resume(cls, data: bytes, mesh=None,

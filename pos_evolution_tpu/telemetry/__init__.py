@@ -35,9 +35,14 @@ from dataclasses import dataclass, field
 from pos_evolution_tpu.telemetry.events import (
     SCHEMA_VERSION,
     EventBus,
+    discover_per_process,
+    merge_event_files,
+    per_process_path,
     read_jsonl,
 )
+from pos_evolution_tpu.telemetry.fleet import FleetAggregator
 from pos_evolution_tpu.telemetry.registry import (
+    SNAPSHOT_VERSION,
     Counter,
     Gauge,
     Histogram,
@@ -45,8 +50,10 @@ from pos_evolution_tpu.telemetry.registry import (
 )
 
 __all__ = [
-    "SCHEMA_VERSION", "EventBus", "read_jsonl",
+    "SCHEMA_VERSION", "SNAPSHOT_VERSION", "EventBus", "read_jsonl",
+    "per_process_path", "discover_per_process", "merge_event_files",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "FleetAggregator",
     "Telemetry", "set_global", "get_global", "emit_global",
 ]
 

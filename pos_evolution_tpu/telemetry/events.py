@@ -165,3 +165,91 @@ def read_jsonl(path: str | os.PathLike) -> list[dict]:
     events = read_versioned_jsonl(path, SCHEMA_VERSION, label="event")
     events.sort(key=lambda e: e.get("seq", 0))
     return events
+
+
+# -- multi-process event logs (ISSUE 18) ---------------------------------------
+#
+# Two processes appending to ONE EventBus file interleave partial lines
+# whenever a write straddles a pipe buffer — the old plane only survived
+# because workers reopened the file per emission and wrote short lines.
+# The supported shape is one file per process: ``per_process_path``
+# derives ``events.<pid>.jsonl`` from the logical log path, each process
+# owns its file exclusively, and ``merge_event_files`` re-sequences the
+# union for the offline consumers.
+
+def per_process_path(path: str | os.PathLike,
+                     pid: int | None = None) -> str:
+    """``/run/events.jsonl`` -> ``/run/events.<pid>.jsonl``. Appending
+    the pid BEFORE the final suffix keeps the ``.jsonl`` extension so
+    every existing glob/tooling convention still matches."""
+    path = os.fspath(path)
+    pid = os.getpid() if pid is None else int(pid)
+    root, ext = os.path.splitext(path)
+    return f"{root}.{pid}{ext or '.jsonl'}"
+
+
+def discover_per_process(path: str | os.PathLike) -> list[str]:
+    """Sibling ``events.<pid>.jsonl`` files of a logical log path,
+    sorted by pid — what ``scripts/run_report.py`` auto-merges."""
+    path = os.fspath(path)
+    directory = os.path.dirname(os.path.abspath(path))
+    base = os.path.basename(path)
+    root, ext = os.path.splitext(base)
+    found = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        if not (name.startswith(root + ".") and name.endswith(ext)):
+            continue
+        middle = name[len(root) + 1:len(name) - len(ext)]
+        if middle.isdigit():
+            found.append((int(middle), os.path.join(directory, name)))
+    return [p for _, p in sorted(found)]
+
+
+def merge_event_files(paths, out_path: str | os.PathLike | None = None
+                      ) -> list[dict]:
+    """Merge per-process event logs into one stream, re-sequenced by
+    ``(wall, seq, source order)`` — wall when the emitter stamped one
+    (cross-process ordering needs a shared clock; per-bus ``seq`` only
+    orders within one process), falling back to ``seq`` so single-file
+    merges keep their original order. The merged events get fresh
+    contiguous ``seq`` ordinals; the original ordinal survives as
+    ``src_seq`` and the source pid (parsed from the filename) as
+    ``src_pid``, so lineage back to the per-process file is never lost.
+
+    ``out_path`` additionally writes the merged stream as JSONL (the
+    shape every existing consumer reads)."""
+    rows = []
+    for order, path in enumerate(paths):
+        pid = None
+        root = os.path.splitext(os.path.basename(os.fspath(path)))[0]
+        tail = root.rsplit(".", 1)[-1]
+        if tail.isdigit():
+            pid = int(tail)
+        # events between wall-stamped ones inherit the last stamp seen
+        # (carry-forward): per-file seq order is preserved exactly, and
+        # cross-file interleave happens at wall-clock granularity
+        last_wall = 0.0
+        for ev in read_versioned_jsonl(path, SCHEMA_VERSION,
+                                       label="event"):
+            wall = ev.get("wall")
+            if wall is not None:
+                last_wall = max(last_wall, float(wall))
+            rows.append(((last_wall, order, ev.get("seq", 0)), pid, ev))
+    rows.sort(key=lambda r: r[0])
+    merged = []
+    for seq, (_, pid, ev) in enumerate(rows):
+        ev = dict(ev)
+        ev["src_seq"] = ev.get("seq", 0)
+        if pid is not None:
+            ev["src_pid"] = pid
+        ev["seq"] = seq
+        merged.append(ev)
+    if out_path is not None:
+        with open(os.fspath(out_path), "w") as fh:
+            for ev in merged:
+                fh.write(json.dumps(ev, sort_keys=True) + "\n")
+    return merged

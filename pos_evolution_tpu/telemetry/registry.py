@@ -25,6 +25,11 @@ import json
 import threading
 from bisect import bisect_left
 
+# Cross-process snapshot format (``snapshot()`` / ``merge_snapshot()``):
+# bumped only when the shape changes incompatibly — readers refuse
+# unknown versions instead of misfolding a future format.
+SNAPSHOT_VERSION = 1
+
 
 def _label_key(labels: dict) -> tuple:
     return tuple(sorted(labels.items()))
@@ -212,6 +217,92 @@ class MetricsRegistry:
 
     def dumps(self) -> str:
         return json.dumps(self.to_json(), indent=1, sort_keys=True)
+
+    # -- cross-process snapshot / merge (ISSUE 18 fleet pipeline) --------------
+
+    def snapshot(self) -> dict:
+        """Schema-versioned, JSON-serializable copy of every series —
+        the unit a worker process flushes beside its heartbeat file and
+        a ``FleetAggregator`` merges back. Unlike ``to_json`` this holds
+        each metric's lock while copying, so a concurrent ``observe_n``
+        can never leave a torn histogram row (bucket counts from one
+        batch, ``count`` from another) in the snapshot."""
+        metrics: dict[str, dict] = {}
+        for name, m in sorted(self._metrics.items()):
+            with m._lock:
+                if m.kind == "histogram":
+                    series = [{"labels": dict(key),
+                               "bucket_counts": list(row["bucket_counts"]),
+                               "sum": row["sum"], "count": row["count"]}
+                              for key, row in sorted(m.series.items())]
+                else:
+                    series = [{"labels": dict(key), "value": val}
+                              for key, val in sorted(m.series.items())]
+            entry = {"kind": m.kind, "help": m.help, "series": series}
+            if m.kind == "histogram":
+                entry["buckets"] = list(m.buckets)
+            metrics[name] = entry
+        return {"v": SNAPSHOT_VERSION, "metrics": metrics}
+
+    def merge_snapshot(self, snap: dict, extra_labels: dict | None = None
+                       ) -> None:
+        """Fold one ``snapshot()`` emission into this registry,
+        optionally tagging every series with ``extra_labels`` (the fleet
+        aggregator passes ``{"worker": "<id>"}`` so per-worker series
+        stay distinguishable after the merge). Counters and histogram
+        rows ADD — merging two snapshots of the same worker double
+        counts, by design the caller's problem; gauges are last-write-
+        wins, matching their single-registry semantics."""
+        if snap.get("v") != SNAPSHOT_VERSION:
+            raise ValueError(
+                f"unknown metrics snapshot version {snap.get('v')!r} "
+                f"(this reader understands v{SNAPSHOT_VERSION})")
+        extra = extra_labels or {}
+        for name, entry in sorted((snap.get("metrics") or {}).items()):
+            kind = entry.get("kind")
+            if kind == "counter":
+                c = self.counter(name, entry.get("help", ""))
+                for row in entry.get("series", ()):
+                    c.inc(row.get("value", 0),
+                          **{**row.get("labels", {}), **extra})
+            elif kind == "gauge":
+                g = self.gauge(name, entry.get("help", ""))
+                for row in entry.get("series", ()):
+                    g.set(row.get("value", 0),
+                          **{**row.get("labels", {}), **extra})
+            elif kind == "histogram":
+                bounds = tuple(entry.get("buckets", _DEFAULT_BUCKETS))
+                h = self.histogram(name, entry.get("help", ""),
+                                   buckets=bounds)
+                for row in entry.get("series", ()):
+                    labels = {**row.get("labels", {}), **extra}
+                    if h.buckets == tuple(sorted(bounds)):
+                        key = _label_key(labels)
+                        with h._lock:
+                            dst = h.series.get(key)
+                            if dst is None:
+                                dst = {"bucket_counts":
+                                       [0] * len(h.buckets),
+                                       "sum": 0.0, "count": 0}
+                                h.series[key] = dst
+                            src = row.get("bucket_counts", ())
+                            for i, n in enumerate(src[:len(h.buckets)]):
+                                dst["bucket_counts"][i] += n
+                            dst["sum"] += row.get("sum", 0.0)
+                            dst["count"] += row.get("count", 0)
+                    else:
+                        # bucket bounds drifted between emitter and
+                        # merger (mixed code versions): degrade to
+                        # re-observing each bucket at its upper bound —
+                        # totals stay exact, bucket placement approximate
+                        srcb = sorted(bounds)
+                        counts = list(row.get("bucket_counts", ()))
+                        for le, n in zip(srcb, counts):
+                            if n:
+                                h.observe_n(le, n, **labels)
+                        over = row.get("count", 0) - sum(counts)
+                        if over > 0 and srcb:  # +Inf-bucket residue
+                            h.observe_n(srcb[-1] * 2, over, **labels)
 
     def counts(self) -> dict[str, int | float]:
         """Flatten all counters (and histogram counts) into one

@@ -1,0 +1,205 @@
+"""Observability self-bench: phase-budget coverage + profiler overhead.
+
+ISSUE 18 leg c ships a per-slot phase profiler inside
+``DenseSimulation.run_slot`` (``profiling/phases.py``). This bench is
+the acceptance harness for its TWO promises, which pull in opposite
+directions:
+
+- **coverage** — at sampled (device-fenced) slots the phase taxonomy
+  must account for >= 95% of the slot wall, or the budget is decoration
+  (``--min-accounted`` gates it);
+- **cheapness** — at steady state (unfenced slots: two clock reads and
+  a dict add per phase) the instrumented loop must cost < a few percent
+  over a genuinely uninstrumented twin, or nobody leaves it on
+  (``--max-overhead`` gates it; off by default because one-shot CPU-CI
+  walls are noisy — the acceptance run passes 5).
+
+Three runs, same seed and shape:
+
+1. **budget**: ``phase_profile=--sample-every`` with a live telemetry
+   bundle — emits ``dense_phase`` events (the ``scripts/run_report.py``
+   "Dense phase budget" section reads these via ``--events``) and the
+   ``dense_phase_ms`` histogram, and yields ``accounted_pct``. Also
+   warms every jit cache so the timed pair below never pays compile;
+2. **twin**: ``phase_profile=None`` — threads ``NULL_TIMER``, the
+   genuinely uninstrumented loop;
+3. **steady**: ``phase_profile=n_slots+1`` — the instrumented loop in
+   which only slot 0 ever fences, i.e. the leave-it-on configuration.
+
+``overhead_pct = (steady_wall - twin_wall) / twin_wall``; with
+``--repeats N`` the twin/steady timings interleave and the minimum wall
+of each wins (adjacent runs see the same box noise).
+
+The emission (``metric: bench_obs``) lands in ``bench_history.jsonl``
+as ``kind=bench_obs``; ``scripts/perf_gate.py --kind bench_obs`` bands
+the ``counts`` leaves (slots, sampled slots, per-phase row counts —
+deterministic properties of the instrumented path, unlike this box's
+walls), so a phase that silently stops recording fails CI. The
+doctored (x10) negative is pinned in the obs-smoke job.
+
+Usage:
+    python scripts/bench_obs.py [--validators 256] [--epochs 2]
+        [--slots-per-epoch 8] [--sample-every 8] [--seed 0]
+        [--repeats 1] [--min-accounted 95] [--max-overhead 5]
+        [--json out.json] [--history bench_history.jsonl]
+        [--events events.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build(args, phase_profile, telemetry=None):
+    from pos_evolution_tpu.config import mainnet_config
+    from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+    cfg = mainnet_config().replace(slots_per_epoch=args.slots_per_epoch)
+    return DenseSimulation(
+        args.validators, cfg=cfg, mesh=None, seed=args.seed,
+        verify_aggregates=True, check_walk_every=16,
+        telemetry=telemetry, phase_profile=phase_profile)
+
+
+def _timed_run(args, phase_profile) -> float:
+    sim = _build(args, phase_profile)
+    t0 = time.perf_counter()
+    sim.run_epochs(args.epochs)
+    return time.perf_counter() - t0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--validators", type=int, default=256)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--slots-per-epoch", type=int, default=8)
+    ap.add_argument("--sample-every", type=int, default=8,
+                    help="fence every N-th slot in the budget run")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--repeats", type=int, default=1,
+                    help="twin/steady timing pairs; min wall of each wins")
+    ap.add_argument("--min-accounted", type=float, default=None,
+                    help="exit 1 unless the sampled budget accounts for "
+                         "at least this %% of the slot wall")
+    ap.add_argument("--max-overhead", type=float, default=None,
+                    help="exit 1 if steady-state instrumentation costs "
+                         "more than this %% over the uninstrumented twin")
+    ap.add_argument("--json", help="write the bench_obs emission here")
+    ap.add_argument("--history",
+                    help="append the emission to this bench_history.jsonl")
+    ap.add_argument("--events",
+                    help="write the budget run's telemetry JSONL here "
+                         "(dense_phase events for run_report.py)")
+    args = ap.parse_args(argv)
+
+    from pos_evolution_tpu.telemetry import Telemetry
+
+    import jax
+
+    n_slots = args.epochs * args.slots_per_epoch
+
+    # 1. budget run: fenced sampling + events; doubles as the jit warmer
+    if args.events:
+        os.makedirs(os.path.dirname(os.path.abspath(args.events)),
+                    exist_ok=True)
+        telemetry = Telemetry.to_file(args.events)
+    else:
+        telemetry = Telemetry()
+    sim = _build(args, args.sample_every, telemetry=telemetry)
+    t0 = time.perf_counter()
+    sim.run_epochs(args.epochs)
+    budget_wall = time.perf_counter() - t0
+    phases = sim.phases.summary()
+    accounted = phases.get("accounted_pct")
+    dense_phase_events = len(telemetry.bus.of_type("dense_phase"))
+    telemetry.close()
+
+    # 2/3. uninstrumented twin vs steady-state (slot 0 alone fences) —
+    # interleaved so both sides of each pair share the box's mood
+    twin_wall = steady_wall = float("inf")
+    for _ in range(max(args.repeats, 1)):
+        twin_wall = min(twin_wall, _timed_run(args, None))
+        steady_wall = min(steady_wall, _timed_run(args, n_slots + 1))
+    overhead_pct = (100.0 * (steady_wall - twin_wall) / twin_wall
+                    if twin_wall > 0 else None)
+
+    sampled = phases.get("sampled_phases") or {}
+    counts = {
+        "slots": phases.get("slots"),
+        "sampled_slots": phases.get("sampled_slots"),
+        "dense_phase_events": dense_phase_events,
+        "phases_recorded": len(sampled),
+    }
+    for name, row in sampled.items():
+        counts[f"phase_rows;phase={name}"] = row.get("count")
+
+    print(f"dense obs bench @ {args.validators} validators x "
+          f"{n_slots} slots, jax backend = {jax.default_backend()}")
+    print(f"  budget run   : {budget_wall * 1e3:9.2f} ms wall, "
+          f"{phases.get('sampled_slots')} fenced slot(s), "
+          f"accounted {accounted}%")
+    print(f"  twin         : {twin_wall * 1e3:9.2f} ms wall "
+          f"(uninstrumented)")
+    print(f"  steady       : {steady_wall * 1e3:9.2f} ms wall "
+          f"(instrumented, unfenced) -> overhead "
+          f"{overhead_pct:+.2f}%")
+    top = sorted(((row.get("total_ms", 0), name)
+                  for name, row in sampled.items()), reverse=True)[:5]
+    for ms, name in top:
+        print(f"    {name:<22} {ms:9.2f} ms "
+              f"({sampled[name].get('share_pct')}%)")
+
+    emission = {
+        "metric": "bench_obs",
+        "validators": args.validators,
+        "slots": n_slots,
+        "sample_every": args.sample_every,
+        "jax_backend": jax.default_backend(),
+        "accounted_pct": accounted,
+        "overhead_pct": (round(overhead_pct, 3)
+                         if overhead_pct is not None else None),
+        "walls": {
+            "budget_ms": round(budget_wall * 1e3, 3),
+            "twin_ms": round(twin_wall * 1e3, 3),
+            "steady_ms": round(steady_wall * 1e3, 3),
+        },
+        "phases": sampled,
+        "async_phases": phases.get("async_phases"),
+        "counts": counts,
+    }
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(emission, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"emission -> {args.json}")
+    if args.history:
+        from pos_evolution_tpu.profiling import history
+        history.append_entry(args.history, emission, kind="bench_obs")
+        print(f"history  -> {args.history} (kind=bench_obs)")
+    if args.events:
+        print(f"events   -> {args.events} "
+              f"({dense_phase_events} dense_phase events; "
+              f"next: python scripts/run_report.py {args.events})")
+
+    ok = True
+    if args.min_accounted is not None and \
+            (accounted is None or accounted < args.min_accounted):
+        print(f"FAIL: sampled budget accounts for {accounted}% of the "
+              f"slot wall < required {args.min_accounted}%",
+              file=sys.stderr)
+        ok = False
+    if args.max_overhead is not None and overhead_pct is not None \
+            and overhead_pct > args.max_overhead:
+        print(f"FAIL: steady-state overhead {overhead_pct:.2f}% > "
+              f"allowed {args.max_overhead}%", file=sys.stderr)
+        ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

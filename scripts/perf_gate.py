@@ -40,7 +40,12 @@ entries of a ``profiling/history.py`` time-series — a count metric fails
 only when it exceeds ``median + max(mad_k · 1.4826 · MAD, abs_slack)``
 of its own recent history, so one noisy run neither poisons the band
 nor slips a slow drift through. Empty history passes vacuously
-(loudly); a history whose counts share no keys with the candidate is
+(loudly) — EXCEPT when ``--kind`` was requested explicitly and the
+filter matched nothing: an emission family the caller named that has
+never emitted is a typo or a CI wiring error, and a vacuous pass there
+would disable the gate forever without anyone noticing — that refuses
+with exit 2. (``--list-kinds`` prints what the history actually
+holds.) A history whose counts share no keys with the candidate is
 incomparable and refuses with exit 2, same as baseline mode.
 
 **Outlier quarantine** (``--max-abs-ratio R``, default off): MAD bands
@@ -64,6 +69,7 @@ Usage:
         [--count-only] [--strict-timing]
         [--history bench_history.jsonl] [--window 20] [--mad-k 4.0]
         [--kind bench] [--max-abs-ratio 8.0]
+    python scripts/perf_gate.py --history bench_history.jsonl --list-kinds
 
 ``--baseline`` defaults to the newest ``BENCH_r*.json`` /
 ``BENCH_ALL_r*.json`` in the repo root, falling back to
@@ -282,6 +288,17 @@ def gate_history(history_path: str, candidate: dict, window: int,
         return 2
     if kind is not None:
         entries = [e for e in entries if e.get("kind") == kind]
+        if not entries:
+            # the caller NAMED this family: a filter that matches
+            # nothing is a typo or a CI wiring error, and a vacuous
+            # pass here would silently disable the gate forever
+            print(f"history {history_path}: zero entries of kind "
+                  f"{kind!r} — an explicitly requested emission family "
+                  f"with no history is a typo or a wiring error, not a "
+                  f"clean slate. Run --list-kinds to see what the "
+                  f"history holds; refusing to gate vacuously.",
+                  file=out)
+            return 2
     else:
         # an entry with no "kind" sorts as None — key it explicitly or
         # sorted() raises TypeError instead of the deliberate exit 2
@@ -380,8 +397,9 @@ def gate_history(history_path: str, candidate: dict, window: int,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--candidate", required=True,
-                    help="fresh bench/report JSON emission")
+    ap.add_argument("--candidate",
+                    help="fresh bench/report JSON emission (required "
+                         "for gating; optional with --list-kinds)")
     ap.add_argument("--baseline",
                     help="baseline emission (default: newest BENCH_*.json, "
                          "else BASELINE.json)")
@@ -409,7 +427,34 @@ def main(argv=None) -> int:
                          "the median of the other entries by more than this "
                          "factor (default: off — contaminated entries are "
                          "only absorbed by the MAD band, silently)")
+    ap.add_argument("--list-kinds", action="store_true",
+                    help="print the emission kinds (and entry counts) a "
+                         "--history file holds, then exit — the lookup "
+                         "for a --kind refusal")
     args = ap.parse_args(argv)
+
+    if args.list_kinds:
+        if not args.history:
+            print("perf_gate: --list-kinds requires --history",
+                  file=sys.stderr)
+            return 2
+        from pos_evolution_tpu.profiling import history as hist
+        try:
+            entries = hist.read_history(args.history)
+        except (OSError, ValueError) as e:
+            print(f"perf_gate: history unreadable: {e}", file=sys.stderr)
+            return 2
+        by_kind: dict[str, int] = {}
+        for e in entries:
+            k = e.get("kind") or "(none)"
+            by_kind[k] = by_kind.get(k, 0) + 1
+        print(f"history: {args.history} ({len(entries)} "
+              f"entr{'y' if len(entries) == 1 else 'ies'})")
+        for k in sorted(by_kind):
+            print(f"  {k}: {by_kind[k]}")
+        return 0
+    if not args.candidate:
+        ap.error("--candidate is required (except with --list-kinds)")
 
     if args.history:
         try:

@@ -20,6 +20,10 @@ Reconstructs, from the event log alone (no live ``Simulation``):
   coalesced unique fetches, per-request p50/p95 serving latency,
   proof-path cache hit rate and verification failures, aggregated from
   the per-block ``das_serve`` events (``das/server.py``);
+- the **dense phase budget** — ISSUE 18's per-slot breakdown of
+  ``DenseSimulation.run_slot`` from the sampled (device-fenced)
+  ``dense_phase`` events: per-phase totals + share of the sampled slot
+  wall, and the accounted percentage the CI smoke pins at >= 95%;
 - **serving** — the live RPC tier's traffic story from ``serve_attach``
   / ``serve_summary`` events (``pos_evolution_tpu/serve/``): per-tier
   p50/p99/p999, goodput vs. shed rate with shed reasons, hedges and
@@ -43,6 +47,15 @@ Reconstructs, from the event log alone (no live ``Simulation``):
   ``--cost`` lands under ``cost_analysis`` (per-kernel FLOPs / bytes /
   peak memory next to the observed timeline).
 
+Multi-process runs (``serve/harness.py`` with ``events_bus`` fan-out, or
+any ISSUE 18 per-process ``EventBus``) write sibling
+``events.<pid>.jsonl`` files instead of sharing one log. The report
+auto-discovers those next to the given path and merges them with
+``telemetry.merge_event_files`` (re-sequenced by wall clock, source pid
+preserved as ``src_pid``) — pass the LOGICAL path
+(``.../events.jsonl``); it does not need to exist when per-pid siblings
+do.
+
 Usage:
     python scripts/run_report.py events.jsonl [--json out.json]
                                  [--markdown out.md] [--top-ops top_ops.json]
@@ -61,6 +74,24 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pos_evolution_tpu.telemetry import read_jsonl  # noqa: E402
+from pos_evolution_tpu.telemetry.events import (  # noqa: E402
+    discover_per_process,
+    merge_event_files,
+)
+
+
+def load_events(events_path: str) -> tuple[list[dict], list[str]]:
+    """Events for a logical log path: the file itself when it stands
+    alone, the merged union when per-process ``events.<pid>.jsonl``
+    siblings exist (both when the logical file is also present — a
+    harness that wrote its own lines next to its workers' files).
+    Returns (events, merged_source_paths)."""
+    per_proc = discover_per_process(events_path)
+    if not per_proc:
+        return read_jsonl(events_path), []
+    paths = ([events_path] if os.path.exists(events_path) else []) \
+        + per_proc
+    return merge_event_files(paths), paths
 
 
 def _percentile(xs: list[float], q: float) -> float:
@@ -333,6 +364,8 @@ def build_report(events: list[dict], top_ops: dict | None = None,
                 "lost": verdict.get("lost"),
                 "verified_proofs": verdict.get("verified_proofs"),
                 "verify_failures": verdict.get("verify_failures"),
+                "traced": (result.get("load") or {}).get("traced"),
+                "fleet_consistent": verdict.get("fleet_consistent"),
                 "ok": verdict.get("ok"),
             }
 
@@ -358,6 +391,50 @@ def build_report(events: list[dict], top_ops: dict | None = None,
             "respawned_on_current_generation":
                 ((chaos_r or steady_r).get("verdict") or {}).get(
                     "respawned_on_current_generation"),
+        }
+        # ISSUE 18 fleet metrics: the scraped FleetAggregator summary
+        # rides the phase result, the consistency verdict rides the
+        # phase verdict — the chaos phase (when run) is the story
+        fl_verdict = (chaos_r or steady_r).get("verdict") or {}
+        fl_raw = (chaos_r or steady_r).get("fleet") or {}
+        if fl_raw or fl_verdict.get("fleet_requests_by_worker") \
+                is not None:
+            serving_mp["fleet"] = {
+                "workers_reporting":
+                    fl_verdict.get("fleet_workers_reporting"),
+                "requests_by_worker":
+                    fl_verdict.get("fleet_requests_by_worker")
+                    or fl_raw.get("requests_by_worker"),
+                "requests_total":
+                    fl_verdict.get("fleet_requests_total"),
+                "window": fl_verdict.get("fleet_window"),
+                "consistent": fl_verdict.get("fleet_consistent"),
+                "snapshots_merged": fl_raw.get("snapshots_merged"),
+                "snapshots_skipped": fl_raw.get("snapshots_skipped"),
+            }
+
+    # -- dense phase budget (profiling/phases.py dense_phase events) ----------
+    dense_ph = by_type.get("dense_phase", [])
+    dense_budget = None
+    if dense_ph:
+        ph_totals: dict[str, float] = {}
+        sampled_wall = 0.0
+        for e in dense_ph:
+            sampled_wall += float(e.get("wall_ms") or 0.0)
+            for name, ms in (e.get("phases") or {}).items():
+                ph_totals[name] = ph_totals.get(name, 0.0) + float(ms)
+        accounted = sum(ph_totals.values())
+        dense_budget = {
+            "sampled_slots": len(dense_ph),
+            "sampled_wall_ms": round(sampled_wall, 3),
+            "phases": {
+                name: {"total_ms": round(ms, 3),
+                       "share_pct": (round(100.0 * ms / sampled_wall, 2)
+                                     if sampled_wall > 0 else None)}
+                for name, ms in sorted(ph_totals.items(),
+                                       key=lambda kv: -kv[1])},
+            "accounted_pct": (round(100.0 * accounted / sampled_wall, 2)
+                              if sampled_wall > 0 else None),
         }
 
     # -- resilience (resilience/ checkpoint + supervisor events) --------------
@@ -508,6 +585,8 @@ def build_report(events: list[dict], top_ops: dict | None = None,
         report["serving"] = serving
     if serving_mp:
         report["serving_mp"] = serving_mp
+    if dense_budget:
+        report["dense_phase_budget"] = dense_budget
     if merkleization:
         report["merkleization"] = merkleization
     if das_serving:
@@ -523,7 +602,8 @@ def build_report(events: list[dict], top_ops: dict | None = None,
     if profiles:
         report["profiles"] = [
             {k: p.get(k) for k in ("name", "by_jit", "attribution",
-                                   "trace_dir", "error") if k in p}
+                                   "by_shard_map", "trace_dir", "error")
+             if k in p}
             for p in profiles]
     return report
 
@@ -787,6 +867,35 @@ def to_markdown(report: dict) -> str:
         md.append(f"- respawned workers on current shared-memory "
                   f"generation: "
                   f"{'**yes**' if regen else '**NO — silent fork**'}")
+        fl = s.get("fleet")
+        if fl:
+            lohi = fl.get("window") or [None, None]
+            md += ["", "### Fleet metrics", ""]
+            md.append(f"- workers reporting: "
+                      f"**{fl.get('workers_reporting')}** "
+                      f"({fl.get('snapshots_merged')} snapshots merged, "
+                      f"{fl.get('snapshots_skipped')} skipped)")
+            if fl.get("requests_by_worker"):
+                md += ["", *_md_table(
+                    ["worker", "requests (fleet counter)"],
+                    [[w, int(n)] for w, n in sorted(
+                        (fl["requests_by_worker"] or {}).items(),
+                        key=lambda kv: int(kv[0]))]), ""]
+            verdict = ("**consistent**" if fl.get("consistent")
+                       else "**INCONSISTENT**")
+            md.append(f"- fleet total {fl.get('requests_total')} vs "
+                      f"loadgen window [{lohi[0]}, {lohi[1]}]: {verdict}")
+
+    if report.get("dense_phase_budget"):
+        d = report["dense_phase_budget"]
+        md += ["", "## Dense phase budget", ""]
+        md.append(f"- accounted: **{d.get('accounted_pct')}%** of the "
+                  f"sampled slot wall ({d.get('sampled_wall_ms')} ms over "
+                  f"{d.get('sampled_slots')} fenced slot(s))")
+        md += ["", *_md_table(
+            ["phase", "total ms", "share %"],
+            [[name, row.get("total_ms"), row.get("share_pct")]
+             for name, row in (d.get("phases") or {}).items()])]
 
     if report.get("das_serving"):
         d = report["das_serving"]
@@ -853,6 +962,14 @@ def to_markdown(report: dict) -> str:
                 md += _md_table(["span / kernel", "total ms", "ops"],
                                 [[k, v.get("total_ms"), v.get("count")]
                                  for k, v in rows])
+            sm = p.get("by_shard_map") or {}
+            if sm:
+                rows = sorted(sm.items(),
+                              key=lambda kv: -kv[1].get("total_ms", 0))
+                md += ["", "shard_map regions:", "", *_md_table(
+                    ["shard_map region", "total ms", "ops"],
+                    [[k, v.get("total_ms"), v.get("count")]
+                     for k, v in rows])]
             md.append("")
 
     if report.get("cost_analysis"):
@@ -889,7 +1006,11 @@ def main(argv=None) -> int:
                          "next to a violations.json)")
     args = ap.parse_args(argv)
 
-    events = read_jsonl(args.events)
+    events, merged_from = load_events(args.events)
+    if merged_from:
+        print(f"# merged {len(merged_from)} per-process event logs: "
+              + ", ".join(os.path.basename(p) for p in merged_from),
+              file=sys.stderr)
     top_ops_path = args.top_ops or discover_top_ops(args.events, events)
     if args.top_ops is None and top_ops_path is not None:
         print(f"# auto-discovered top-ops table: {top_ops_path}",

@@ -34,8 +34,15 @@ driven 10x harder (20000/s default) while seeded chaos SIGKILLs
 workers, wedges heartbeats, and exhausts fds. The emission kind becomes
 ``bench_serve_mp`` and the run fails unless the harness verdict is ok:
 goodput >= 99%, p99 inside the SLO, zero verify failures, every kill
-and wedge detected, and every respawned worker on the current
-shared-memory generation.
+and wedge detected, every respawned worker on the current
+shared-memory generation, and (since ISSUE 18) the fleet metrics
+scraped off the ``metrics`` RPC consistent with the loadgen's ledger.
+
+Under ``--mp``, ``--trace-rate``/``--trace-dir`` switch on end-to-end
+request tracing (per-process span files, merged into one Chrome trace
+by ``scripts/trace_merge.py``) and ``--metrics-out`` saves the fleet
+Prometheus text scraped off the admission-exempt ``metrics`` RPC —
+the ``obs-smoke`` CI artifacts.
 """
 
 from __future__ import annotations
@@ -44,6 +51,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -144,12 +152,19 @@ def _main_mp(args, telemetry) -> int:
                 "wedges": args.wedges, "fd_exhaust": args.fd_exhaust}
                if chaos_on else None))
 
+    trace_dir = None
+    if args.trace_rate > 0:
+        trace_dir = args.trace_dir or tempfile.mkdtemp(
+            prefix="serve_mp_trace_")
+        os.makedirs(trace_dir, exist_ok=True)
+
     # phase 1: steady state at the headline rate — the SLO phase
     steady = run_mp_scenario(
         n_fronts=args.fronts, workers_per_front=args.workers_per_front,
         arrivals=args.arrivals, rate=args.rate, seed=args.seed,
         kills=0, wedges=0, fd_exhaust_n=0, slo_ms=args.slo_ms,
-        events_bus=telemetry.bus)
+        events_bus=telemetry.bus,
+        trace_rate=args.trace_rate, trace_dir=trace_dir)
     _phase_line("steady", steady)
     s_verdict = steady["verdict"]
 
@@ -164,7 +179,8 @@ def _main_mp(args, telemetry) -> int:
             arrivals=args.chaos_arrivals, rate=args.chaos_rate,
             seed=args.seed, kills=args.kills, wedges=args.wedges,
             fd_exhaust_n=args.fd_exhaust, slo_ms=args.slo_ms,
-            events_bus=telemetry.bus)
+            events_bus=telemetry.bus,
+            trace_rate=args.trace_rate, trace_dir=trace_dir)
         _phase_line("chaos ", chaos)
         c_verdict = chaos["verdict"]
         print(f"pool:  {c_verdict['kills_delivered']} SIGKILLs "
@@ -215,7 +231,28 @@ def _main_mp(args, telemetry) -> int:
             "verify_failures": failures,
         },
         "board_generation": steady["board_generation"],
+        "fleet": {
+            "workers_reporting":
+                s_verdict.get("fleet_workers_reporting"),
+            "requests_by_worker":
+                s_verdict.get("fleet_requests_by_worker"),
+            "requests_total": s_verdict.get("fleet_requests_total"),
+            "consistent": s_verdict.get("fleet_consistent"),
+        },
     }
+    if trace_dir is not None:
+        emission["traced"] = (steady["load"].get("traced", 0)
+                              + (chaos["load"].get("traced", 0)
+                                 if chaos else 0))
+        emission["trace_dir"] = trace_dir
+        print(f"traces   -> {trace_dir}\n  next: "
+              f"python scripts/trace_merge.py {trace_dir}")
+    if args.metrics_out:
+        prom = (chaos or steady).get("fleet_prometheus")
+        if prom:
+            with open(args.metrics_out, "w") as fh:
+                fh.write(prom)
+            print(f"metrics  -> {args.metrics_out}")
     if chaos is not None:
         c_inter = chaos["load"]["tiers"]["interactive"]
         c_bulk = chaos["load"]["tiers"]["bulk"]
@@ -289,6 +326,16 @@ def main(argv=None) -> int:
                     help="[--mp] chaos-phase arrival rate — the rate "
                          "the surviving workers must hold while their "
                          "peers are killed, wedged, and respawned")
+    ap.add_argument("--trace-rate", type=float, default=0.0,
+                    help="[--mp] seeded fraction of arrivals carrying "
+                         "an end-to-end trace id (0 = tracing off)")
+    ap.add_argument("--trace-dir",
+                    help="[--mp] directory for per-process span files "
+                         "(default: a fresh temp dir when --trace-rate "
+                         "> 0); merge with scripts/trace_merge.py")
+    ap.add_argument("--metrics-out",
+                    help="[--mp] write the fleet Prometheus text scraped "
+                         "off the metrics RPC here")
     ap.add_argument("--events", help="telemetry JSONL output path")
     ap.add_argument("--json", help="write the bench emission here")
     ap.add_argument("--history",
@@ -306,8 +353,12 @@ def main(argv=None) -> int:
     with use_config(minimal_config()):
         if args.mp:
             from pos_evolution_tpu.telemetry import Telemetry
-            telemetry = (Telemetry.to_file(args.events) if args.events
-                         else Telemetry())
+            if args.events:
+                os.makedirs(os.path.dirname(
+                    os.path.abspath(args.events)), exist_ok=True)
+                telemetry = Telemetry.to_file(args.events)
+            else:
+                telemetry = Telemetry()
             return _main_mp(args, telemetry)
         from pos_evolution_tpu.serve import (
             LoadGenerator,

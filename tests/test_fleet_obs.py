@@ -1,0 +1,623 @@
+"""Fleet observability tests (ISSUE 18, DESIGN.md "Fleet observability").
+
+Covers the three legs end to end at unit scale (the mp harness test in
+``test_serve_mp.py`` covers the full plane):
+
+- the cross-process metrics pipeline: snapshot write/load/discover,
+  torn-file tolerance, the ``FleetAggregator`` merge (worker labels,
+  respawn folding, meta freshness), and the ``Histogram.observe_n``
+  vs concurrent ``snapshot()`` torn-row race;
+- the ``metrics`` RPC served from memory while the circuit breaker is
+  OPEN on a fake clock — a backing outage must not blind the fleet;
+- end-to-end tracing: seeded deterministic sampling / trace ids, the
+  ``SpanBuffer`` append-only flush contract, the client's trace-first
+  frame ordering (the byte-scan fast-path contract), and
+  ``scripts/trace_merge.py``'s pid lanes + flow arrows;
+- per-process event logs: path derivation, discovery, wall-ordered
+  merge with lineage;
+- the dense phase profiler: slot-wall partition, sampling cadence,
+  async charging, and the ``NULL_TIMER`` twin's surface;
+- ``scripts/perf_gate.py``: explicit ``--kind`` matching nothing is a
+  loud exit 2, ``--list-kinds`` inventories the history;
+- the balancer's fleet-metrics health bias.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+
+import pytest
+
+from pos_evolution_tpu.config import minimal_config, use_config
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+
+# --- snapshot files -----------------------------------------------------------
+
+def _registry_with(requests):
+    from pos_evolution_tpu.telemetry.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    c = reg.counter("serve_requests_total", "requests by status")
+    for status, n in requests.items():
+        c.inc(n, method="head", status=status)
+    reg.histogram("serve_latency_s", "latency").observe_n(0.002, 5,
+                                                          tier="0")
+    return reg
+
+
+class TestSnapshotFiles:
+    def test_write_load_discover_roundtrip(self, tmp_path):
+        from pos_evolution_tpu.telemetry import fleet
+        reg = _registry_with({"ok": 7, "error": 2})
+        path = fleet.snapshot_path(tmp_path, worker=3, pid=123)
+        assert os.path.basename(path) == "worker3.pid123.metrics.json"
+        fleet.write_snapshot(path, reg, worker=3, pid=123, front=1,
+                             generation=4)
+        blob = fleet.load_snapshot(path)
+        assert blob["worker"] == 3 and blob["pid"] == 123
+        assert blob["front"] == 1 and blob["generation"] == 4
+        assert "serve_requests_total" in blob["registry"]["metrics"]
+        # discovery sorts by (worker, pid) regardless of listdir order
+        fleet.write_snapshot(fleet.snapshot_path(tmp_path, 0, 999),
+                             reg, worker=0, pid=999)
+        fleet.write_snapshot(fleet.snapshot_path(tmp_path, 3, 45),
+                             reg, worker=3, pid=45)
+        names = [os.path.basename(p)
+                 for p in fleet.discover_snapshots(tmp_path)]
+        assert names == ["worker0.pid999.metrics.json",
+                         "worker3.pid45.metrics.json",
+                         "worker3.pid123.metrics.json"]
+
+    def test_torn_and_foreign_files_are_skipped(self, tmp_path):
+        from pos_evolution_tpu.telemetry import fleet
+        torn = tmp_path / "worker0.pid1.metrics.json"
+        torn.write_text('{"v": 1, "registry": {"met')  # killed mid-dump
+        assert fleet.load_snapshot(torn) is None
+        assert fleet.load_snapshot(tmp_path / "absent.json") is None
+        (tmp_path / "heartbeat.json").write_text("{}")  # non-snapshot
+        assert fleet.discover_snapshots(tmp_path) == [
+            str(torn)]  # name matches; load is what rejects it
+        agg = fleet.FleetAggregator.from_dir(tmp_path)
+        assert agg.snapshots_merged == 0
+        assert agg.snapshots_skipped == 1
+
+    def test_wrong_snapshot_version_is_skipped(self, tmp_path):
+        from pos_evolution_tpu.telemetry import fleet
+        p = tmp_path / "worker0.pid2.metrics.json"
+        p.write_text(json.dumps({"v": 999, "worker": 0, "pid": 2,
+                                 "registry": {"metrics": {}}}))
+        assert fleet.load_snapshot(p) is None
+
+
+class TestFleetAggregator:
+    def _snap(self, tmp_path, worker, pid, requests, **meta):
+        from pos_evolution_tpu.telemetry import fleet
+        fleet.write_snapshot(
+            fleet.snapshot_path(tmp_path, worker, pid),
+            _registry_with(requests), worker=worker, pid=pid, **meta)
+
+    def test_worker_labels_totals_and_status_split(self, tmp_path):
+        from pos_evolution_tpu.telemetry import fleet
+        self._snap(tmp_path, 0, 11, {"ok": 90, "error": 10}, front=0)
+        self._snap(tmp_path, 1, 12, {"ok": 50, "shed": 50}, front=1)
+        agg = fleet.FleetAggregator.from_dir(tmp_path)
+        assert agg.worker_totals("serve_requests_total") == {
+            "0": 100, "1": 100}
+        assert agg.fleet_total("serve_requests_total") == 200
+        by = agg.worker_status_totals("serve_requests_total")
+        assert by["0"] == {"ok": 90, "error": 10}
+        assert by["1"] == {"ok": 50, "shed": 50}
+        assert 'worker="0"' in agg.registry.to_prometheus()
+        summ = agg.summary()
+        assert summ["requests_by_worker"] == {"0": 100, "1": 100}
+        assert summ["snapshots_merged"] == 2
+
+    def test_respawned_incarnations_fold_into_one_worker(self, tmp_path):
+        from pos_evolution_tpu.telemetry import fleet
+        # the killed pid's last flush + the respawn's fresh counts ADD
+        self._snap(tmp_path, 0, 100, {"ok": 40})
+        self._snap(tmp_path, 0, 200, {"ok": 2})
+        agg = fleet.FleetAggregator.from_dir(tmp_path)
+        assert agg.worker_totals("serve_requests_total") == {"0": 42}
+
+    def test_live_blob_does_not_blank_beat_meta(self, tmp_path):
+        from pos_evolution_tpu.telemetry import fleet
+        self._snap(tmp_path, 0, 11, {"ok": 5}, front=2, generation=7)
+        agg = fleet.FleetAggregator.from_dir(tmp_path)
+        # the front's own live-registry blob: newer wall, no meta
+        agg.add({"v": 1, "worker": 0, "pid": 11, "front": None,
+                 "generation": None, "wall": time.time() + 10,
+                 "registry": _registry_with({"ok": 1}).snapshot()})
+        meta = agg.workers["0"]
+        assert meta["front"] == 2 and meta["generation"] == 7
+
+
+class TestHistogramSnapshotRace:
+    def test_observe_n_never_tears_a_snapshot_row(self):
+        """N threads batch-observing one histogram value while another
+        thread snapshots: every snapshot row must be internally
+        consistent (the value always lands in ONE bucket, so any torn
+        copy shows bucket_counts[i] != count)."""
+        from pos_evolution_tpu.telemetry.registry import MetricsRegistry
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(0.001, 1.0))
+        stop = threading.Event()
+        torn = []
+
+        def snapshotter():
+            while not stop.is_set():
+                snap = reg.snapshot()
+                for row in snap["metrics"]["h"]["series"]:
+                    if row["bucket_counts"][1] != row["count"]:
+                        torn.append(row)
+
+        def hammer():
+            for _ in range(3000):
+                h.observe_n(0.5, 3, tier="0")
+
+        snap_t = threading.Thread(target=snapshotter)
+        snap_t.start()
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        stop.set()
+        snap_t.join(timeout=10.0)
+        assert not torn, f"torn histogram rows observed: {torn[:3]}"
+        assert h.value(tier="0")["count"] == 4 * 3000 * 3
+
+
+# --- metrics RPC during a backing outage --------------------------------------
+
+def _scrape(addr):
+    from pos_evolution_tpu.serve.protocol import recv_frame, send_frame
+    with socket.create_connection(addr, timeout=3.0) as s:
+        s.settimeout(3.0)
+        send_frame(s, {"id": 1, "method": "metrics", "params": {},
+                       "deadline_ms": 2500.0, "tier": 0})
+        return recv_frame(s)
+
+
+class TestMetricsRpcDuringOutage:
+    def test_metrics_served_from_memory_while_breaker_open(self, tmp_path):
+        """The whole point of the admission-exempt scrape: a backing
+        outage opens the breaker (fake clock pins it open), and the
+        fleet must stay observable anyway."""
+        with use_config(minimal_config()):
+            from tests.test_serve import _synthetic_view
+
+            from pos_evolution_tpu.serve import (
+                ServeChaos,
+                ServeClient,
+                ServeFront,
+                ServingState,
+            )
+            from pos_evolution_tpu.serve.admission import CircuitBreaker
+            from pos_evolution_tpu.telemetry.registry import MetricsRegistry
+            eng, root, view = _synthetic_view()
+            state = ServingState()
+            state.publish(view)
+            clock = [100.0]
+            chaos = ServeChaos(1)
+            front = ServeFront(
+                state, scheme=eng.scheme, registry=MetricsRegistry(),
+                workers=1, chaos=chaos, metrics_dir=str(tmp_path),
+                worker_id=0,
+                breaker=CircuitBreaker(failure_threshold=2,
+                                       cooldown_s=60.0,
+                                       clock=lambda: clock[0]))
+            addr = front.start()
+            try:
+                cli = ServeClient(addr, connections=1, hedge_ms=None,
+                                  max_retries=0)
+                chaos.fail_backing_for(3600.0)
+                params = {"block_root": root.hex(), "samples": [[0, 1]]}
+                for _ in range(front.breaker.failure_threshold):
+                    assert cli.request("das_cells", params,
+                                       deadline_s=0.5).status == "error"
+                assert front.breaker.state == front.breaker.OPEN
+                resp = _scrape(addr)
+                assert resp["status"] == "ok"
+                result = resp["result"]
+                assert 'worker="0"' in result["prometheus"]
+                assert "serve_requests_total" in result["prometheus"]
+                assert result["fleet"]["requests_by_worker"]["0"] > 0
+                # the fake clock never advanced: still open after serving
+                assert front.breaker.state == front.breaker.OPEN
+                cli.close()
+            finally:
+                front.stop()
+
+
+# --- tracing ------------------------------------------------------------------
+
+class TestTracingDeterminism:
+    def test_sample_is_seeded_and_stateless(self):
+        from pos_evolution_tpu.telemetry import tracing
+        draws = [tracing.sample(7, i, 0.1) for i in range(10_000)]
+        assert draws == [tracing.sample(7, i, 0.1)
+                        for i in range(10_000)]
+        frac = sum(draws) / len(draws)
+        assert 0.05 < frac < 0.2
+        assert not any(tracing.sample(7, i, 0.0) for i in range(100))
+        assert all(tracing.sample(7, i, 1.0) for i in range(100))
+        # a different seed samples a different subset
+        assert draws != [tracing.sample(8, i, 0.1)
+                        for i in range(10_000)]
+
+    def test_trace_id_deterministic_and_distinct(self):
+        from pos_evolution_tpu.telemetry import tracing
+        ids = {tracing.trace_id(7, i) for i in range(1000)}
+        assert len(ids) == 1000
+        assert tracing.trace_id(7, 42) == tracing.trace_id(7, 42)
+        assert all(len(t) == 16 for t in ids)
+
+    def test_span_buffer_append_only_flush(self, tmp_path):
+        from pos_evolution_tpu.telemetry.tracing import (
+            SpanBuffer,
+            span_filename,
+        )
+        buf = SpanBuffer(tmp_path, proc="loadgen", max_spans=3)
+        buf.add("t1", "client", 100.0, 5.0, status="ok")
+        assert buf.flush() == 1
+        buf.add("t2", "client", 101.0, 6.0)
+        buf.mark("t2", "hedge_sent")
+        assert buf.flush() == 2      # only the NEW spans append
+        assert buf.flush() == 0
+        buf.add("t3", "overflow", 102.0, 1.0)  # 4th span: dropped
+        assert buf.summary()["dropped"] == 1
+        path = tmp_path / span_filename()
+        lines = [json.loads(ln) for ln in
+                 path.read_text().splitlines()]
+        assert [s["name"] for s in lines] == ["client", "client",
+                                              "hedge_sent"]
+        assert lines[0]["proc"] == "loadgen"
+        assert lines[0]["status"] == "ok"
+
+    def test_record_span_is_noop_without_buffer_or_trace(self, tmp_path):
+        from pos_evolution_tpu.telemetry import tracing
+        old = tracing.get_buffer()
+        try:
+            tracing._BUFFER[0] = None
+            tracing.record_span("t", "x", 0.0, 1.0)  # no buffer: no-op
+            buf = tracing.install_buffer(tmp_path, proc="p")
+            tracing.record_span(None, "x", 0.0, 1.0)  # unsampled: no-op
+            assert buf.summary()["spans"] == 0
+            tracing.record_span("t", "x", 0.0, 1.0)
+            assert buf.summary()["spans"] == 1
+        finally:
+            tracing._BUFFER[0] = old
+
+
+class TestClientTraceFrame:
+    def test_traced_frame_puts_trace_member_first(self):
+        """A traced frame must not match the servers' byte-scan fast
+        path — the client pins the ``trace`` member in FRONT of the
+        envelope (protocol.py's contract)."""
+        from pos_evolution_tpu.serve.client import ServeClient
+        from pos_evolution_tpu.serve.protocol import send_frame
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        raw = []
+
+        def serve_one():
+            conn, _ = srv.accept()
+            with conn:
+                hdr = conn.recv(4, socket.MSG_WAITALL)
+                (n,) = struct.unpack(">I", hdr)
+                payload = conn.recv(n, socket.MSG_WAITALL)
+                raw.append(payload)
+                req = json.loads(payload)
+                send_frame(conn, {"id": req["id"], "status": "ok",
+                                  "result": {}})
+
+        t = threading.Thread(target=serve_one)
+        t.start()
+        try:
+            cli = ServeClient(srv.getsockname(), connections=1,
+                              hedge_ms=None, max_retries=0)
+            res = cli.request("ping", deadline_s=2.0, tier=0,
+                              trace="deadbeefdeadbeef")
+            assert res.ok
+            cli.close()
+        finally:
+            t.join(timeout=5.0)
+            srv.close()
+        assert raw and raw[0].startswith(
+            b'{"trace":{"id":"deadbeefdeadbeef","s":1}')
+
+
+class TestTraceMerge:
+    def _spans(self, tmp_path):
+        rows = [
+            # pid 10 = loadgen lane; pid 20 = worker lane
+            {"trace": "aa", "name": "client", "t0": 100.0, "dur_ms": 8.0,
+             "pid": 10, "proc": "loadgen", "tid": 0, "status": "ok"},
+            {"trace": "aa", "name": "service", "t0": 100.002,
+             "dur_ms": 5.0, "pid": 20, "proc": "worker0", "tid": 1},
+            {"trace": "bb", "name": "client", "t0": 100.01,
+             "dur_ms": 1.0, "pid": 10, "proc": "loadgen", "tid": 0},
+        ]
+        by_pid = {}
+        for r in rows:
+            by_pid.setdefault(r["pid"], []).append(r)
+        for pid, spans in by_pid.items():
+            with open(tmp_path / f"spans.{pid}.jsonl", "w") as fh:
+                for s in spans:
+                    fh.write(json.dumps(s) + "\n")
+                if pid == 20:
+                    fh.write('{"trace": "cc", "name": "to')  # torn tail
+
+    def test_pid_lanes_flows_and_rebase(self, tmp_path):
+        import trace_merge
+        self._spans(tmp_path)
+        spans = trace_merge.load_directory(tmp_path)
+        assert len(spans) == 3  # torn line skipped, never fatal
+        merged = trace_merge.merge_chrome(spans)
+        evs = merged["traceEvents"]
+        lanes = {e["pid"]: e["args"]["name"] for e in evs
+                 if e["ph"] == "M"}
+        assert lanes == {10: "loadgen", 20: "worker0"}
+        slices = [e for e in evs if e["ph"] == "X"]
+        assert min(e["ts"] for e in slices) == 0.0  # re-based to t0_min
+        # flow arrows only for the trace that crossed processes
+        flows = [e for e in evs if e["ph"] in ("s", "t", "f")]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        assert len({e["id"] for e in flows}) == 1
+        args = {e["args"]["trace"] for e in slices}
+        assert args == {"aa", "bb"}
+
+    def test_cli_expect_pids_gate(self, tmp_path, capsys):
+        import trace_merge
+        self._spans(tmp_path)
+        assert trace_merge.main([str(tmp_path), "--expect-pids", "2"]) == 0
+        assert os.path.exists(tmp_path / "merged.json")
+        assert trace_merge.main([str(tmp_path), "--expect-pids", "3"]) == 1
+        out = capsys.readouterr()
+        assert "2 processes" in out.out
+        assert "did not cross the process boundary" in out.err
+
+    def test_trace_filter(self, tmp_path):
+        import trace_merge
+        self._spans(tmp_path)
+        spans = trace_merge.load_directory(tmp_path, trace="aa")
+        assert {s["trace"] for s in spans} == {"aa"}
+
+
+# --- per-process event logs ---------------------------------------------------
+
+class TestPerProcessEvents:
+    def test_path_derivation_and_discovery(self, tmp_path):
+        from pos_evolution_tpu.telemetry import (
+            discover_per_process,
+            per_process_path,
+        )
+        logical = str(tmp_path / "events.jsonl")
+        assert per_process_path(logical, pid=42) == str(
+            tmp_path / "events.42.jsonl")
+        for pid in (300, 4, 77):
+            with open(per_process_path(logical, pid=pid), "w") as fh:
+                fh.write(json.dumps({"v": 1, "seq": 0, "type": "x",
+                                     "wall": pid}) + "\n")
+        (tmp_path / "events.notapid.jsonl").write_text("junk\n")
+        found = [os.path.basename(p)
+                 for p in discover_per_process(logical)]
+        assert found == ["events.4.jsonl", "events.77.jsonl",
+                         "events.300.jsonl"]
+
+    def test_merge_orders_by_wall_and_keeps_lineage(self, tmp_path):
+        from pos_evolution_tpu.telemetry import (
+            EventBus,
+            merge_event_files,
+            per_process_path,
+        )
+        logical = str(tmp_path / "events.jsonl")
+        with EventBus(per_process_path(logical, pid=1)) as b1:
+            b1.emit("a", wall=10.0)
+            b1.emit("b", wall=30.0)
+        with EventBus(per_process_path(logical, pid=2)) as b2:
+            b2.emit("c", wall=20.0)
+        out = str(tmp_path / "merged.jsonl")
+        merged = merge_event_files(
+            [per_process_path(logical, pid=1),
+             per_process_path(logical, pid=2)], out_path=out)
+        assert [e["type"] for e in merged] == ["a", "c", "b"]
+        assert [e["seq"] for e in merged] == [0, 1, 2]
+        assert [e["src_pid"] for e in merged] == [1, 2, 1]
+        assert merged[2]["src_seq"] == 1
+        from pos_evolution_tpu.telemetry import read_jsonl
+        assert [e["type"] for e in read_jsonl(out)] == ["a", "c", "b"]
+
+    def test_run_report_auto_merges_per_process_logs(self, tmp_path):
+        import run_report
+        from pos_evolution_tpu.telemetry import (
+            EventBus,
+            per_process_path,
+        )
+        logical = str(tmp_path / "events.jsonl")
+        with EventBus(per_process_path(logical, pid=9)) as bus:
+            bus.emit("run_start", n_validators=8)
+            bus.emit("dense_phase", slot=0, wall_ms=10.0,
+                     phases={"vote_pass": 6.0, "epoch_sweep": 3.9},
+                     accounted_pct=99.0)
+        events, merged_from = run_report.load_events(logical)
+        assert len(merged_from) == 1
+        report = run_report.build_report(events)
+        budget = report["dense_phase_budget"]
+        assert budget["sampled_slots"] == 1
+        assert budget["accounted_pct"] == 99.0
+        md = run_report.to_markdown(report)
+        assert "## Dense phase budget" in md
+        assert "**99.0%**" in md
+
+
+# --- dense phase profiler -----------------------------------------------------
+
+class TestPhaseTimer:
+    def test_partition_accounts_for_slot_wall(self):
+        from pos_evolution_tpu.profiling.phases import (
+            DENSE_PHASES,
+            PhaseTimer,
+        )
+        from pos_evolution_tpu.telemetry import Telemetry
+        tel = Telemetry()
+        pt = PhaseTimer(sample_every=2, registry=tel.registry,
+                        bus=tel.bus)
+        for slot in range(4):
+            pt.begin_slot(slot)
+            with pt.phase("vote_pass"):
+                time.sleep(0.002)
+            with pt.phase("record"):
+                time.sleep(0.001)
+            pt.end_slot(slot)
+        s = pt.summary()
+        assert s["slots"] == 4 and s["sampled_slots"] == 2
+        assert set(s["phases"]) == {"vote_pass", "record"}
+        assert set(s["phases"]) <= set(DENSE_PHASES)
+        assert s["accounted_pct"] > 90.0
+        assert s["phases"]["vote_pass"]["count"] == 4
+        assert s["sampled_phases"]["vote_pass"]["count"] == 2
+        # only sampled slots emit events / histogram rows
+        evs = tel.bus.of_type("dense_phase")
+        assert [e["slot"] for e in evs] == [0, 2]
+        assert evs[0]["accounted_pct"] > 90.0
+        hist = tel.registry._metrics["dense_phase_ms"]
+        row = hist.value(phase="vote_pass")
+        assert row["count"] == 2
+
+    def test_reentered_phase_accumulates(self):
+        from pos_evolution_tpu.profiling.phases import PhaseTimer
+        pt = PhaseTimer(sample_every=1)
+        pt.begin_slot(0)
+        for _ in range(3):
+            with pt.phase("vote_apply"):
+                time.sleep(0.001)
+        pt.end_slot(0)
+        assert pt.summary()["phases"]["vote_apply"]["count"] == 1
+        assert pt.summary()["phases"]["vote_apply"]["total_ms"] >= 3.0
+
+    def test_async_charge_stays_out_of_slot_partition(self):
+        from pos_evolution_tpu.profiling.phases import PhaseTimer
+        pt = PhaseTimer(sample_every=1)
+        pt.begin_slot(0)
+        with pt.phase("checkpoint_capture"):
+            pass
+        pt.end_slot(0)
+        pt.charge_async("checkpoint_serialize", 0.25)
+        s = pt.summary()
+        assert "checkpoint_serialize" not in s["phases"]
+        assert s["async_phases"]["checkpoint_serialize"]["total_ms"] \
+            == 250.0
+        # accounted_pct cannot be inflated past 100 by overlap work
+        assert s["accounted_pct"] is None or s["accounted_pct"] <= 100.5
+
+    def test_null_timer_twin_surface(self):
+        from pos_evolution_tpu.profiling.phases import NULL_TIMER
+        assert NULL_TIMER.enabled is False
+        NULL_TIMER.begin_slot(0)
+        with NULL_TIMER.phase("vote_pass"):
+            pass
+        NULL_TIMER.fence(None)
+        NULL_TIMER.charge_async("x", 1.0)
+        NULL_TIMER.end_slot(0)
+        assert NULL_TIMER.summary() is None
+
+
+# --- perf gate kinds ----------------------------------------------------------
+
+class TestPerfGateKinds:
+    def _history(self, tmp_path, kinds):
+        from pos_evolution_tpu.profiling import history
+        path = str(tmp_path / "bench_history.jsonl")
+        for kind, counts in kinds:
+            history.append_entry(path, {"metric": kind,
+                                        "counts": counts}, kind=kind)
+        return path
+
+    def test_explicit_kind_matching_nothing_exits_2(self, tmp_path,
+                                                    capsys):
+        import perf_gate
+        hist = self._history(tmp_path, [("bench_merkle", {"x": 1})])
+        cand = tmp_path / "cand.json"
+        cand.write_text(json.dumps({"counts": {"x": 1}}))
+        rc = perf_gate.main(["--candidate", str(cand),
+                             "--history", hist,
+                             "--kind", "bench_obsx"])  # typo'd kind
+        assert rc == 2
+        err = capsys.readouterr()
+        assert "zero entries of kind 'bench_obsx'" in err.out + err.err
+
+    def test_list_kinds_inventories_history(self, tmp_path, capsys):
+        import perf_gate
+        hist = self._history(tmp_path, [("bench_obs", {"x": 1}),
+                                        ("bench_obs", {"x": 1}),
+                                        ("bench_merkle", {"y": 2})])
+        assert perf_gate.main(["--history", hist, "--list-kinds"]) == 0
+        out = capsys.readouterr().out
+        assert "bench_obs" in out and "2" in out
+        assert "bench_merkle" in out
+        # --candidate still required on the gating path
+        assert perf_gate.main != 0  # sanity: callable imported
+
+    def test_matching_kind_still_gates(self, tmp_path):
+        import perf_gate
+        hist = self._history(tmp_path, [("bench_obs", {"x": 4}),
+                                        ("bench_obs", {"x": 4})])
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps({"counts": {"x": 4}}))
+        assert perf_gate.main(["--candidate", str(good),
+                               "--history", hist,
+                               "--kind", "bench_obs"]) == 0
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"counts": {"x": 40}}))
+        assert perf_gate.main(["--candidate", str(bad),
+                               "--history", hist,
+                               "--kind", "bench_obs"]) == 1
+
+
+# --- balancer fleet bias ------------------------------------------------------
+
+class TestBalancerMetricsBias:
+    def test_error_heavy_worker_is_downweighted(self, tmp_path):
+        from pos_evolution_tpu.serve.balancer import Balancer
+        from pos_evolution_tpu.telemetry import fleet
+        fleet.write_snapshot(fleet.snapshot_path(tmp_path, 0, 1),
+                             _registry_with({"ok": 100}), 0, 1)
+        fleet.write_snapshot(fleet.snapshot_path(tmp_path, 1, 2),
+                             _registry_with({"ok": 40, "error": 60}),
+                             1, 2)
+        fleet.write_snapshot(fleet.snapshot_path(tmp_path, 2, 3),
+                             _registry_with({"error": 8}), 2, 3)
+        bal = Balancer(3, metrics_dir=str(tmp_path),
+                       metrics_refresh_s=0.0)
+        bias = bal._metrics_bias()
+        assert bias[0] == 1.0
+        assert bias[1] == 0.25  # 60% errors -> floor
+        assert 2 not in bias    # < 32 requests: no bias, cold != sick
+        assert bal.metrics_refreshes == 1
+
+    def test_shed_is_not_illness(self, tmp_path):
+        from pos_evolution_tpu.serve.balancer import Balancer
+        from pos_evolution_tpu.telemetry import fleet
+        fleet.write_snapshot(fleet.snapshot_path(tmp_path, 0, 1),
+                             _registry_with({"ok": 50, "shed": 50}),
+                             0, 1)
+        bal = Balancer(1, metrics_dir=str(tmp_path),
+                       metrics_refresh_s=0.0)
+        assert bal._metrics_bias()[0] == 1.0
+
+    def test_no_metrics_dir_means_no_bias(self):
+        from pos_evolution_tpu.serve.balancer import Balancer
+        bal = Balancer(2)
+        assert bal.metrics_dir is None
+        assert bal._metrics_bias() == {}  # board-less: uniform pick
