@@ -261,7 +261,7 @@ def main():
     if "--trace" in sys.argv:
         # One traced epoch of the measured workload (SURVEY §5 / VERDICT
         # r4 item 7): xplane protobuf under bench_trace/, plus a top-op
-        # table in bench_trace/top_ops.json via scripts/trace_summary.py.
+        # table in bench_trace/top_ops.json via profiling/xplane.py.
         # The fresh trace lands in a TEMP dir and only replaces
         # bench_trace/ after the summary succeeds — a failed traced run
         # must not delete the committed top_ops.json artifact.

@@ -52,6 +52,7 @@ from pos_evolution_tpu.parallel.collectives import (  # noqa: E402
     SHARD_AXIS,
     JaxCollectives,
 )
+from pos_evolution_tpu.profiling import ledger  # noqa: E402
 
 
 def make_mesh(n_devices: int | None = None, n_pods: int | None = None) -> Mesh:
@@ -79,8 +80,20 @@ def host_gather(tree):
     the cheap, device-synchronous half of an async checkpoint (ISSUE
     10): the caller keeps only this host copy on the critical path and
     hands compression/serialization to the background writer. Works on
-    plain jnp/np arrays too, so call sites need no mesh conditional."""
-    return jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
+    plain jnp/np arrays too, so call sites need no mesh conditional.
+
+    Every gather is charged to ``jax_transfer_bytes_total{site=
+    host_gather}`` (and to the active phase) — the baseline number for
+    ROADMAP item 5's "collapse the per-slot gather" lever."""
+    gathered = jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
+    try:
+        from pos_evolution_tpu.telemetry import jaxrt
+        nbytes = sum(a.nbytes for a in jax.tree_util.tree_leaves(gathered)
+                     if hasattr(a, "nbytes"))
+        jaxrt.record_transfer(nbytes, direction="d2h", site="host_gather")
+    except Exception:
+        pass  # pev: ignore[PEV005] — accounting must never kill a gather
+    return gathered
 
 
 def shard_registry(mesh: Mesh, reg: DenseRegistry) -> DenseRegistry:
@@ -141,7 +154,20 @@ _KERNEL_CACHE: dict = {}
 def _cached(key, build):
     kern = _KERNEL_CACHE.get(key)
     if kern is None:
-        kern = _KERNEL_CACHE[key] = build()
+        # wrap the built kernel in a compile-provenance scope named by
+        # the cache key's leading element ("epoch", "votes", ...): any
+        # (re)compile the call triggers lands on a named ledger row.
+        # One context-manager enter/exit per call — noise next to a
+        # device dispatch, and the wrapper is cached with the kernel.
+        raw = build()
+        name = key[0] if isinstance(key, tuple) and key else str(key)
+
+        def kern(*a, _raw=raw, _name=f"sharded:{name}", **kw):
+            with ledger.function_scope(_name):
+                return _raw(*a, **kw)
+
+        kern.__wrapped__ = raw
+        _KERNEL_CACHE[key] = kern
     return kern
 
 

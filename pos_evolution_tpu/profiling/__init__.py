@@ -33,6 +33,10 @@ from pos_evolution_tpu.profiling.attribution import (
     group_by_shard_map,
     innermost_jit,
 )
+from pos_evolution_tpu.profiling.ledger import (
+    CompileLedger,
+    function_scope,
+)
 from pos_evolution_tpu.profiling.phases import (
     DENSE_PHASES,
     NULL_TIMER,
@@ -57,6 +61,7 @@ __all__ = [
     "ProfiledRegion", "attribute_to_spans", "group_by_jit",
     "group_by_shard_map", "innermost_jit",
     "PhaseTimer", "NULL_TIMER", "DENSE_PHASES",
+    "CompileLedger", "function_scope",
     "HISTORY_SCHEMA_VERSION", "append_entry", "band_verdicts",
     "read_history", "robust_band",
     "encode_xspace", "parse_xspace", "summarize_path", "summarize_xplane",

@@ -31,7 +31,7 @@ import re
 import shutil
 import tempfile
 
-from pos_evolution_tpu.profiling import xplane
+from pos_evolution_tpu.profiling import ledger, xplane
 
 _JIT_RE = re.compile(r"jit\(([^()]*)\)")
 
@@ -248,8 +248,13 @@ class ProfiledRegion:
         self._bus_mark = 0
         self._annotation = None
         self._tracing = False
+        self._prev_region = None
 
     def __enter__(self) -> "ProfiledRegion":
+        # name this region in the compile-provenance span context
+        # (profiling/ledger.py): compiles triggered inside the region
+        # are charged to it when no tighter function scope is active
+        self._prev_region = ledger.push_region(self.name)
         if self.trace_dir is None:
             self.trace_dir = tempfile.mkdtemp(prefix=".profiled_region_")
         os.makedirs(self.trace_dir, exist_ok=True)
@@ -279,6 +284,7 @@ class ProfiledRegion:
         return names
 
     def __exit__(self, *exc) -> None:
+        ledger.pop_region(self._prev_region)
         if self._annotation is not None:
             try:
                 self._annotation.__exit__(*exc)

@@ -1,0 +1,217 @@
+"""Compile-provenance ledger: who compiled, and during which phase.
+
+``telemetry/jaxrt.py`` counts backend compiles
+(``jax_backend_compiles_total``) but cannot say *which function* or
+*which part of the slot* triggered them — jax 0.4.37's
+``jax.monitoring`` duration listeners receive no kwargs (no
+``fun_name``), so all provenance must come from our own side of the
+fence. This module is that side: a thread-local **span context** that
+the rest of the repo pushes into —
+
+- ``profiling/phases.py`` sets the *phase* slot on every
+  ``with pt.phase(name)`` enter/exit (two attribute writes — the
+  steady-state slot loop pays nothing measurable);
+- ``parallel/sharded.py`` wraps each memoized kernel in a
+  ``function_scope`` carrying the kernel-cache name (``"epoch"``,
+  ``"votes"``, ...);
+- ``profiling/attribution.py``'s ``ProfiledRegion`` sets the *region*
+  slot so ad-hoc profiled blocks name their compiles too.
+
+``CompileLedger.on_duration`` (invoked by the jaxrt listener when a
+ledger is attached) reads the context at compile time and charges the
+event to a ``(stage, function, phase)`` row. When no explicit function
+scope is active but a phase is, the row is named ``inline:<phase>`` —
+the single-device dense driver compiles everything inline inside phase
+blocks, so those rows are still *named* attribution (the acceptance
+bar: >= 95% of ``jax_backend_compiles_total`` lands on a named row).
+Rows also flow into the registry as
+``jax_compiles_by_provenance_total{stage,function,phase}`` so they ride
+snapshots, Prometheus export, and ``perf_gate`` for free.
+
+Everything here is stdlib-only and never raises into the caller: the
+ledger is observability, and observability must never be the reason a
+run dies.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = [
+    "CompileLedger",
+    "current_function",
+    "current_phase",
+    "current_region",
+    "function_scope",
+    "pop_phase",
+    "push_phase",
+]
+
+_TLS = threading.local()
+
+#: duration-event suffix -> short stage label used in ledger rows.
+_STAGES = {
+    "backend_compile_duration": "backend_compile",
+    "jaxpr_trace_duration": "trace",
+    "jaxpr_to_mlir_module_duration": "lower",
+}
+
+
+def _ctx():
+    ctx = getattr(_TLS, "ctx", None)
+    if ctx is None:
+        ctx = _TLS.ctx = {"function": None, "phase": None, "region": None}
+    return ctx
+
+
+def push_phase(name: str):
+    """Set the active phase; returns the previous value for ``pop_phase``."""
+    ctx = _ctx()
+    prev = ctx["phase"]
+    ctx["phase"] = name
+    return prev
+
+
+def pop_phase(prev) -> None:
+    _ctx()["phase"] = prev
+
+
+def push_region(name: str):
+    ctx = _ctx()
+    prev = ctx["region"]
+    ctx["region"] = name
+    return prev
+
+
+def pop_region(prev) -> None:
+    _ctx()["region"] = prev
+
+
+def current_phase() -> str | None:
+    return _ctx()["phase"]
+
+
+def current_function() -> str | None:
+    return _ctx()["function"]
+
+
+def current_region() -> str | None:
+    return _ctx()["region"]
+
+
+def current() -> dict:
+    """Copy of the active span context (function/phase/region)."""
+    return dict(_ctx())
+
+
+class function_scope:
+    """Cheap ``with`` scope naming the function about to dispatch.
+
+    Nested scopes restore the outer name on exit; exceptions propagate
+    (the scope itself never raises). Used by the sharded kernel-cache
+    wrapper, hence the ``__slots__`` + no-allocation design: it sits on
+    every kernel call.
+    """
+
+    __slots__ = ("name", "_prev")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        ctx = _ctx()
+        self._prev = ctx["function"]
+        ctx["function"] = self.name
+        return self
+
+    def __exit__(self, *exc):
+        _ctx()["function"] = self._prev
+        return False
+
+
+def provenance(stage: str) -> tuple[str, str, str]:
+    """Resolve the (stage, function, phase) row for a compile event now.
+
+    Precedence for the function name: explicit ``function_scope`` >
+    ``inline:<phase>`` when only a phase is active > the active
+    ``ProfiledRegion`` name > ``"?"``.
+    """
+    ctx = _ctx()
+    phase = ctx["phase"] or "?"
+    fn = ctx["function"]
+    if fn is None:
+        if ctx["phase"] is not None:
+            fn = f"inline:{ctx['phase']}"
+        elif ctx["region"] is not None:
+            fn = ctx["region"]
+        else:
+            fn = "?"
+    return _STAGES.get(stage, stage), fn, phase
+
+
+class CompileLedger:
+    """Per-(stage, function, phase) decomposition of jax compile events.
+
+    Attach via ``telemetry.jaxrt.attach_ledger(ledger)``; the jaxrt
+    duration listener then calls :meth:`on_duration` for every compile/
+    trace/lower event, and this ledger charges it to the span context
+    active on the calling thread. Thread-safe; bounded by the number of
+    distinct (stage, function, phase) triples, which is bounded by the
+    kernel + phase taxonomies.
+    """
+
+    def __init__(self, registry=None):
+        self.registry = registry
+        self._lock = threading.Lock()
+        # (stage, function, phase) -> [count, seconds]
+        self._rows: dict[tuple[str, str, str], list] = {}
+
+    def on_duration(self, event: str, duration: float) -> None:
+        stage, fn, phase = provenance(event.rsplit("/", 1)[-1])
+        with self._lock:
+            row = self._rows.setdefault((stage, fn, phase), [0, 0.0])
+            row[0] += 1
+            row[1] += float(duration)
+        reg = self.registry
+        if reg is not None:
+            try:
+                reg.counter(
+                    "jax_compiles_by_provenance_total",
+                    "compile events by (stage, function, phase)",
+                ).inc(1, stage=stage, function=fn, phase=phase)
+            except Exception:
+                pass  # pev: ignore[PEV005] — ledger must never kill a run
+
+    def rows(self) -> list[dict]:
+        """Ledger rows, heaviest backend-compile time first."""
+        with self._lock:
+            items = [
+                {"stage": k[0], "function": k[1], "phase": k[2],
+                 "count": v[0], "seconds": round(v[1], 6)}
+                for k, v in self._rows.items()
+            ]
+        items.sort(key=lambda r: (-r["seconds"], r["stage"], r["function"]))
+        return items
+
+    def attribution(self, total: int | None = None) -> dict:
+        """How much of ``jax_backend_compiles_total`` has a named row.
+
+        A row is *named* when its phase is known (the phase taxonomy is
+        the attribution target; ``inline:<phase>`` functions count).
+        ``total`` defaults to every backend_compile event the ledger
+        saw — pass the registry's ``jax_backend_compiles_total`` to
+        measure against the full listener count instead.
+        """
+        with self._lock:
+            backend = [(k, v[0]) for k, v in self._rows.items()
+                       if k[0] == "backend_compile"]
+        seen = sum(n for _, n in backend)
+        named = sum(n for (_, fn, phase), n in backend
+                    if phase != "?" or fn != "?")
+        denom = int(total) if total is not None else seen
+        pct = round(100.0 * named / denom, 2) if denom else None
+        return {"backend_compiles": denom, "seen": seen, "named": named,
+                "named_pct": pct}
+
+    def summary(self) -> dict:
+        return {"rows": self.rows(), "attribution": self.attribution()}

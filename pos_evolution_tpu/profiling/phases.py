@@ -33,6 +33,8 @@ from __future__ import annotations
 import threading
 import time
 
+from pos_evolution_tpu.profiling import ledger
+
 __all__ = ["PhaseTimer", "NULL_TIMER", "DENSE_PHASES"]
 
 # The slot taxonomy (DESIGN.md "Fleet observability"): every section of
@@ -57,19 +59,25 @@ DENSE_PHASES = (
 class _Phase:
     """One timed section; re-entered phases accumulate."""
 
-    __slots__ = ("timer", "name")
+    __slots__ = ("timer", "name", "_prev_phase")
 
     def __init__(self, timer: "PhaseTimer", name: str):
         self.timer = timer
         self.name = name
 
     def __enter__(self) -> "_Phase":
+        # publish the phase to the compile-provenance span context
+        # (profiling/ledger.py) so jax compiles, transfers, and
+        # donations occurring inside this block name their phase —
+        # two attribute writes, nothing measurable at steady state
+        self._prev_phase = ledger.push_phase(self.name)
         self.timer._stack.append((self.name, time.perf_counter()))
         return self
 
     def __exit__(self, *exc) -> None:
         name, t0 = self.timer._stack.pop()
         self.timer._charge(name, time.perf_counter() - t0)
+        ledger.pop_phase(self._prev_phase)
 
 
 class _NullPhase:

@@ -1,5 +1,6 @@
 """xplane protobuf parsing — the importable heart of what used to live in
-``scripts/trace_summary.py`` (now a thin CLI shim over this module).
+``scripts/trace_summary.py`` (now a deprecation stub; the CLI path is
+``scripts/run_report.py --xplane TRACE``).
 
 No ``xplane_pb2`` bindings ship in this image, so this walks the protobuf
 wire format directly with the field numbers from

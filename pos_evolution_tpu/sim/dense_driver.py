@@ -127,7 +127,8 @@ class DenseSimulation:
                  verify_aggregates: bool = True, capacity: int = 256,
                  check_walk_every: int = 16, autocheckpoint=None,
                  n_groups: int = 1, fault_plan=None, adversaries=(),
-                 monitors=(), telemetry=None, phase_profile=None):
+                 monitors=(), telemetry=None, phase_profile=None,
+                 flight_recorder=None):
         import jax.numpy as jnp
         self.cfg = cfg or mainnet_config()
         self.n = int(n_validators)
@@ -165,6 +166,15 @@ class DenseSimulation:
             registry=telemetry.registry if telemetry else None,
             bus=telemetry.bus if telemetry else None)
             if phase_profile else NULL_TIMER)
+        # device flight recorder (ISSUE 19): memory watermarks at slot/
+        # epoch/checkpoint boundaries, shard-skew probes at its sampled
+        # slots, and the compile-provenance ledger. Armed lazily at the
+        # first ``run_slot`` — NOT here — so construction-time warm-up
+        # compiles (jnp.full fills, block-tree init: no phase active)
+        # never land as unattributed ledger rows; that lazy arming is
+        # what the >=95% named-attribution bar assumes.
+        self.flight = flight_recorder
+        self._flight_probe = False  # True during a probed slot's phases
         self.monitor_violations: list[dict] = []
         # honest duty split: view group per validator (parity keeps the
         # shuffled committees near-balanced between the halves)
@@ -444,6 +454,11 @@ class DenseSimulation:
                 buckets = rebuild_buckets(view.msg_block,
                                           view.registry.effective_balance,
                                           self.capacity)
+            if self._flight_probe:
+                # before the fence: afterwards every shard is ready and
+                # the per-device arrival spread is unobservable
+                self.flight.probe_skew("vote_pass", buckets,
+                                       slot=self.slot + 1)
             self.phases.fence(buckets)
         # the int() materialization blocks, so this phase is honestly
         # fenced on EVERY slot, sampled or not
@@ -664,11 +679,20 @@ class DenseSimulation:
         if self.mesh is not None:
             from pos_evolution_tpu.parallel.sharded import epoch_step_for
             import jax
-            step = epoch_step_for(self.mesh, self.cfg,
-                                  donate=jax.default_backend() != "cpu")
+            donate = jax.default_backend() != "cpu"
+            step = epoch_step_for(self.mesh, self.cfg, donate=donate)
         else:
             from pos_evolution_tpu.ops.epoch import process_epoch_dense
+            donate = False
             step = lambda *a: process_epoch_dense(*a, self.cfg)  # noqa: E731
+        if self.flight is not None:
+            # donation efficacy (ROADMAP item 5): registry bytes the
+            # epoch step donates (or, armed=0, copies) each boundary
+            from pos_evolution_tpu.telemetry import jaxrt
+            jaxrt.record_donation(
+                sum(a.nbytes for a in view.registry
+                    if hasattr(a, "nbytes")),
+                site="epoch_step", armed=donate)
         out = step(view.registry, jnp.int64(cur_e),
                    jnp.int64(view.finalized[0]), jnp.asarray(view.bits),
                    jnp.int64(view.prev_just[0]),
@@ -711,12 +735,23 @@ class DenseSimulation:
         pt = self.phases
         s = self.slot + 1
         epoch = s // self.S
+        fr = self.flight
+        if fr is not None and not fr.installed:
+            # armed at the first slot, not at construction: see __init__
+            fr.install()
+        self._flight_probe = fr is not None and fr.should_probe(s)
         pt.begin_slot(s)
         if s % self.S == 0 and s > 0:
             with pt.phase("epoch_sweep"):
                 for view in self.views:
                     self._epoch_boundary(view, epoch)
+                if self._flight_probe:
+                    # pre-fence, or the spread is unobservable
+                    fr.probe_skew("epoch_sweep",
+                                  self.views[0].registry.balance, slot=s)
                 pt.fence(*(v.registry.balance for v in self.views))
+            if fr is not None:
+                fr.on_epoch(slot=s)
         if self._epoch_ready < epoch:
             # _start_epoch ends on np.asarray(perm) — host-materialized,
             # so this phase is self-fencing
@@ -856,6 +891,10 @@ class DenseSimulation:
             with pt.phase("checkpoint_capture"):
                 self.supervision.tick(self, s,
                                       self._checkpoint_async_capture)
+        if fr is not None and self._flight_probe:
+            with pt.phase("record"):
+                fr.on_slot(s)  # memory watermark at the slot boundary
+        self._flight_probe = False
         pt.end_slot(s)
 
     def run_epochs(self, n_epochs: int) -> None:
@@ -900,6 +939,8 @@ class DenseSimulation:
                 {v["kind"] for v in self.monitor_violations})
         if self.phases.enabled:
             out["dense_phases"] = self.phases.summary()
+        if self.flight is not None:
+            out["device"] = self.flight.summary()
         return out
 
     # -- checkpoint / resume (gather -> host -> re-shard) ----------------------
@@ -1001,6 +1042,20 @@ class DenseSimulation:
         }
         if self._perm_host is not None:
             cols["perm"] = self._perm_host
+        # ISSUE 19: charge the full capture to the transfer ledger under
+        # its own site (host_gather already charged the registry columns
+        # it moved — the sites stay distinct, don't sum them) and take a
+        # memory watermark while both device state and its host copy are
+        # live: this is the run's realistic high-water point.
+        try:
+            from pos_evolution_tpu.telemetry import jaxrt
+            jaxrt.record_transfer(
+                sum(a.nbytes for a in cols.values() if hasattr(a, "nbytes")),
+                direction="d2h", site="checkpoint_capture")
+        except Exception:
+            pass  # pev: ignore[PEV005] — accounting must never kill this
+        if self.flight is not None:
+            self.flight.sample_memory(site="checkpoint", slot=self.slot)
         return meta, cols
 
     @staticmethod

@@ -32,6 +32,10 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from pos_evolution_tpu.telemetry.device import (
+    DeviceMemorySampler,
+    FlightRecorder,
+)
 from pos_evolution_tpu.telemetry.events import (
     SCHEMA_VERSION,
     EventBus,
@@ -53,7 +57,7 @@ __all__ = [
     "SCHEMA_VERSION", "SNAPSHOT_VERSION", "EventBus", "read_jsonl",
     "per_process_path", "discover_per_process", "merge_event_files",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "FleetAggregator",
+    "FleetAggregator", "DeviceMemorySampler", "FlightRecorder",
     "Telemetry", "set_global", "get_global", "emit_global",
 ]
 

@@ -1,0 +1,333 @@
+"""Device flight recorder: HBM watermarks, shard-skew probes, and the
+compile-provenance ledger in one armable bundle (ISSUE 19).
+
+ROADMAP item 5's levers (donated epoch step, gather collapse, compile
+pre-seeding) are device-level phenomena. This module is the device-side
+counterpart of the fleet observability plane:
+
+- **memory watermarks** — :class:`DeviceMemorySampler` reads
+  ``device.memory_stats()`` per device and turns it into
+  ``device_memory_bytes{device,stat}`` gauges, ``device_memory`` events,
+  and an in-memory headroom curve. On CPU jax returns ``memory_stats()
+  = None`` (jax 0.4.37, probed), so the sampler falls back to a pure
+  host RSS estimate from ``/proc/self/statm`` — labelled
+  ``platform=host_rss`` because it measures the *process*, not an
+  accelerator: it includes Python, numpy, caches; it proves the
+  sampling plumbing and gives a CPU headroom proxy, nothing more.
+- **shard-skew probes** — :func:`shard_completion_times` walks an
+  output array's ``addressable_shards`` and records, per device, when
+  that device's shard became ready. Blocking is one-pass in shard
+  order, so each row is "time until *this* shard AND every
+  earlier-polled shard finished" — cumulative and monotone, which still
+  bounds the straggler (the max row is exact; earlier rows are upper
+  bounds only for devices polled after the straggler). One row on a
+  single-device run.
+- **flight recorder** — :class:`FlightRecorder` bundles the sampler, a
+  ``profiling/ledger.CompileLedger`` and the skew accumulator behind
+  one ``install()``/cadence policy, so the dense driver arms all four
+  ISSUE-19 legs with a single kwarg. Probes run every
+  ``sample_every``-th slot (the phase profiler's fencing policy), which
+  is what keeps the fully-armed steady state within the +3% bench_obs
+  budget.
+
+Everything degrades silently: telemetry must never be the reason a
+NumPy-only run dies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = [
+    "DeviceMemorySampler",
+    "FlightRecorder",
+    "host_rss_bytes",
+    "shard_completion_times",
+]
+
+#: retained headroom-curve points before decimation (keeps artifacts and
+#: memory bounded on 1M-validator-scale runs)
+CURVE_CAP = 4096
+
+
+def host_rss_bytes() -> int | None:
+    """Resident-set bytes of this process from ``/proc/self/statm``
+    (field 2 = resident pages). None off-Linux — the caller then simply
+    has no fallback row. No psutil: nothing pip-installable here."""
+    try:
+        with open("/proc/self/statm") as fh:
+            rss_pages = int(fh.read().split()[1])
+        return rss_pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        return None  # pev: ignore[PEV005] — estimator is best-effort
+
+
+class DeviceMemorySampler:
+    """Per-device memory watermarks with a host-RSS fallback.
+
+    ``sample()`` never raises; each call appends one point (per device)
+    to the in-memory curve, updates peak watermarks, sets
+    ``device_memory_bytes`` gauges, and emits one ``device_memory``
+    event when a bus is attached.
+    """
+
+    def __init__(self, registry=None, bus=None, curve_cap: int = CURVE_CAP):
+        self.registry = registry
+        self.bus = bus
+        self.curve_cap = max(int(curve_cap), 2)
+        self.samples = 0
+        self.source: str | None = None
+        self.curve: list[dict] = []
+        self._curve_stride = 1  # decimation factor after cap overflows
+        self.peak: dict[str, int] = {}
+
+    def _rows(self) -> list[dict]:
+        rows: list[dict] = []
+        try:
+            import jax
+            for d in jax.devices():
+                stats = d.memory_stats()
+                if not stats:
+                    continue  # CPU backend: memory_stats() is None
+                row = {"device": f"{d.platform}:{d.id}",
+                       "platform": d.platform,
+                       "bytes_in_use": int(stats.get("bytes_in_use", 0))}
+                for src, dst in (("peak_bytes_in_use", "peak_bytes_in_use"),
+                                 ("bytes_limit", "limit_bytes")):
+                    if stats.get(src) is not None:
+                        row[dst] = int(stats[src])
+                rows.append(row)
+        except Exception:
+            pass  # pev: ignore[PEV005] — sampling must never kill a run
+        if rows:
+            self.source = "memory_stats"
+            return rows
+        rss = host_rss_bytes()
+        if rss is not None:
+            self.source = "host_rss"
+            return [{"device": "host", "platform": "host_rss",
+                     "bytes_in_use": rss}]
+        self.source = "unavailable"
+        return []
+
+    def sample(self, *, site: str = "slot", slot=None) -> list[dict]:
+        rows = self._rows()
+        if not rows:
+            return rows
+        self.samples += 1
+        for row in rows:
+            dev = row["device"]
+            in_use = row["bytes_in_use"]
+            if in_use > self.peak.get(dev, -1):
+                self.peak[dev] = in_use
+        reg = self.registry
+        if reg is not None:
+            try:
+                g = reg.gauge("device_memory_bytes",
+                              "per-device memory watermark samples")
+                for row in rows:
+                    g.set(row["bytes_in_use"], device=row["device"],
+                          stat="bytes_in_use")
+                    g.set(self.peak[row["device"]], device=row["device"],
+                          stat="peak_bytes_in_use")
+                    if row.get("limit_bytes") is not None:
+                        g.set(row["limit_bytes"], device=row["device"],
+                              stat="limit_bytes")
+            except Exception:
+                pass  # pev: ignore[PEV005] — gauges are best-effort
+        point = {"unix": time.time(), "site": site, "slot": slot,
+                 "rows": rows}
+        if self.bus is not None:
+            try:
+                self.bus.emit("device_memory", **point)
+            except Exception:
+                pass  # pev: ignore[PEV005] — a closed bus must not kill us
+        # bounded curve: on overflow drop every other retained point and
+        # double the stride — spacing coarsens, endpoints survive
+        if self.samples % self._curve_stride == 0:
+            self.curve.append(point)
+            if len(self.curve) >= self.curve_cap:
+                del self.curve[1::2]
+                self._curve_stride *= 2
+        return rows
+
+    def watermark(self) -> dict:
+        return {"samples": self.samples, "source": self.source,
+                "peak_bytes": dict(self.peak),
+                "curve_points": len(self.curve),
+                "curve_stride": self._curve_stride}
+
+
+def shard_completion_times(array) -> list[dict]:
+    """Per-device readiness of one (possibly sharded) array, ms since
+    the probe started. Rows come back in shard-poll order; see module
+    docstring for the cumulative-monotone caveat. Empty list when the
+    value has no pollable shards (host arrays, no jax)."""
+    t0 = time.perf_counter()
+    rows: list[dict] = []
+    try:
+        shards = getattr(array, "addressable_shards", None)
+        if shards:
+            for sh in shards:
+                sh.data.block_until_ready()
+                rows.append({
+                    "device": str(getattr(sh, "device", "?")),
+                    "ms": round((time.perf_counter() - t0) * 1e3, 4)})
+        elif hasattr(array, "block_until_ready"):
+            array.block_until_ready()
+            rows.append({"device": "0",
+                         "ms": round((time.perf_counter() - t0) * 1e3, 4)})
+    except Exception:
+        return []  # pev: ignore[PEV005] — probing is best-effort
+    return rows
+
+
+class FlightRecorder:
+    """Arms the device flight recorder for one run.
+
+    >>> fr = FlightRecorder(telemetry=tel, sample_every=16)
+    >>> sim = DenseSimulation(n, telemetry=tel, flight_recorder=fr)
+    >>> sim.run_epochs(4)
+    >>> fr.summary()["compile_ledger"]["attribution"]["named_pct"]
+
+    The dense driver calls ``install()`` (idempotent) when handed a
+    recorder, then ``should_probe``/``on_slot``/``on_epoch``/
+    ``probe_skew``/``sample_memory`` at the cadence sites. Construction
+    order matters for the >=95% attribution bar: arm *after* building
+    the sim (warm-up compiles outside any phase would otherwise land
+    unattributed) and before running it.
+    """
+
+    def __init__(self, telemetry=None, *, registry=None, bus=None,
+                 sample_every: int = 16, skew: bool = True,
+                 ledger: bool = True, memory: bool = True):
+        if telemetry is not None:
+            registry = registry if registry is not None else telemetry.registry
+            bus = bus if bus is not None else telemetry.bus
+        self.registry = registry
+        self.bus = bus
+        self.sample_every = max(int(sample_every), 1)
+        self.memory = (DeviceMemorySampler(registry=registry, bus=bus)
+                       if memory else None)
+        if ledger:
+            from pos_evolution_tpu.profiling.ledger import CompileLedger
+            self.ledger = CompileLedger(registry=registry)
+        else:
+            self.ledger = None
+        self.skew_enabled = bool(skew)
+        self.skew_probes = 0
+        # (phase, device) -> [total_ms, count, max_ms]
+        self._skew: dict[tuple[str, str], list] = {}
+        self._installed = False
+
+    # -- lifecycle -------------------------------------------------------------
+
+    @property
+    def installed(self) -> bool:
+        return self._installed
+
+    def install(self) -> "FlightRecorder":
+        """Point jax runtime telemetry at this recorder's registry and
+        attach the compile ledger. Idempotent; last install wins (same
+        semantics as ``jaxrt.install``)."""
+        from pos_evolution_tpu.telemetry import jaxrt
+        if self.registry is not None:
+            jaxrt.install(self.registry)
+        if self.ledger is not None:
+            jaxrt.attach_ledger(self.ledger)
+        self._installed = True
+        return self
+
+    def detach(self) -> None:
+        from pos_evolution_tpu.telemetry import jaxrt
+        if self.ledger is not None and jaxrt.current_ledger() is self.ledger:
+            jaxrt.attach_ledger(None)
+        self._installed = False
+
+    # -- cadence sites (called by the drivers) ---------------------------------
+
+    def should_probe(self, slot: int) -> bool:
+        return (slot % self.sample_every) == 0
+
+    def on_slot(self, slot: int) -> None:
+        if self.memory is not None and self.should_probe(slot):
+            self.memory.sample(site="slot", slot=slot)
+
+    def on_epoch(self, slot: int) -> None:
+        if self.memory is not None:
+            self.memory.sample(site="epoch", slot=slot)
+
+    def sample_memory(self, *, site: str, slot=None) -> None:
+        if self.memory is not None:
+            self.memory.sample(site=site, slot=slot)
+
+    def probe_skew(self, phase: str, array, slot=None) -> list[dict]:
+        """Record per-device completion of ``array`` under ``phase``.
+        Call only at fenced/sampled slots — this blocks."""
+        if not self.skew_enabled:
+            return []
+        rows = shard_completion_times(array)
+        if not rows:
+            return rows
+        self.skew_probes += 1
+        for row in rows:
+            cell = self._skew.setdefault((phase, row["device"]),
+                                         [0.0, 0, 0.0])
+            cell[0] += row["ms"]
+            cell[1] += 1
+            cell[2] = max(cell[2], row["ms"])
+        spread = round(max(r["ms"] for r in rows)
+                       - min(r["ms"] for r in rows), 4)
+        if self.bus is not None:
+            try:
+                self.bus.emit("shard_skew", phase=phase, slot=slot,
+                              spread_ms=spread, rows=rows)
+            except Exception:
+                pass  # pev: ignore[PEV005] — probing is best-effort
+        if self.registry is not None:
+            try:
+                self.registry.gauge(
+                    "shard_skew_ms",
+                    "straggler spread (max-min shard readiness) at the "
+                    "last probed slot").set(spread, phase=phase)
+            except Exception:
+                pass  # pev: ignore[PEV005] — gauges are best-effort
+        return rows
+
+    # -- reporting -------------------------------------------------------------
+
+    def skew_table(self) -> list[dict]:
+        rows = [{"phase": k[0], "device": k[1],
+                 "mean_ms": round(v[0] / v[1], 4), "max_ms": round(v[2], 4),
+                 "probes": v[1]}
+                for k, v in self._skew.items()]
+        rows.sort(key=lambda r: (r["phase"], -r["max_ms"], r["device"]))
+        return rows
+
+    def summary(self) -> dict:
+        out: dict = {"sample_every": self.sample_every,
+                     "installed": self._installed}
+        if self.memory is not None:
+            out["memory"] = self.memory.watermark()
+        if self.ledger is not None:
+            out["compile_ledger"] = self.ledger.summary()
+        if self.skew_enabled:
+            out["shard_skew"] = {"probes": self.skew_probes,
+                                 "table": self.skew_table()}
+        return out
+
+    def write_artifact(self, path: str) -> dict:
+        """Write the device-ledger artifact ``run_report.py``
+        auto-discovers beside an event log (``*device_ledger.json``):
+        summary + the full memory curve."""
+        doc = {"v": 1, "flight_recorder": self.summary()}
+        if self.memory is not None:
+            doc["memory_curve"] = self.memory.curve
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, path)
+        return doc

@@ -24,11 +24,21 @@ registers ONE forwarding pair on first use and points it at the active
 registry; ``install(None)`` detaches without touching other listeners.
 Everything degrades to a no-op when jax or the monitoring module is
 absent — telemetry must never be the reason a NumPy-only run dies.
+
+The flight recorder (ISSUE 19) adds a fourth source: a
+``profiling/ledger.CompileLedger`` attached via ``attach_ledger``
+receives every compile-pipeline duration event *with span context*
+(function / phase), decomposing ``jax_backend_compiles_total`` into a
+per-(function, phase) table. ``record_transfer`` additionally charges
+bytes to the active phase (``jax_transfer_bytes_by_phase_total``) when
+one is set, and ``record_donation`` tracks donated-buffer bytes so the
+donation-efficacy lever of ROADMAP item 5 has a number.
 """
 
 from __future__ import annotations
 
-_STATE: dict = {"registry": None, "listeners_registered": False}
+_STATE: dict = {"registry": None, "listeners_registered": False,
+                "ledger": None}
 
 # monitoring key -> counter name for the compile-pipeline stages the perf
 # gate cares about (everything else lands in jax_events_total{event=...})
@@ -44,6 +54,17 @@ def current():
     return _STATE["registry"]
 
 
+def attach_ledger(ledger) -> None:
+    """Point compile-pipeline duration events at a ``CompileLedger``
+    (None detaches). Independent of the registry: a ledger without a
+    registry still accumulates rows in memory."""
+    _STATE["ledger"] = ledger
+
+
+def current_ledger():
+    return _STATE["ledger"]
+
+
 def _on_event(event: str, **kw) -> None:
     reg = _STATE["registry"]
     if reg is not None:
@@ -52,6 +73,12 @@ def _on_event(event: str, **kw) -> None:
 
 
 def _on_duration(event: str, duration: float, **kw) -> None:
+    led = _STATE["ledger"]
+    if led is not None and event in _DURATION_COUNTERS:
+        try:
+            led.on_duration(event, duration)
+        except Exception:
+            pass  # pev: ignore[PEV005] — ledger must never kill a run
     reg = _STATE["registry"]
     if reg is None:
         return
@@ -101,3 +128,29 @@ def record_transfer(nbytes: int, *, direction: str = "d2h",
         reg.counter("jax_transfer_bytes_total",
                     "host<->device bytes moved by instrumented call "
                     "sites").inc(int(nbytes), direction=direction, site=site)
+        # charge to the active phase taxonomy when a phase block is open
+        # (separate counter: the site-keyed one above is a pinned
+        # contract, and adding a label would rename its count keys)
+        from pos_evolution_tpu.profiling import ledger as _ledger
+        phase = _ledger.current_phase()
+        if phase is not None:
+            reg.counter("jax_transfer_bytes_by_phase_total",
+                        "host<->device bytes charged to the dense phase "
+                        "active at transfer time").inc(
+                int(nbytes), direction=direction, phase=phase)
+
+
+def record_donation(nbytes: int, *, site: str = "unknown",
+                    armed: bool = True) -> None:
+    """Account bytes offered for buffer donation at an instrumented call
+    site. ``armed=False`` records the same bytes on the undonated path
+    (e.g. the CPU epoch step, where donation is off), so the efficacy
+    ratio donated/(donated+undonated) is computable from the counter
+    pair alone."""
+    reg = _STATE["registry"]
+    if reg is not None:
+        reg.counter("jax_donation_bytes_total",
+                    "bytes offered for XLA buffer donation (armed) vs "
+                    "moved undonated (armed=0) at instrumented call "
+                    "sites").inc(int(nbytes), site=site,
+                                 armed="1" if armed else "0")

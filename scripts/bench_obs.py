@@ -14,7 +14,7 @@ directions:
   (``--max-overhead`` gates it; off by default because one-shot CPU-CI
   walls are noisy — the acceptance run passes 5).
 
-Three runs, same seed and shape:
+Four runs, same seed and shape:
 
 1. **budget**: ``phase_profile=--sample-every`` with a live telemetry
    bundle — emits ``dense_phase`` events (the ``scripts/run_report.py``
@@ -24,7 +24,13 @@ Three runs, same seed and shape:
 2. **twin**: ``phase_profile=None`` — threads ``NULL_TIMER``, the
    genuinely uninstrumented loop;
 3. **steady**: ``phase_profile=n_slots+1`` — the instrumented loop in
-   which only slot 0 ever fences, i.e. the leave-it-on configuration.
+   which only slot 0 ever fences, i.e. the leave-it-on configuration;
+4. **armed** (ISSUE 19): steady + the full device flight recorder
+   (memory watermarks, compile ledger, skew probes at the default
+   cadence) — ``armed_overhead_pct`` bounds its cost
+   (``--max-armed-overhead``), and the budget run's compile ledger must
+   name >= ``--min-ledger-attribution`` %% of
+   ``jax_backend_compiles_total`` by (function, phase).
 
 ``overhead_pct = (steady_wall - twin_wall) / twin_wall``; with
 ``--repeats N`` the twin/steady timings interleave and the minimum wall
@@ -56,18 +62,26 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _build(args, phase_profile, telemetry=None):
+def _build(args, phase_profile, telemetry=None, flight_recorder=None):
     from pos_evolution_tpu.config import mainnet_config
     from pos_evolution_tpu.sim.dense_driver import DenseSimulation
     cfg = mainnet_config().replace(slots_per_epoch=args.slots_per_epoch)
     return DenseSimulation(
         args.validators, cfg=cfg, mesh=None, seed=args.seed,
         verify_aggregates=True, check_walk_every=16,
-        telemetry=telemetry, phase_profile=phase_profile)
+        telemetry=telemetry, phase_profile=phase_profile,
+        flight_recorder=flight_recorder)
 
 
-def _timed_run(args, phase_profile) -> float:
-    sim = _build(args, phase_profile)
+def _timed_run(args, phase_profile, flight: bool = False) -> float:
+    fr = None
+    if flight:
+        # fully-armed twin (ISSUE 19): fresh in-memory telemetry +
+        # flight recorder at the default cadence — the leave-it-on
+        # configuration whose steady-state cost the gate bounds
+        from pos_evolution_tpu.telemetry import FlightRecorder, Telemetry
+        fr = FlightRecorder(telemetry=Telemetry())
+    sim = _build(args, phase_profile, flight_recorder=fr)
     t0 = time.perf_counter()
     sim.run_epochs(args.epochs)
     return time.perf_counter() - t0
@@ -89,6 +103,12 @@ def main(argv=None) -> int:
     ap.add_argument("--max-overhead", type=float, default=None,
                     help="exit 1 if steady-state instrumentation costs "
                          "more than this %% over the uninstrumented twin")
+    ap.add_argument("--max-armed-overhead", type=float, default=None,
+                    help="exit 1 if the fully-armed flight recorder "
+                         "costs more than this %% over the twin")
+    ap.add_argument("--min-ledger-attribution", type=float, default=None,
+                    help="exit 1 unless the compile ledger names at "
+                         "least this %% of jax_backend_compiles_total")
     ap.add_argument("--json", help="write the bench_obs emission here")
     ap.add_argument("--history",
                     help="append the emission to this bench_history.jsonl")
@@ -110,23 +130,45 @@ def main(argv=None) -> int:
         telemetry = Telemetry.to_file(args.events)
     else:
         telemetry = Telemetry()
-    sim = _build(args, args.sample_every, telemetry=telemetry)
+    from pos_evolution_tpu.telemetry import FlightRecorder
+    fr = FlightRecorder(telemetry=telemetry,
+                        sample_every=args.sample_every)
+    sim = _build(args, args.sample_every, telemetry=telemetry,
+                 flight_recorder=fr)
     t0 = time.perf_counter()
     sim.run_epochs(args.epochs)
     budget_wall = time.perf_counter() - t0
     phases = sim.phases.summary()
     accounted = phases.get("accounted_pct")
     dense_phase_events = len(telemetry.bus.of_type("dense_phase"))
+    # compile attribution vs the registry's own backend-compile count:
+    # both armed at the first run_slot, so the denominators align
+    compiles_total = int(telemetry.registry.counts().get(
+        "jax_backend_compiles_total", 0))
+    attribution = fr.ledger.attribution(total=compiles_total)
+    device_summary = fr.summary()
+    if args.events:
+        stem = args.events[:-6] if args.events.endswith(".jsonl") \
+            else args.events
+        device_artifact = f"{stem}.device_ledger.json"
+        fr.write_artifact(device_artifact)
+    else:
+        device_artifact = None
     telemetry.close()
 
     # 2/3. uninstrumented twin vs steady-state (slot 0 alone fences) —
     # interleaved so both sides of each pair share the box's mood
-    twin_wall = steady_wall = float("inf")
+    twin_wall = steady_wall = armed_wall = float("inf")
     for _ in range(max(args.repeats, 1)):
         twin_wall = min(twin_wall, _timed_run(args, None))
         steady_wall = min(steady_wall, _timed_run(args, n_slots + 1))
+        # 4. armed: steady-state profiler + full flight recorder
+        armed_wall = min(armed_wall,
+                         _timed_run(args, n_slots + 1, flight=True))
     overhead_pct = (100.0 * (steady_wall - twin_wall) / twin_wall
                     if twin_wall > 0 else None)
+    armed_overhead_pct = (100.0 * (armed_wall - twin_wall) / twin_wall
+                          if twin_wall > 0 else None)
 
     sampled = phases.get("sampled_phases") or {}
     counts = {
@@ -134,6 +176,10 @@ def main(argv=None) -> int:
         "sampled_slots": phases.get("sampled_slots"),
         "dense_phase_events": dense_phase_events,
         "phases_recorded": len(sampled),
+        "device_memory_samples": device_summary.get(
+            "memory", {}).get("samples"),
+        "ledger_rows": len(device_summary.get(
+            "compile_ledger", {}).get("rows", ())),
     }
     for name, row in sampled.items():
         counts[f"phase_rows;phase={name}"] = row.get("count")
@@ -148,6 +194,13 @@ def main(argv=None) -> int:
     print(f"  steady       : {steady_wall * 1e3:9.2f} ms wall "
           f"(instrumented, unfenced) -> overhead "
           f"{overhead_pct:+.2f}%")
+    print(f"  armed        : {armed_wall * 1e3:9.2f} ms wall "
+          f"(flight recorder on) -> overhead "
+          f"{armed_overhead_pct:+.2f}%")
+    print(f"  compile ledger: {attribution['named']}/"
+          f"{attribution['backend_compiles']} backend compiles on a "
+          f"named (function, phase) row "
+          f"({attribution['named_pct']}%)")
     top = sorted(((row.get("total_ms", 0), name)
                   for name, row in sampled.items()), reverse=True)[:5]
     for ms, name in top:
@@ -163,13 +216,18 @@ def main(argv=None) -> int:
         "accounted_pct": accounted,
         "overhead_pct": (round(overhead_pct, 3)
                          if overhead_pct is not None else None),
+        "armed_overhead_pct": (round(armed_overhead_pct, 3)
+                               if armed_overhead_pct is not None
+                               else None),
         "walls": {
             "budget_ms": round(budget_wall * 1e3, 3),
             "twin_ms": round(twin_wall * 1e3, 3),
             "steady_ms": round(steady_wall * 1e3, 3),
+            "armed_ms": round(armed_wall * 1e3, 3),
         },
         "phases": sampled,
         "async_phases": phases.get("async_phases"),
+        "device": device_summary,
         "counts": counts,
     }
     if args.json:
@@ -185,6 +243,10 @@ def main(argv=None) -> int:
         print(f"events   -> {args.events} "
               f"({dense_phase_events} dense_phase events; "
               f"next: python scripts/run_report.py {args.events})")
+    if device_artifact:
+        print(f"device   -> {device_artifact} "
+              f"(flight-recorder artifact; run_report auto-discovers "
+              f"it beside the event log)")
 
     ok = True
     if args.min_accounted is not None and \
@@ -198,6 +260,20 @@ def main(argv=None) -> int:
         print(f"FAIL: steady-state overhead {overhead_pct:.2f}% > "
               f"allowed {args.max_overhead}%", file=sys.stderr)
         ok = False
+    if args.max_armed_overhead is not None \
+            and armed_overhead_pct is not None \
+            and armed_overhead_pct > args.max_armed_overhead:
+        print(f"FAIL: armed flight-recorder overhead "
+              f"{armed_overhead_pct:.2f}% > allowed "
+              f"{args.max_armed_overhead}%", file=sys.stderr)
+        ok = False
+    if args.min_ledger_attribution is not None:
+        pct = attribution.get("named_pct")
+        if pct is None or pct < args.min_ledger_attribution:
+            print(f"FAIL: compile ledger names {pct}% of backend "
+                  f"compiles < required {args.min_ledger_attribution}%",
+                  file=sys.stderr)
+            ok = False
     return 0 if ok else 1
 
 

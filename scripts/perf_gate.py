@@ -88,6 +88,8 @@ import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
+# scripts/ itself, so the failure path can import the perf_diff sibling
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
 
 
 def extract_counts(obj: dict) -> dict[str, float]:
@@ -206,9 +208,23 @@ def gate(baseline: dict, candidate: dict, rel_tol: float, abs_slack: float,
         print(f"PERF GATE: FAIL ({len(failures)} regression"
               f"{'s' if len(failures) != 1 else ''}): "
               + ", ".join(failures), file=out)
+        _print_attribution(baseline, candidate, out)
         return 1
     print("PERF GATE: pass", file=out)
     return 0
+
+
+def _print_attribution(baseline: dict, candidate: dict, out) -> None:
+    """On gate failure, rank WHAT regressed via scripts/perf_diff.py —
+    the attribution table (ISSUE 19 leg 4). Diagnostic only: any
+    failure here must never change the gate's exit code."""
+    try:
+        import perf_diff
+        print("--- attribution (scripts/perf_diff.py) ---", file=out)
+        print(perf_diff.render(perf_diff.diff(baseline, candidate)),
+              file=out)
+    except Exception as e:  # pev: ignore[PEV005] — diagnostic only
+        print(f"(perf_diff attribution unavailable: {e!r:.120})", file=out)
 
 
 def quarantine_series(series: dict[str, list[float]], ratio: float,
@@ -390,6 +406,12 @@ def gate_history(history_path: str, candidate: dict, window: int,
         print(f"PERF GATE: FAIL ({len(failures)} regression"
               f"{'s' if len(failures) != 1 else ''} vs history band): "
               + ", ".join(failures), file=out)
+        # attribution baseline: the newest history emission that is not
+        # the candidate itself (same no-self-gating rule as the band)
+        base = next((e.get("emission") for e in reversed(entries)
+                     if e.get("emission") != candidate), None)
+        if base is not None:
+            _print_attribution(base, candidate, out)
         return 1
     print("PERF GATE: pass", file=out)
     return 0

@@ -67,6 +67,7 @@ Markdown goes to stdout unless ``--markdown`` is given.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -129,6 +130,18 @@ def discover_top_ops(events_path: str, events=()) -> str | None:
     return None
 
 
+def discover_device_ledger(events_path: str) -> str | None:
+    """The flight recorder's artifact beside the event log
+    (``*device_ledger.json``, written by ``FlightRecorder.
+    write_artifact``) — same proximity contract as the fleet snapshot
+    auto-discovery. Newest mtime wins when several runs share a dir."""
+    here = os.path.dirname(os.path.abspath(events_path))
+    cands = glob.glob(os.path.join(here, "*device_ledger.json"))
+    if not cands:
+        return None
+    return max(cands, key=os.path.getmtime)
+
+
 def discover_bundle(events_path: str) -> str | None:
     """The chaos-fuzz repro bundle the event log belongs to, if any:
     ``write_bundle`` moves the violating run's ``events.jsonl`` next to
@@ -139,9 +152,59 @@ def discover_bundle(events_path: str) -> str | None:
     return None
 
 
+def _device_section(by_type: dict, device_ledger: dict | None) -> dict:
+    """The "Device" section (ISSUE 19): flight-recorder artifact first
+    (memory watermarks + compile ledger + skew table), live
+    ``device_memory`` / ``shard_skew`` events as the fallback when the
+    run died before writing one. Empty dict = no section."""
+    out: dict = {}
+    fr = (device_ledger or {}).get("flight_recorder") or {}
+    if fr.get("memory"):
+        out["memory"] = fr["memory"]
+    if fr.get("compile_ledger"):
+        out["compile_ledger"] = fr["compile_ledger"]
+    if (fr.get("shard_skew") or {}).get("table"):
+        out["shard_skew"] = fr["shard_skew"]
+    curve = (device_ledger or {}).get("memory_curve") or []
+    dm_events = by_type.get("device_memory", [])
+    if "memory" not in out and dm_events:
+        # reconstruct watermarks from the event stream alone
+        peak: dict[str, int] = {}
+        source = None
+        for ev in dm_events:
+            for row in ev.get("rows") or []:
+                dev = row.get("device", "?")
+                peak[dev] = max(peak.get(dev, 0),
+                                int(row.get("bytes_in_use", 0)))
+                source = row.get("platform")
+        out["memory"] = {"samples": len(dm_events), "source": source,
+                         "peak_bytes": peak}
+    if curve or dm_events:
+        points = curve or dm_events
+        out["memory_events"] = len(points)
+        first, last = points[0], points[-1]
+        out["memory_span"] = {
+            "first": {"site": first.get("site"),
+                      "slot": first.get("slot")},
+            "last": {"site": last.get("site"), "slot": last.get("slot")},
+        }
+    skew_events = by_type.get("shard_skew", [])
+    if "shard_skew" not in out and skew_events:
+        worst = max(skew_events,
+                    key=lambda e: e.get("spread_ms") or 0)
+        out["shard_skew"] = {
+            "probes": len(skew_events),
+            "worst": {"phase": worst.get("phase"),
+                      "slot": worst.get("slot"),
+                      "spread_ms": worst.get("spread_ms")},
+        }
+    return out
+
+
 def build_report(events: list[dict], top_ops: dict | None = None,
                  cost: dict | None = None,
-                 bundle: str | None = None) -> dict:
+                 bundle: str | None = None,
+                 device_ledger: dict | None = None) -> dict:
     """Pure JSONL -> report-dict transform (the testable core)."""
     by_type: dict[str, list[dict]] = {}
     for ev in events:
@@ -587,6 +650,9 @@ def build_report(events: list[dict], top_ops: dict | None = None,
         report["serving_mp"] = serving_mp
     if dense_budget:
         report["dense_phase_budget"] = dense_budget
+    device = _device_section(by_type, device_ledger)
+    if device:
+        report["device"] = device
     if merkleization:
         report["merkleization"] = merkleization
     if das_serving:
@@ -897,6 +963,49 @@ def to_markdown(report: dict) -> str:
             [[name, row.get("total_ms"), row.get("share_pct")]
              for name, row in (d.get("phases") or {}).items()])]
 
+    if report.get("device"):
+        d = report["device"]
+        md += ["", "## Device", ""]
+        mem = d.get("memory") or {}
+        if mem:
+            peaks = ", ".join(
+                f"{dev}: {b / (1 << 20):.1f} MiB"
+                for dev, b in sorted((mem.get("peak_bytes") or {}).items()))
+            md.append(f"- memory watermark ({mem.get('samples')} samples, "
+                      f"source **{mem.get('source')}**): {peaks or 'n/a'}")
+            if mem.get("source") == "host_rss":
+                md.append("  - host_rss measures the whole PROCESS "
+                          "(python, numpy, caches) — a CPU headroom "
+                          "proxy, not accelerator memory")
+        led = d.get("compile_ledger") or {}
+        attr = led.get("attribution") or {}
+        if attr:
+            md.append(f"- compile ledger: **{attr.get('named')}/"
+                      f"{attr.get('backend_compiles')}** backend "
+                      f"compiles on a named (function, phase) row "
+                      f"({attr.get('named_pct')}%)")
+        rows = led.get("rows") or []
+        if rows:
+            md += ["", *_md_table(
+                ["stage", "function", "phase", "count", "seconds"],
+                [[r.get("stage"), r.get("function"), r.get("phase"),
+                  r.get("count"), r.get("seconds")]
+                 for r in rows[:12]])]
+        skew = d.get("shard_skew") or {}
+        if skew.get("table"):
+            md += ["", "shard skew (per phase x device):", "",
+                   *_md_table(
+                       ["phase", "device", "mean ms", "max ms", "probes"],
+                       [[r.get("phase"), r.get("device"),
+                         r.get("mean_ms"), r.get("max_ms"),
+                         r.get("probes")]
+                        for r in skew["table"][:16]])]
+        elif skew.get("worst"):
+            w = skew["worst"]
+            md.append(f"- worst shard skew: {w.get('spread_ms')} ms "
+                      f"spread in **{w.get('phase')}** at slot "
+                      f"{w.get('slot')} ({skew.get('probes')} probes)")
+
     if report.get("das_serving"):
         d = report["das_serving"]
         md += ["", "## DAS serving", ""]
@@ -1004,6 +1113,16 @@ def main(argv=None) -> int:
                     help="chaos-fuzz repro bundle the log belongs to "
                          "(default: auto-discovered when the log sits "
                          "next to a violations.json)")
+    ap.add_argument("--device-ledger",
+                    help="flight-recorder artifact to fold into the "
+                         "Device section (default: auto-discovered "
+                         "*device_ledger.json next to the event log)")
+    ap.add_argument("--xplane", metavar="TRACE",
+                    help="xplane trace dir/file to summarize into the "
+                         "top-device-ops table (absorbs the old "
+                         "scripts/trace_summary.py; wins over --top-ops)")
+    ap.add_argument("--top-n", type=int, default=10,
+                    help="rows per plane for --xplane (default 10)")
     args = ap.parse_args(argv)
 
     events, merged_from = load_events(args.events)
@@ -1016,7 +1135,13 @@ def main(argv=None) -> int:
         print(f"# auto-discovered top-ops table: {top_ops_path}",
               file=sys.stderr)
     top_ops = None
-    if top_ops_path and os.path.exists(top_ops_path):
+    if args.xplane:
+        # the trace_summary.py fold-in: summarize an xplane trace
+        # directly into the same table --top-ops would have carried
+        from pos_evolution_tpu.profiling.xplane import summarize_path
+        blob = summarize_path(args.xplane, args.top_n)
+        top_ops = blob.get("planes", blob)
+    elif top_ops_path and os.path.exists(top_ops_path):
         with open(top_ops_path) as fh:
             blob = json.load(fh)
         top_ops = blob.get("planes", blob)
@@ -1025,7 +1150,16 @@ def main(argv=None) -> int:
         with open(args.cost) as fh:
             cost = json.load(fh)
     bundle = args.bundle or discover_bundle(args.events)
-    report = build_report(events, top_ops=top_ops, cost=cost, bundle=bundle)
+    ledger_path = args.device_ledger or discover_device_ledger(args.events)
+    device_ledger = None
+    if ledger_path and os.path.exists(ledger_path):
+        if args.device_ledger is None:
+            print(f"# auto-discovered device ledger: {ledger_path}",
+                  file=sys.stderr)
+        with open(ledger_path) as fh:
+            device_ledger = json.load(fh)
+    report = build_report(events, top_ops=top_ops, cost=cost, bundle=bundle,
+                          device_ledger=device_ledger)
     if args.json:
         with open(args.json, "w") as fh:
             json.dump(report, fh, indent=1, sort_keys=True)

@@ -1,17 +1,23 @@
-"""Summarize a jax.profiler xplane trace into a top-N op table.
+"""DEPRECATED shim — use ``scripts/run_report.py --xplane`` instead.
 
-Thin CLI shim: the wire-format parser lives in
-``pos_evolution_tpu/profiling/xplane.py`` (importable; also feeds the
-Chrome-trace exporter and the span-attribution pass). This entry point
-keeps the historic invocation working:
+This tool has been a thin wrapper over ``profiling/xplane.py`` since
+PR 4; ISSUE 19 folded it into ``run_report.py`` (``--xplane TRACE``
+summarizes a trace into the report's top-device-ops table, with
+``--top-n`` for the row count). The importable names below still
+forward to ``pos_evolution_tpu.profiling.xplane`` so old callers keep
+working, and the CLI still prints the same JSON — but both emit a
+DeprecationWarning and will be removed after the next milestone.
 
-Usage: python scripts/trace_summary.py <trace_dir_or_xplane.pb> [top_n]
-Prints the top-N table as JSON — device planes first.
+Old:  python scripts/trace_summary.py TRACE [TOP_N]
+New:  python scripts/run_report.py events.jsonl --xplane TRACE [--top-n N]
 """
+
+from __future__ import annotations
 
 import json
 import os
 import sys
+import warnings
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -21,9 +27,22 @@ from pos_evolution_tpu.profiling.xplane import (  # noqa: E402,F401
     top_table,          # re-exported for legacy importers
 )
 
+_DEPRECATION = ("scripts/trace_summary.py is deprecated; use "
+                "scripts/run_report.py --xplane TRACE [--top-n N]")
+
+warnings.warn(_DEPRECATION, DeprecationWarning, stacklevel=2)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    print(f"# {_DEPRECATION}", file=sys.stderr)
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    top_n = int(argv[1]) if len(argv) > 1 else 10
+    print(json.dumps(summarize_path(argv[0], top_n), indent=1))
+    return 0
+
+
 if __name__ == "__main__":
-    if len(sys.argv) < 2:
-        sys.exit(__doc__)
-    top = summarize_path(sys.argv[1],
-                         int(sys.argv[2]) if len(sys.argv) > 2 else 10)
-    print(json.dumps(top, indent=1))
+    sys.exit(main())
