@@ -61,7 +61,7 @@ PARTITION_RULES: tuple[tuple[str, P], ...] = (
     # resident fork-choice latest-message table + the dense driver's
     # committee-assignment, vote-delivery-mask (faults/adversary, ISSUE
     # 13), evidence and genesis-stake columns: [N] over validators
-    (r"messages/(msg_block|msg_epoch|weight|ok|assigned"
+    (r"messages/(msg_block|msg_epoch|msg_slot|weight|ok|assigned"
      r"|allow|evidence|stake)", VALIDATOR_SPEC),
     # fused-transition session columns: [N] over validators
     (r"session/(balances|prev_flags|cur_flags|eff_units)", VALIDATOR_SPEC),

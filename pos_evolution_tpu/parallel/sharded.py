@@ -286,21 +286,46 @@ def vote_apply_for(mesh: Mesh):
     drop/delay/crash masks, computed replicated on host and placed
     sharded; elementwise, zero collectives, so faulted == unfaulted-
     with-all-pass-masks bit-for-bit on every mesh shape (and identical
-    to the single-device jitted twin in sim/dense_driver.py)."""
+    to the single-device jitted twin in sim/dense_driver.py). The
+    ``msg_slot`` column (ISSUE 20) stamps each landed vote with its
+    origination slot — the expiry-window input of the variant plane."""
     vspec = P((POD_AXIS, SHARD_AXIS))
 
     def build():
         @jax.jit
         @partial(shard_map, mesh=mesh,
-                 in_specs=(vspec, vspec, vspec, vspec, P(), P(), P()),
-                 out_specs=(vspec, vspec, vspec))
-        def apply(msg_block, msg_epoch, cur_flags, mask, idx, ep, flag_on):
+                 in_specs=(vspec, vspec, vspec, vspec, vspec,
+                           P(), P(), P(), P()),
+                 out_specs=(vspec, vspec, vspec, vspec))
+        def apply(msg_block, msg_epoch, msg_slot, cur_flags, mask,
+                  idx, ep, vslot, flag_on):
             return (jnp.where(mask, idx, msg_block),
                     jnp.where(mask, ep, msg_epoch),
+                    jnp.where(mask, vslot, msg_slot),
                     jnp.where(mask & flag_on,
                               cur_flags | np.uint8(7), cur_flags))
         return apply
     return _cached(("vote_apply", mesh), build)
+
+
+def expiry_mask_for(mesh: Mesh):
+    """Memoized expiry-window message filter (ISSUE 20): the Goldfish /
+    RLMD / SSF head query counts only votes whose origination slot falls
+    inside ``[lo, hi]`` — elementwise over the sharded latest-message
+    columns (expired rows become the no-vote sentinel -1), zero
+    collectives, feeding the unchanged ``vote_weights_for`` reduction.
+    Identical math to the single-device twin in sim/dense_variants.py."""
+    vspec = P((POD_AXIS, SHARD_AXIS))
+
+    def build():
+        @jax.jit
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(vspec, vspec, P(), P()), out_specs=vspec)
+        def mask(msg_block, msg_slot, lo, hi):
+            live = (msg_slot >= lo) & (msg_slot <= hi)
+            return jnp.where(live, msg_block, jnp.int32(-1))
+        return mask
+    return _cached(("expiry_mask", mesh), build)
 
 
 def masked_stake_for(mesh: Mesh):

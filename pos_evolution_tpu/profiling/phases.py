@@ -47,6 +47,9 @@ DENSE_PHASES = (
     "vote_pass",          # _head: the masked vote-weights kernel
     "head_descent",       # _head: head_from_buckets descent
     "vote_apply",         # _deliver_batch/_apply_batch vote landing
+    "variant_tally",      # dense variant plane: expiry window / link /
+                          # acknowledgment tallies + per-slot gadgets
+    "workload",           # DAS sidecar build/sampling + light clients
     "aggregate_verify",   # _verify_slot committee aggregates
     "monitors",           # dense monitor sweep over the tallies
     "host_audit",         # head_host_walk parity check

@@ -51,8 +51,8 @@ from pos_evolution_tpu.sim.faults import stateless_unit
 
 __all__ = [
     "VoteBatch", "DenseAdversaryStrategy", "DenseEquivocator",
-    "DenseWithholder", "DenseSplitVoter", "DenseBalancer",
-    "DENSE_STRATEGIES", "dense_adversary_from_config",
+    "DenseWithholder", "DenseExAnteReorg", "DenseSplitVoter",
+    "DenseBalancer", "DENSE_STRATEGIES", "dense_adversary_from_config",
 ]
 
 
@@ -74,6 +74,10 @@ class VoteBatch:
     # an explicit bool forces it (used by tests)
     flag: bool | None = None
     faultable: bool = True
+    # origination slot (None = the delivery slot). Carried so expiry
+    # windows and the per-slot variant tallies judge the CAST slot even
+    # when the batch lands late (fault delays, banked releases)
+    slot: int | None = None
 
     def for_view(self, g: int) -> bool:
         return self.views is None or g in self.views
@@ -144,8 +148,11 @@ class DenseAdversaryStrategy:
     # -- shared helpers --------------------------------------------------------
 
     def _mine(self, sim, slot: int) -> np.ndarray:
-        """Controlled members of this slot's committee, as a mask."""
-        return self.controlled_mask & sim.committee_mask(slot)
+        """Controlled members of this slot's duty set, as a mask —
+        the slot committee under Gasper, everyone under a
+        full-participation variant (the adversary votes on the same
+        schedule the honest set does)."""
+        return self.controlled_mask & sim.duty_mask(slot)
 
 
 def _ranges(idx: np.ndarray) -> list:
@@ -305,6 +312,88 @@ class DenseWithholder(DenseAdversaryStrategy):
             mask[arrays[f"bank{j}_idx"]] = True
             self.bank.append(VoteBatch(mask, int(b["block"]),
                                        int(b["epoch"])))
+
+
+class DenseExAnteReorg(DenseAdversaryStrategy):
+    """Committee-targeted multi-slot ex-ante reorg (ISSUE 20) — the
+    attack the proposer-boost / full-participation matrix cells judge.
+
+    Unlike ``DenseWithholder`` (private SIBLING chain + vote bank held
+    OUT of the table), this is the pos-evolution.md:1495 shape: the
+    adversary **controls the slot-F proposer**, withholds that slot's
+    legitimate proposal (``sim.withhold_proposal`` — honest duty falls
+    back to voting its parent), and for ``span`` slots votes the hidden
+    block with its controlled duty slices THROUGH the normal table
+    path. The banked weight is real latest-message state, but the head
+    kernels weigh only visible blocks, so it is inert until
+    ``reveal_blocks`` at slot ``F + span`` — where it lands all at once
+    against the public branch the honest committees built meanwhile.
+
+    Per-variant verdicts (the dense matrix pins):
+
+    - Gasper, no boost: disjoint committees mean the bank accumulates
+      ``span * f`` committees against the single honest committee
+      backing the public tip — at f=0.35, span=2 the reorg SUCCEEDS;
+    - Gasper, boost=40: the propose-time head query at the release slot
+      carries the previous proposal's boost, outweighing the bank —
+      defended;
+    - Goldfish/RLMD/SSF (full participation): every honest validator
+      re-votes the public branch every slot while the bank collapses to
+      one latest-message stamp of ``f * total`` — structurally
+      defended (and under Goldfish's eta=1 the early stamps expire
+      outright).
+    """
+
+    name = "dense_exante_reorg"
+
+    def __init__(self, controlled=(), fork_slot: int = 2, span: int = 2):
+        super().__init__(controlled)
+        self.fork_slot = int(fork_slot)
+        self.span = max(int(span), 1)
+        self.priv: list[int] = []       # the withheld proposal
+        self.honest_tip: int | None = None  # public tip at release
+        self.released = False
+
+    def describe(self) -> dict:
+        d = super().describe()
+        d.update(fork_slot=self.fork_slot, span=self.span)
+        return d
+
+    def before_propose(self, sim, slot: int) -> None:
+        if (slot == self.fork_slot + self.span and not self.released
+                and self.priv):
+            self.released = True
+            # public tip NOW is what the reorg must beat; the matrix
+            # verdict compares the post-release head against both
+            self.honest_tip = sim._head(0)
+            sim.reveal_blocks(self.priv)
+
+    def on_proposals(self, sim, slot: int, new_idx: list) -> None:
+        if slot == self.fork_slot and not self.priv:
+            sim.withhold_proposal(0, new_idx[0])
+            self.priv = [new_idx[0]]
+
+    def vote_batches(self, sim, slot: int, new_idx: list) -> list:
+        mine = self._mine(sim, slot)
+        if not mine.any():
+            return []
+        epoch = slot // sim.S
+        if (self.priv and not self.released
+                and self.fork_slot <= slot < self.fork_slot + self.span):
+            # the bank: real table writes for an invisible target —
+            # weightless in every honest head query until the release
+            return [VoteBatch(mine, self.priv[0], epoch)]
+        return [VoteBatch(mine, new_idx[0], epoch, views=(0,))]
+
+    def state_meta(self) -> dict:
+        return {"priv": list(self.priv), "released": self.released,
+                "honest_tip": self.honest_tip}
+
+    def restore_state(self, meta: dict, arrays: dict) -> None:
+        self.priv = [int(i) for i in meta.get("priv", [])]
+        self.released = bool(meta.get("released", False))
+        ht = meta.get("honest_tip")
+        self.honest_tip = None if ht is None else int(ht)
 
 
 class DenseSplitVoter(DenseAdversaryStrategy):
@@ -468,6 +557,7 @@ class DenseBalancer(DenseAdversaryStrategy):
 DENSE_STRATEGIES = {
     "DenseEquivocator": DenseEquivocator,
     "DenseWithholder": DenseWithholder,
+    "DenseExAnteReorg": DenseExAnteReorg,
     "DenseSplitVoter": DenseSplitVoter,
     "DenseBalancer": DenseBalancer,
 }
@@ -487,4 +577,7 @@ def dense_adversary_from_config(d: dict) -> DenseAdversaryStrategy:
     elif kind == "DenseWithholder":
         kwargs = {"fork_slot": d.get("fork_slot", 2),
                   "release_slot": d.get("release_slot", 4)}
+    elif kind == "DenseExAnteReorg":
+        kwargs = {"fork_slot": d.get("fork_slot", 2),
+                  "span": d.get("span", 2)}
     return cls(controlled=controlled, **kwargs)

@@ -99,9 +99,9 @@ class _View:
     columns (inside the registry), FFG scalars and block visibility.
     ``n_groups=1`` runs exactly one of these — the pre-ISSUE-13 driver."""
 
-    __slots__ = ("registry", "msg_block", "msg_epoch", "bits", "prev_just",
-                 "cur_just", "finalized", "epoch_start_idx", "vis_host",
-                 "vis_d", "pending")
+    __slots__ = ("registry", "msg_block", "msg_epoch", "msg_slot", "bits",
+                 "prev_just", "cur_just", "finalized", "epoch_start_idx",
+                 "vis_host", "vis_d", "pending")
 
     def __init__(self):
         self.bits = np.zeros(4, dtype=bool)
@@ -128,7 +128,7 @@ class DenseSimulation:
                  check_walk_every: int = 16, autocheckpoint=None,
                  n_groups: int = 1, fault_plan=None, adversaries=(),
                  monitors=(), telemetry=None, phase_profile=None,
-                 flight_recorder=None):
+                 flight_recorder=None, variant=None, riders=()):
         import jax.numpy as jnp
         self.cfg = cfg or mainnet_config()
         self.n = int(n_validators)
@@ -154,6 +154,18 @@ class DenseSimulation:
         self.adversaries = list(adversaries)
         self.monitors = list(monitors)
         self.telemetry = telemetry
+        # protocol-variant seam (ISSUE 20): head/confirmation policy,
+        # duty shape (committee vs full participation), expiry windows,
+        # per-slot gadgets — DenseGasper reproduces the pre-variant
+        # driver bit-for-bit (no window, no anchor override, boost 0)
+        from pos_evolution_tpu.sim.dense_variants import (
+            dense_variant_from_config,
+        )
+        self.variant = dense_variant_from_config(variant)
+        self.riders = [r for r in riders if r is not None]
+        # per-view proposer-boost candidate: the newest timely (visible)
+        # proposal; None while withheld or before the first slot
+        self._boost: list[int | None] = [None] * self.n_groups
         # phase profiler (ISSUE 18 leg c): ``phase_profile=N`` fences
         # every N-th slot; None/0 threads the disabled twin so the loop
         # body stays branch-free either way
@@ -226,12 +238,16 @@ class DenseSimulation:
                 view.msg_epoch = build_sharded(
                     mesh, spec_for("messages/msg_epoch"), (self.n,),
                     np.int64, fill_const(0, np.int64))
+                view.msg_slot = build_sharded(
+                    mesh, spec_for("messages/msg_slot"), (self.n,),
+                    np.int64, fill_const(0, np.int64))
             else:
                 view.registry = DenseRegistry(**{
                     f: jnp.full(self.n, v, dtype=dt)
                     for f, (v, dt) in col_fills.items()})
                 view.msg_block = jnp.full(self.n, -1, dtype=jnp.int32)
                 view.msg_epoch = jnp.zeros(self.n, dtype=jnp.int64)
+                view.msg_slot = jnp.zeros(self.n, dtype=jnp.int64)
 
         # --- replicated O(B) block tree ------------------------------------
         self.capacity = _next_pow2(capacity)
@@ -266,13 +282,19 @@ class DenseSimulation:
 
         self._append_block(_hash(b"genesis", self.seed), -1, 0)
 
+        self.variant.bind(self)
+        for r in self.riders:
+            r.bind(self)
         for adv in self.adversaries:
             adv.bind(self)
         for mon in self.monitors:
             mon.bind(self)
         self._emit("run_start", n_validators=self.n,
                    n_groups=self.n_groups, dense=True,
-                   mesh=self._mesh_shape())
+                   mesh=self._mesh_shape(), variant=self.variant.name)
+        if self.variant.name != "gasper" or self.riders:
+            self._emit("variant_attach", variant=self.variant.describe(),
+                       riders=[r.describe() for r in self.riders])
         if self.adversaries or self.monitors:
             self._emit("monitor_attach",
                        monitors=[m.describe() for m in self.monitors],
@@ -299,6 +321,8 @@ class DenseSimulation:
                          lambda s, v: setattr(s.views[0], "msg_block", v))
     msg_epoch = property(lambda s: s.views[0].msg_epoch,
                          lambda s, v: setattr(s.views[0], "msg_epoch", v))
+    msg_slot = property(lambda s: s.views[0].msg_slot,
+                        lambda s, v: setattr(s.views[0], "msg_slot", v))
     bits = property(lambda s: s.views[0].bits,
                     lambda s, v: setattr(s.views[0], "bits", v))
     prev_just = property(lambda s: s.views[0].prev_just,
@@ -389,6 +413,52 @@ class DenseSimulation:
                 view.vis_host[i] = True
                 view.vis_d = view.vis_d.at[i].set(True)
 
+    def withhold_proposal(self, g: int, idx: int) -> None:
+        """Adversary proposer withholds this slot's proposal: the block
+        goes private in EVERY view (it was never broadcast), honest duty
+        falls back to voting its parent, and it earns no proposer boost
+        — the opening move of the ex-ante reorg. ``reveal_blocks``
+        undoes it at release time."""
+        for view in self.views:
+            view.vis_host[idx] = False
+            view.vis_d = view.vis_d.at[idx].set(False)
+
+    # -- variant seam ----------------------------------------------------------
+
+    def duty_mask(self, slot: int) -> np.ndarray:
+        """bool[N]: who votes this slot — the slot committee under
+        Gasper, everyone under the full-participation variants (the
+        per-slot audit the spec tier can't afford, ISSUE 20)."""
+        if self.variant.full_participation:
+            return np.ones(self.n, dtype=bool)
+        return self.committee_mask(slot)
+
+    def _vote_target(self, g: int, idx: int) -> int:
+        """What view ``g`` actually votes for when told to vote ``idx``:
+        the block if it is visible, else its parent (a withheld proposal
+        cannot attract honest votes)."""
+        return idx if self.views[g].vis_host[idx] else self.parents[idx]
+
+    def _variant_head_inputs(self, g: int):
+        """(window, start_idx, boost_idx, boost_amount) for one view's
+        head query — the SINGLE source both the device descent and the
+        host-walk oracle consume, so variant policy can never split
+        them. Window is as-of ``self.slot + 1`` (the next decision
+        point: during the propose pass that is the slot being built,
+        after ``self.slot = s`` it is the head entering slot s+1)."""
+        v = self.variant
+        win = v.window(self.slot + 1)
+        anchor = v.anchor(g)
+        start = self.views[g].cur_just[1] if anchor is None else anchor
+        bidx, bamt = -1, 0
+        if v.boost_percent and self._boost[g] is not None:
+            bidx = self._boost[g]
+            # the spec's committee-sized boost: one slot's share of
+            # total stake, scaled — exact integer math
+            bamt = (self.total_stake // self.S
+                    * v.boost_percent // 100)
+        return win, start, bidx, bamt
+
     # -- committees ------------------------------------------------------------
 
     def _start_epoch(self, epoch: int) -> None:
@@ -443,15 +513,35 @@ class DenseSimulation:
             rebuild_buckets,
         )
         view = self.views[g]
+        win, start, bidx, bamt = self._variant_head_inputs(g)
         with self.phases.phase("vote_pass"):
+            msg = view.msg_block
+            if win is not None:
+                # expiry-windowed variants: filter the message table
+                # before the unchanged weights pass (sharded twin /
+                # single-device jit twin — identical elementwise math)
+                if self.mesh is not None:
+                    from pos_evolution_tpu.parallel.sharded import (
+                        expiry_mask_for,
+                    )
+                    msg = expiry_mask_for(self.mesh)(
+                        msg, view.msg_slot, jnp.int64(win[0]),
+                        jnp.int64(win[1]))
+                else:
+                    from pos_evolution_tpu.sim.dense_variants import (
+                        expiry_kernel,
+                    )
+                    msg = expiry_kernel()(msg, view.msg_slot,
+                                          jnp.int64(win[0]),
+                                          jnp.int64(win[1]))
             if self.mesh is not None:
                 from pos_evolution_tpu.parallel.sharded import (
                     vote_weights_for,
                 )
                 buckets = vote_weights_for(self.mesh, self.capacity)(
-                    view.msg_block, view.registry.effective_balance)
+                    msg, view.registry.effective_balance)
             else:
-                buckets = rebuild_buckets(view.msg_block,
+                buckets = rebuild_buckets(msg,
                                           view.registry.effective_balance,
                                           self.capacity)
             if self._flight_probe:
@@ -465,8 +555,8 @@ class DenseSimulation:
         with self.phases.phase("head_descent"):
             head_idx, _ = head_from_buckets(
                 self._parent_d, self._real_d & view.vis_d, self._rank_d,
-                self._viable_d, jnp.int32(view.cur_just[1]), buckets,
-                jnp.int32(-1), jnp.int64(0), self.capacity)
+                self._viable_d, jnp.int32(start), buckets,
+                jnp.int32(bidx), jnp.int64(bamt), self.capacity)
             return int(head_idx)
 
     def head_host_walk(self, g: int = 0) -> bytes:
@@ -476,7 +566,11 @@ class DenseSimulation:
         MULTICHIP_r09, per view, withheld blocks masked out."""
         from pos_evolution_tpu.ops.forkchoice import head_host
         view = self.views[g]
+        win, start, bidx, bamt = self._variant_head_inputs(g)
         msg = np.asarray(view.msg_block)[: self.n]
+        if win is not None:
+            ms = np.asarray(view.msg_slot)[: self.n]
+            msg = np.where((ms >= win[0]) & (ms <= win[1]), msg, -1)
         eff = np.asarray(view.registry.effective_balance)[: self.n]
         valid = msg >= 0
         vw = np.zeros(self.capacity + 1, np.int64)
@@ -489,8 +583,8 @@ class DenseSimulation:
         real[:b] = True
         rank = np.asarray(self._rank_d)
         idx = head_host(parent, real & view.vis_host, rank,
-                        np.ones(self.capacity, bool), view.cur_just[1],
-                        vw[: self.capacity], -1, 0)
+                        np.ones(self.capacity, bool), start,
+                        vw[: self.capacity], bidx, bamt)
         return self.roots[idx]
 
     # -- monitors' gathered-tally helpers --------------------------------------
@@ -534,10 +628,12 @@ class DenseSimulation:
     # -- votes -----------------------------------------------------------------
 
     def _apply_batch(self, g: int, mask_np: np.ndarray, block_idx: int,
-                     epoch: int, flag_on: bool) -> None:
+                     epoch: int, vote_slot: int, flag_on: bool) -> None:
         """One masked vote landing on view ``g``'s sharded columns —
         the shard_map kernel on a mesh, its jitted elementwise twin on
-        a single device (identical math)."""
+        a single device (identical math). ``vote_slot`` stamps the
+        landed rows with the vote's ORIGINATION slot — the expiry and
+        per-slot-tally input of the variant plane."""
         import jax.numpy as jnp
         view = self.views[g]
         mask_col = self._place_validator_col(
@@ -547,11 +643,12 @@ class DenseSimulation:
             kern = vote_apply_for(self.mesh)
         else:
             kern = _vote_kernel()
-        mb, me, cf = kern(view.msg_block, view.msg_epoch,
-                          view.registry.cur_flags, mask_col,
-                          jnp.int32(block_idx), jnp.int64(epoch),
-                          jnp.bool_(flag_on))
-        view.msg_block, view.msg_epoch = mb, me
+        mb, me, ms, cf = kern(view.msg_block, view.msg_epoch,
+                              view.msg_slot, view.registry.cur_flags,
+                              mask_col, jnp.int32(block_idx),
+                              jnp.int64(epoch), jnp.int64(vote_slot),
+                              jnp.bool_(flag_on))
+        view.msg_block, view.msg_epoch, view.msg_slot = mb, me, ms
         view.registry = view.registry._replace(cur_flags=cf)
 
     def _fault_masks(self, slot: int, g: int):
@@ -570,6 +667,15 @@ class DenseSimulation:
         Returns the mask that actually landed."""
         from pos_evolution_tpu.sim.dense_adversary import VoteBatch
         mask = batch.mask
+        # origination stamp: a delayed/banked vote keeps its true slot
+        # through any number of requeues — expiry judges when the vote
+        # was CAST, not when it landed
+        vslot = slot if batch.slot is None else int(batch.slot)
+        if not self.variant.admit(vslot, slot):
+            # RLMD staleness gate: too old to merge into the view at all
+            self._emit("dense_fault", slot=slot, view=g,
+                       expired=int(mask.sum()))
+            return np.zeros(self.n, dtype=bool)
         if batch.faultable:
             dropped, delayed, crashed = self._fault_masks(slot, g)
             land = mask & ~crashed & ~dropped & ~delayed
@@ -577,7 +683,8 @@ class DenseSimulation:
             if late.any():
                 self.views[g].pending.append(
                     VoteBatch(late, batch.block, batch.epoch, views=(g,),
-                              flag=batch.flag, faultable=False))
+                              flag=batch.flag, faultable=False,
+                              slot=vslot))
             n_d, n_l = int((mask & dropped).sum()), int(late.sum())
             if n_d or n_l:
                 self._emit("dense_fault", slot=slot, view=g,
@@ -594,7 +701,7 @@ class DenseSimulation:
             # participation flag — deterministic and conservative
             flag_on = (batch.epoch == epoch_now
                        and self._target_matches(g, batch.block, batch.epoch))
-        self._apply_batch(g, land, batch.block, batch.epoch, flag_on)
+        self._apply_batch(g, land, batch.block, batch.epoch, vslot, flag_on)
         return land
 
     def apply_votes_now(self, batches, slot: int) -> None:
@@ -730,6 +837,16 @@ class DenseSimulation:
         delay = 1 if mode == "delay" else 0
         return [(h, delay) for h in range(self.n_groups) if h != g]
 
+    def _merge_active(self) -> bool:
+        """View-merge (Goldfish/RLMD): the slot proposer broadcasts its
+        merged view, so every group votes for the proposer group's
+        proposal and proposals reveal across views in-slot. A full
+        partition severs the broadcast — merge can't cross it."""
+        if not self.variant.view_merge or self.n_groups <= 1:
+            return False
+        mode = self.fault_plan.partition if self.fault_plan else None
+        return mode != "full"
+
     def run_slot(self) -> None:
         from pos_evolution_tpu.sim.dense_adversary import VoteBatch
         pt = self.phases
@@ -776,6 +893,7 @@ class DenseSimulation:
         # --- per-view proposals (head queries charge vote_pass /
         # head_descent inside _head; the block-tree bookkeeping around
         # them is "record") -------------------------------------------------
+        merge = self._merge_active()
         new_idx: list[int] = []
         for g in range(self.n_groups):
             head = self._head(g)
@@ -787,13 +905,17 @@ class DenseSimulation:
                                  self.roots[head], g)
                 visible_to = None
                 cross = self._cross_views(g)
-                if self.n_groups > 1:
+                if self.n_groups > 1 and not merge:
                     visible_to = [g] + [h for h, d in cross if d == 0]
                 idx = self._append_block(root, head, s,
                                          visible_to=visible_to)
-                for h, d in cross:
-                    if d > 0:
-                        self._pending_vis.append((idx, h, s + d))
+                if not merge:
+                    # view-merge reveals proposals across views in-slot
+                    # (the proposer broadcasts its merged view); without
+                    # it, delayed cross visibility lands next slot
+                    for h, d in cross:
+                        if d > 0:
+                            self._pending_vis.append((idx, h, s + d))
                 if s % self.S == 0:
                     self.views[g].epoch_start_idx[epoch] = idx
                 new_idx.append(idx)
@@ -801,6 +923,17 @@ class DenseSimulation:
         with pt.phase("record"):
             for adv in self.adversaries:
                 adv.on_proposals(self, s, new_idx)
+            # proposer-boost candidates for every head query until the
+            # next proposal: this slot's proposal, unless withheld
+            for g in range(self.n_groups):
+                self._boost[g] = (new_idx[g]
+                                  if self.views[g].vis_host[new_idx[g]]
+                                  else None)
+        if self.riders:
+            with pt.phase("workload"):
+                for r in self.riders:
+                    if hasattr(r, "on_proposals"):
+                        r.on_proposals(self, s, new_idx)
 
         # --- votes: pending (delayed) first, then honest, then adversarial
         with pt.phase("vote_apply"):
@@ -813,16 +946,29 @@ class DenseSimulation:
                     land = self._deliver_batch(g, batch, s, epoch)
                     if batch.block == new_idx[g]:
                         landed_own[g] |= land
-            committee = self.committee_mask(s)
+            # view-merge variants vote ONE merged target per slot (the
+            # proposer group's proposal — pos-evolution.md:1560); the
+            # others vote their own view's proposal. A withheld target
+            # falls back to its parent (the honest view never saw it).
+            vote_targets = [new_idx[s % self.n_groups] if merge
+                            else new_idx[g]
+                            for g in range(self.n_groups)]
+            duty_all = self.duty_mask(s)
             for g in range(self.n_groups):
-                duty = (committee & (self.group_of == g)
+                duty = (duty_all & (self.group_of == g)
                         & ~self.controlled_any)
-                batch = VoteBatch(duty, new_idx[g], epoch, views=(g,))
+                tgt = self._vote_target(g, vote_targets[g])
+                batch = VoteBatch(duty, tgt, epoch, views=(g,))
                 self._originated.append((g, batch))
-                landed_own[g] |= self._deliver_batch(g, batch, s, epoch)
+                land = self._deliver_batch(g, batch, s, epoch)
+                if tgt == new_idx[g]:
+                    landed_own[g] |= land
                 for h, delay in self._cross_views(g):
-                    cross = VoteBatch(duty.copy(), new_idx[g], epoch,
-                                      views=(h,))
+                    # stamp at origination: the delayed copy must carry
+                    # slot s into the next slot's delivery (expiry and
+                    # the per-slot tallies judge the cast slot)
+                    cross = VoteBatch(duty.copy(), tgt, epoch,
+                                      views=(h,), slot=s)
                     if delay == 0:
                         self._originated.append((h, cross))
                         self._deliver_batch(h, cross, s, epoch)
@@ -838,8 +984,11 @@ class DenseSimulation:
                                 landed_own[g] |= land
             pt.fence(*(v.msg_block for v in self.views))
 
-        if self.verify_aggregates:
-            # _verify_slot materializes the ok vector — self-fencing
+        if self.verify_aggregates and not self.variant.full_participation:
+            # _verify_slot materializes the ok vector — self-fencing.
+            # Full-participation variants replace committee aggregation
+            # with per-slot everyone-votes, so there is no committee
+            # aggregate to verify.
             with pt.phase("aggregate_verify"):
                 for g in range(self.n_groups):
                     if landed_own[g].any():
@@ -850,6 +999,16 @@ class DenseSimulation:
         self.slot = s
         self.view_heads = [self.roots[new_idx[g]]
                            for g in range(self.n_groups)]
+
+        # --- variant plane: per-slot tallies / gadgets over the sharded
+        # link tallies (expiry confirmation, SSF justify/finalize) ---------
+        with pt.phase("variant_tally"):
+            self.variant.on_slot_end(self, s, vote_targets)
+        if self.riders:
+            with pt.phase("workload"):
+                for r in self.riders:
+                    if hasattr(r, "on_slot_end"):
+                        r.on_slot_end(self, s)
 
         # --- monitors over the gathered tallies ---------------------------
         with pt.phase("monitors"):
@@ -870,6 +1029,14 @@ class DenseSimulation:
             with pt.phase("host_audit"):
                 self.walk_checks.append(self.head_host_walk(0) ==
                                         dev_head)
+                if self.mesh is not None and self.variant.name != "gasper":
+                    # sharded windowed tally vs the ops/variant_tally
+                    # host oracle — the variant plane's parity audit
+                    from pos_evolution_tpu.sim.dense_variants import (
+                        variant_tally_parity,
+                    )
+                    self.walk_checks.append(
+                        variant_tally_parity(self, 0, s))
         with pt.phase("record"):
             m = {
                 "slot": s, "head_root": self.view_heads[0].hex()[:16],
@@ -933,6 +1100,14 @@ class DenseSimulation:
                              "finalized_epoch": v.finalized[0],
                              "head_root": self.view_heads[g].hex()[:16]}
                             for g, v in enumerate(self.views)]
+        out["variant"] = self.variant.name
+        if self.variant.name != "gasper":
+            out["variant_decisions"] = len(self.variant.decisions)
+            vs = self.variant.summary_fields(self)
+            if vs:
+                out["variant_state"] = vs
+        if self.riders:
+            out["workload"] = {r.kind: r.stats() for r in self.riders}
         if self.monitors or self.adversaries:
             out["monitor_violations"] = len(self.monitor_violations)
             out["violation_kinds"] = sorted(
@@ -979,6 +1154,7 @@ class DenseSimulation:
                 cols[prefix + f] = a[: self.n]
             cols[prefix + "msg_block"] = np.asarray(view.msg_block)[: self.n]
             cols[prefix + "msg_epoch"] = np.asarray(view.msg_epoch)[: self.n]
+            cols[prefix + "msg_slot"] = np.asarray(view.msg_slot)[: self.n]
             pend_meta = []
             for j, b in enumerate(view.pending):
                 cols[f"v{g}_pend{j}_idx"] = \
@@ -986,7 +1162,9 @@ class DenseSimulation:
                 pend_meta.append({"block": int(b.block),
                                   "epoch": int(b.epoch),
                                   "flag": b.flag,
-                                  "faultable": bool(b.faultable)})
+                                  "faultable": bool(b.faultable),
+                                  "slot": (None if b.slot is None
+                                           else int(b.slot))})
             views_meta.append({
                 "bits": [bool(x) for x in view.bits],
                 "prev_just": list(view.prev_just),
@@ -1016,8 +1194,18 @@ class DenseSimulation:
                               "state": m.state_meta()}
                              for m in self.monitors],
             }
+        for i, r in enumerate(self.riders):
+            for name, arr in r.state_arrays().items():
+                cols[f"rider{i}_{name}"] = np.asarray(arr)
         meta = {
-            "version": 2, "n": self.n, "seed": self.seed,
+            "version": 3, "n": self.n, "seed": self.seed,
+            # the variant fingerprint: resume reconstructs the policy
+            # from this and refuses an ``expect_variant`` mismatch loudly
+            "variant": self.variant.describe(),
+            "variant_state": self.variant.state_meta(),
+            "riders": [{"config": r.describe(), "state": r.state_meta()}
+                       for r in self.riders],
+            "boost": [None if b is None else int(b) for b in self._boost],
             "shuffle_rounds": self.shuffle_rounds,
             "verify_aggregates": self.verify_aggregates,
             "capacity": self.capacity,
@@ -1084,8 +1272,9 @@ class DenseSimulation:
         return job
 
     @classmethod
-    def resume(cls, data: bytes, mesh=None,
-               telemetry=None) -> "DenseSimulation":
+    def resume(cls, data: bytes, mesh=None, telemetry=None,
+               expect_variant: str | None = None, phase_profile=None,
+               flight_recorder=None) -> "DenseSimulation":
         from pos_evolution_tpu.sim.dense_adversary import (
             VoteBatch,
             dense_adversary_from_config,
@@ -1093,12 +1282,23 @@ class DenseSimulation:
         from pos_evolution_tpu.sim.dense_monitors import (
             dense_monitor_from_config,
         )
+        from pos_evolution_tpu.sim.dense_variants import (
+            dense_rider_from_config,
+            dense_variant_from_config,
+        )
         from pos_evolution_tpu.sim.faults import DenseFaultPlan
         buf = io.BytesIO(data)
         (n_head,) = np.frombuffer(buf.read(8), dtype=np.uint64)
         meta = json.loads(buf.read(int(n_head)).decode())
-        assert meta["version"] in (1, 2), meta["version"]
+        assert meta["version"] in (1, 2, 3), meta["version"]
         v1 = meta["version"] == 1
+        ckpt_variant = (meta.get("variant") or {"kind": "gasper"})["kind"]
+        if expect_variant is not None and ckpt_variant != expect_variant:
+            raise ValueError(
+                f"checkpoint was written under variant {ckpt_variant!r}, "
+                f"refusing to resume it as {expect_variant!r}: the "
+                f"message-table semantics (expiry stamps, per-slot "
+                f"gadget state) are not interchangeable across variants")
         cfg = Config(**{
             k: (bytes.fromhex(v[1])
                 if isinstance(v, list) and len(v) == 2 and v[0] == "__bytes__"
@@ -1112,6 +1312,8 @@ class DenseSimulation:
                            for a in chaos.get("adversaries", [])]
             monitors = [dense_monitor_from_config(m["config"])
                         for m in chaos.get("monitors", [])]
+        riders = [dense_rider_from_config(r["config"])
+                  for r in meta.get("riders", [])]
         sim = cls(meta["n"], cfg=cfg, mesh=mesh, seed=meta["seed"],
                   shuffle_rounds=meta["shuffle_rounds"],
                   verify_aggregates=meta["verify_aggregates"],
@@ -1120,7 +1322,11 @@ class DenseSimulation:
                   n_groups=meta.get("n_groups", 1),
                   fault_plan=fault_plan,
                   adversaries=adversaries or (),
-                  monitors=monitors or (), telemetry=telemetry)
+                  monitors=monitors or (), telemetry=telemetry,
+                  phase_profile=phase_profile,
+                  flight_recorder=flight_recorder,
+                  variant=dense_variant_from_config(meta.get("variant")),
+                  riders=riders)
         views_meta = ([{
             "bits": meta["bits"], "prev_just": meta["prev_just"],
             "cur_just": meta["cur_just"], "finalized": meta["finalized"],
@@ -1158,6 +1364,11 @@ class DenseSimulation:
                 arrays[prefix + "msg_block"], "messages/msg_block")
             view.msg_epoch = sim._place_validator_col(
                 arrays[prefix + "msg_epoch"], "messages/msg_epoch")
+            ms_key = prefix + "msg_slot"
+            view.msg_slot = sim._place_validator_col(
+                arrays[ms_key] if ms_key in arrays
+                else np.zeros(sim.n, np.int64),  # pre-v3: no stamps
+                "messages/msg_slot")
             view.bits = np.asarray(vm["bits"], dtype=bool)
             view.prev_just = tuple(vm["prev_just"])
             view.cur_just = tuple(vm["cur_just"])
@@ -1175,10 +1386,12 @@ class DenseSimulation:
             for j, pm in enumerate(vm.get("pending", [])):
                 mask = np.zeros(sim.n, dtype=bool)
                 mask[arrays[f"v{g}_pend{j}_idx"]] = True
+                pslot = pm.get("slot")
                 view.pending.append(VoteBatch(
                     mask, int(pm["block"]), int(pm["epoch"]), views=(g,),
                     flag=pm.get("flag"),
-                    faultable=bool(pm.get("faultable", False))))
+                    faultable=bool(pm.get("faultable", False)),
+                    slot=None if pslot is None else int(pslot)))
         sim._pending_vis = [tuple(t) for t in meta.get("pending_vis", [])]
         sim.slot = meta["slot"]
         sim.aggregates_verified = meta["aggregates_verified"]
@@ -1200,6 +1413,15 @@ class DenseSimulation:
                 mon.restore_state(mm.get("state", {}), {
                     k[len(f"mon{i}_"):]: v for k, v in arrays.items()
                     if k.startswith(f"mon{i}_")})
+        sim.variant.restore_state(meta.get("variant_state", {}))
+        for i, (r, rm) in enumerate(zip(sim.riders,
+                                        meta.get("riders", []))):
+            r.restore_state(rm.get("state", {}), {
+                k[len(f"rider{i}_"):]: v for k, v in arrays.items()
+                if k.startswith(f"rider{i}_")})
+        boost = meta.get("boost")
+        if boost is not None:
+            sim._boost = [None if b is None else int(b) for b in boost]
         perm = arrays.get("perm")
         if perm is not None and sim._epoch_ready >= 0:
             sim._perm_host = perm.astype(np.int64)
@@ -1227,8 +1449,9 @@ class DenseSimulation:
                                        self._checkpoint_async_capture)
 
     @classmethod
-    def resume_latest(cls, dir, mesh=None,
-                      autocheckpoint=None) -> "DenseSimulation":
+    def resume_latest(cls, dir, mesh=None, autocheckpoint=None,
+                      expect_variant: str | None = None
+                      ) -> "DenseSimulation":
         """Resume from the newest *valid* checkpoint under ``dir``,
         quarantining and rolling past corrupt steps — onto whatever
         mesh is ACTIVE now (``mesh=None`` = single device), which is
@@ -1244,7 +1467,8 @@ class DenseSimulation:
             raise FileNotFoundError(
                 f"no valid checkpoint under {dir!r} to resume from")
         step, payloads = found
-        sim = cls.resume(payloads["payload.bin"], mesh=mesh)
+        sim = cls.resume(payloads["payload.bin"], mesh=mesh,
+                         expect_variant=expect_variant)
         if autocheckpoint is not None:
             sim.attach_autocheckpoint(autocheckpoint)
         from pos_evolution_tpu.telemetry import emit_global
@@ -1265,9 +1489,11 @@ def _vote_kernel():
         import jax
         import jax.numpy as jnp
 
-        def kern(msg_block, msg_epoch, cur_flags, mask, idx, ep, flag_on):
+        def kern(msg_block, msg_epoch, msg_slot, cur_flags, mask,
+                 idx, ep, vslot, flag_on):
             return (jnp.where(mask, idx, msg_block),
                     jnp.where(mask, ep, msg_epoch),
+                    jnp.where(mask, vslot, msg_slot),
                     jnp.where(mask & flag_on,
                               cur_flags | np.uint8(7), cur_flags))
         _VOTE_KERNEL = jax.jit(kern)

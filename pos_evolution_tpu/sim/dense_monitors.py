@@ -40,7 +40,8 @@ import numpy as np
 
 __all__ = [
     "DenseMonitor", "DenseAccountableSafetyMonitor",
-    "DenseFinalityLivenessMonitor", "DenseForkChoiceParityMonitor",
+    "DenseVariantSafetyMonitor", "DenseFinalityLivenessMonitor",
+    "DenseForkChoiceParityMonitor",
     "default_dense_monitors", "dense_monitor_from_config",
 ]
 
@@ -167,6 +168,126 @@ class DenseAccountableSafetyMonitor(DenseMonitor):
         self._reported = {tuple(k) for k in meta.get("reported", [])}
 
 
+class DenseVariantSafetyMonitor(DenseMonitor):
+    """Judges each variant by ITS OWN finality rule (ISSUE 20): the FFG
+    monitor above prices conflicting epoch checkpoints, but the per-slot
+    variants decide at slot granularity — SSF finalizes in-slot, the
+    expiry variants confirm per slot. This monitor reads the variant's
+    per-view decision state (``fin_log`` / ``conf_idx``) and prices
+    conflicts with the same double-vote evidence column, now keyed by
+    SLOT (two votes cast the same slot for different blocks — exactly
+    the per-slot equivocation the SSF slashing conditions name):
+
+    - conflicting per-view SSF finalizations at the same slot with
+      evidence >= 1/3 stake -> ``accountable_double_finality`` (the
+      theorem holding at slot granularity); with less evidence ->
+      ``protocol_violation`` (what the doctored negative forges);
+    - cross-view Goldfish/RLMD confirmations where neither confirmed
+      block descends from the other -> ``confirmation_divergence``
+      (expected under a partition: confirmation is synchrony-dependent,
+      pos-evolution.md:1573 — the monitor names it, the matrix expects
+      it).
+
+    Inert under Gasper (no per-slot decision state to read)."""
+
+    name = "variant_safety"
+
+    def bind(self, sim) -> None:
+        super().bind(sim)
+        self.implicated = np.zeros(sim.n, dtype=bool)
+        self._reported: set = set()
+
+    def on_votes(self, sim, slot: int, originated: list) -> None:
+        for i in range(len(originated)):
+            for j in range(i + 1, len(originated)):
+                (_, a), (_, b) = originated[i], originated[j]
+                sa = slot if a.slot is None else a.slot
+                sb = slot if b.slot is None else b.slot
+                if sa == sb and a.block != b.block:
+                    both = a.mask & b.mask
+                    if both.any():
+                        self.implicated |= both
+
+    def on_slot_end(self, sim, slot: int) -> list[dict]:
+        variant = sim.variant
+        out = []
+        fin_log = getattr(variant, "fin_log", None)
+        if fin_log is not None:
+            # SSF: any same-slot, different-block pair across views
+            for i in range(sim.n_groups):
+                for j in range(i + 1, sim.n_groups):
+                    for s_i, b_i in fin_log[i]:
+                        for s_j, b_j in fin_log[j]:
+                            if s_i != s_j or b_i == b_j:
+                                continue
+                            key = ("fin", i, j, s_i, b_i, b_j)
+                            if key in self._reported:
+                                continue
+                            self._reported.add(key)
+                            stake = sim.stake_of(self.implicated)
+                            total = sim.total_stake
+                            accountable = 3 * stake >= total
+                            out.append({
+                                "monitor": self.name,
+                                "kind": ("accountable_double_finality"
+                                         if accountable
+                                         else "protocol_violation"),
+                                "rule": variant.name,
+                                "groups": [i, j],
+                                "decision_slot": int(s_i),
+                                "roots": [sim.roots[b_i].hex()[:16],
+                                          sim.roots[b_j].hex()[:16]],
+                                "evidence_size":
+                                    int(self.implicated.sum()),
+                                "slashable_stake": int(stake),
+                                "total_stake": int(total),
+                                "detail": (
+                                    f"views {i}/{j} finalized conflicting "
+                                    f"blocks at slot {s_i} under "
+                                    f"{variant.name}; per-slot double-vote "
+                                    f"evidence covers {stake}/{total} stake"
+                                    + ("" if accountable else
+                                       " — BELOW the 1/3 bound")),
+                            })
+        conf = getattr(variant, "conf_idx", None)
+        if conf is not None:
+            for i in range(sim.n_groups):
+                for j in range(i + 1, sim.n_groups):
+                    a, b = conf[i], conf[j]
+                    if a == b or sim._descends(a, b) \
+                            or sim._descends(b, a):
+                        continue
+                    key = ("conf", i, j, a, b)
+                    if key in self._reported:
+                        continue
+                    self._reported.add(key)
+                    out.append({
+                        "monitor": self.name,
+                        "kind": "confirmation_divergence",
+                        "rule": variant.name,
+                        "groups": [i, j],
+                        "roots": [sim.roots[a].hex()[:16],
+                                  sim.roots[b].hex()[:16]],
+                        "detail": (
+                            f"views {i}/{j} confirmed diverging blocks "
+                            f"under {variant.name} (confirmation is "
+                            f"synchrony-dependent — expected under a "
+                            f"partition, never under clean conditions)"),
+                    })
+        return out
+
+    def state_meta(self) -> dict:
+        return {"reported": [list(k) for k in sorted(self._reported)]}
+
+    def state_arrays(self) -> dict:
+        return {"implicated": self.implicated}
+
+    def restore_state(self, meta: dict, arrays: dict) -> None:
+        self.implicated = np.asarray(arrays["implicated"],
+                                     dtype=bool).copy()
+        self._reported = {tuple(k) for k in meta.get("reported", [])}
+
+
 class DenseFinalityLivenessMonitor(DenseMonitor):
     """Plausible-liveness auditor; disarmed (loudly, in ``describe``)
     when the theorem's preconditions cannot hold."""
@@ -287,11 +408,13 @@ def default_dense_monitors(bound_epochs: int = 4,
     """The full dense audit stack (dense chaos fuzzing default)."""
     return [DenseAccountableSafetyMonitor(),
             DenseFinalityLivenessMonitor(bound_epochs=bound_epochs),
-            DenseForkChoiceParityMonitor(every=parity_every)]
+            DenseForkChoiceParityMonitor(every=parity_every),
+            DenseVariantSafetyMonitor()]
 
 
 _MONITORS = {
     "DenseAccountableSafetyMonitor": DenseAccountableSafetyMonitor,
+    "DenseVariantSafetyMonitor": DenseVariantSafetyMonitor,
     "DenseFinalityLivenessMonitor": DenseFinalityLivenessMonitor,
     "DenseForkChoiceParityMonitor": DenseForkChoiceParityMonitor,
 }

@@ -422,24 +422,59 @@ def _finish_serve(front, loader, serve_cfg, telemetry):
 
 _DENSE_SCENARIOS = ("equivocator_faulted", "withholder", "splitvoter",
                     "balancer")
+_DENSE_VARIANTS = ("gasper", "goldfish", "rlmd", "ssf")
+_DENSE_WORKLOADS = ("none", "das-merkle", "das-kzg")
+
+
+def _dense_workload(choice: str, seed: int, episode: int) -> dict:
+    """Rider configs for one workload draw (ISSUE 20): the DAS sidecar
+    pipeline (merkle or kzg cell commitments, built/verified/sampled per
+    dense proposal) plus the dense light-client population following the
+    active variant's own decision rule."""
+    if choice == "none":
+        return {"choice": "none", "riders": []}
+    scheme = "kzg" if choice.endswith("kzg") else "merkle"
+    return {"choice": choice, "riders": [
+        # the erasure-reconstruction leg is the expensive half (kzg
+        # additionally runs the Fr/NTT engine), so it thins to every
+        # N-th proposal; commitments + sampling run on every one
+        {"kind": "das", "scheme": scheme, "n_blobs": 1, "n_clients": 16,
+         "samples_per_client": 2, "seed": int(seed) * 31 + episode,
+         "verify_every": 4 if scheme == "kzg" else 2},
+        {"kind": "lightclient", "n_clients": 16,
+         "seed": int(seed) * 17 + episode},
+    ]}
 
 
 def episode_config_dense(seed: int, episode: int, n_validators: int = 576,
                          n_epochs: int = 4, slots_per_epoch: int = 8,
                          mesh: str | None = None, doctor: bool = False,
                          scenario: str | None = None,
-                         scheme: str = "merkle") -> dict:
+                         scheme: str = "merkle",
+                         variant: str | None = None,
+                         workload: str | None = None) -> dict:
     """One DENSE episode's composition from (seed, episode) alone: a
-    scenario (which vectorized strategy + network shape), a seeded
-    ``DenseFaultPlan``, and the expectation the verdict is judged
-    against. ``n_validators`` should divide by 24 (mesh divisibility x
-    the exactly-1/3 SplitVoter split)."""
+    protocol variant, a scenario (which vectorized strategy + network
+    shape), a workload draw (DAS sidecars + light clients, or none), a
+    seeded ``DenseFaultPlan``, and the expectation the verdict is judged
+    against — the full protocol x attack x workload product (ISSUE 20).
+    ``n_validators`` should divide by 24 (mesh divisibility x the
+    exactly-1/3 SplitVoter split)."""
     u = lambda dom, k: stateless_unit(seed, dom, episode, k)  # noqa: E731
     n = int(n_validators)
     n_slots = n_epochs * slots_per_epoch
+    if variant is None:
+        variant = _DENSE_VARIANTS[min(int(u(_D_DENSE, 7) * 4), 3)]
+    if workload is None:
+        workload = _DENSE_WORKLOADS[min(int(u(_D_DENSE, 8) * 3), 2)]
     if scenario is None:
+        # the balancer's table-balancing model assumes committee duty;
+        # the full-participation variants swap it for the ex-ante cell
+        opts = (_DENSE_SCENARIOS + ("exante",) if variant == "gasper"
+                else ("equivocator_faulted", "withholder", "splitvoter",
+                      "exante"))
         r = u(_D_DENSE, 0)
-        scenario = _DENSE_SCENARIOS[min(int(r * 4), 3)]
+        scenario = opts[min(int(r * len(opts)), len(opts) - 1)]
     if doctor:
         scenario = "doctor"
     two_view = scenario in ("splitvoter", "balancer", "doctor")
@@ -477,15 +512,41 @@ def episode_config_dense(seed: int, episode: int, n_validators: int = 576,
         # evidence pinned at exactly 1/3 of stake
         expect = {"clean": False, "accountable_double_finality": True,
                   "exact_third": True}
+        if variant == "ssf":
+            # the per-slot gadget must ALSO double-finalize accountably
+            # (accountable_double_finality from the variant monitor)
+            expect["ssf_double_finality"] = True
+        elif variant in ("goldfish", "rlmd"):
+            # kappa-deep confirmation diverges UNACCOUNTABLY under the
+            # partition — the paper's motivation for SSF, named by the
+            # variant monitor
+            expect["confirmation_divergence"] = True
     elif scenario == "balancer":
         faults["partition"] = "delay"
         # strictly below 1/3 so the liveness monitor stays armed
         adversaries.append({"kind": "DenseBalancer",
                             "controlled": [[0, (n * 5) // 16]]})
         expect = {"clean": False, "liveness_stall": True}
+    elif scenario == "exante":
+        # committee-targeted multi-slot ex-ante reorg: the banked
+        # margin is span*f - (span-1)*(1-f) committees, so f=0.45 keeps
+        # the outcome several sigma past committee-shuffle variance
+        # even at smoke sizes. A pure fork-choice attack — no monitor
+        # fires either way; full-participation variants must defend
+        # structurally (latest-message collapse on the revealed chain).
+        adversaries.append({"kind": "DenseExAnteReorg",
+                            "controlled": [[0, int(n * 0.45)]],
+                            "fork_slot": 2, "span": 2})
+        expect = ({"clean": True} if variant == "gasper"
+                  else {"clean": True, "exante_defended": True})
     else:   # doctor: honest partitioned pair + forged double finality
         faults["partition"] = "full"
         expect = {"clean": False, "protocol_violation": True}
+        if variant in ("goldfish", "rlmd"):
+            # the honest halves legitimately confirm diverging chains
+            # under the partition — explained, not required
+            expect["confirmation_divergence_ok"] = True
+    wl = _dense_workload(workload, seed, episode)
     return {
         "schema": SCHEMA, "dense": True,
         "seed": int(seed), "episode": int(episode),
@@ -493,10 +554,18 @@ def episode_config_dense(seed: int, episode: int, n_validators: int = 576,
         "slots_per_epoch": int(slots_per_epoch),
         "n_groups": 2 if two_view else 1,
         "mesh": mesh, "scenario": scenario,
-        # recorded for composition completeness/replay parity with the
-        # serve episodes; dense sims carry no blob sidecars, so the
-        # cell-commitment scheme is inert here
-        "scheme": str(scheme),
+        # the protocol variant is part of the composition (ISSUE 20):
+        # every episode replays under the variant that produced it, and
+        # the checkpoint's variant fingerprint refuses cross-variant
+        # resume. Ex-ante cells run pre-boost (the boost defense is a
+        # pinned variant_matrix cell, not a fuzz draw).
+        "variant": ({"kind": variant, "boost_percent": 0}
+                    if scenario == "exante" else {"kind": variant}),
+        # workload draw: rider configs ride the composition AND the
+        # checkpoint, so a replay rebuilds byte-identical sidecars
+        "workload": wl,
+        "scheme": (wl["riders"][0]["scheme"] if wl["riders"]
+                   else str(scheme)),
         "faults": faults, "adversaries": adversaries,
         "monitors": {"bound_epochs": 2 if scenario == "balancer" else 4,
                      "parity_every": 2},
@@ -539,21 +608,29 @@ def _doctor_dense(sim) -> None:
 
 def run_dense_episode(cfg: dict, events_path: str | None = None,
                       resume_from: bytes | None = None,
-                      bundle_dir: str | None = None) -> dict:
+                      bundle_dir: str | None = None,
+                      phase_profile: int | None = 8) -> dict:
     """Run one dense episode; same bundle/replay shape as
     ``run_episode``. ``resume_from`` replays from the bundle's
     episode-start checkpoint via ``DenseSimulation.resume`` — the
-    checkpoint carries the full chaos composition + adversary/monitor
-    state in-band, and the run is bit-identical on ANY mesh layout, so
-    a 2x4 bundle replays exactly on a single device."""
+    checkpoint carries the full chaos composition + adversary/monitor/
+    variant/rider state in-band, and the run is bit-identical on ANY
+    mesh layout, so a 2x4 bundle replays exactly on a single device.
+
+    Attack runs get the same observability as benign ones (ISSUE 20
+    satellite): when the episode records events, the PR-19
+    ``FlightRecorder`` arms (compile attribution, HBM watermarks) and
+    the dense phase profiler fences every ``phase_profile``-th slot —
+    ``variant_tally`` / ``workload`` phases included."""
     from pos_evolution_tpu.config import mainnet_config
     from pos_evolution_tpu.sim.dense_adversary import (
         dense_adversary_from_config,
     )
     from pos_evolution_tpu.sim.dense_driver import DenseSimulation
     from pos_evolution_tpu.sim.dense_monitors import default_dense_monitors
+    from pos_evolution_tpu.sim.dense_variants import dense_rider_from_config
     from pos_evolution_tpu.sim.faults import DenseFaultPlan
-    from pos_evolution_tpu.telemetry import Telemetry
+    from pos_evolution_tpu.telemetry import FlightRecorder, Telemetry
     from pos_evolution_tpu.utils.snapshot import atomic_write_bytes
 
     if bundle_dir is not None:
@@ -567,39 +644,55 @@ def run_dense_episode(cfg: dict, events_path: str | None = None,
                  if events_path is not None else None)
     mesh = _dense_mesh(cfg.get("mesh"))
     n_slots = cfg["n_epochs"] * cfg["slots_per_epoch"]
+    # the DAS riders size their blob grids off the ACTIVE config, so the
+    # episode pins it (fresh run, resume and replay alike) — sidecars
+    # rebuild byte-identical across all three
+    cfg_obj = mainnet_config().replace(
+        slots_per_epoch=cfg["slots_per_epoch"],
+        max_committees_per_slot=4)
+    flight = (FlightRecorder(telemetry=telemetry, sample_every=8).install()
+              if telemetry is not None else None)
+    profile = phase_profile if telemetry is not None else None
     try:
-        if resume_from is not None:
-            sim = DenseSimulation.resume(resume_from, mesh=mesh,
-                                         telemetry=telemetry)
-            checkpoint = resume_from
-        else:
-            cfg_obj = mainnet_config().replace(
-                slots_per_epoch=cfg["slots_per_epoch"],
-                max_committees_per_slot=4)
-            m = cfg.get("monitors", {})
-            sim = DenseSimulation(
-                cfg["n_validators"], cfg=cfg_obj, mesh=mesh,
-                seed=cfg["seed"] * 101 + cfg["episode"],
-                shuffle_rounds=6, verify_aggregates=False,
-                check_walk_every=0,
-                n_groups=cfg.get("n_groups", 1),
-                fault_plan=DenseFaultPlan.from_config(cfg.get("faults")),
-                adversaries=[dense_adversary_from_config(a)
-                             for a in cfg.get("adversaries", ())],
-                monitors=default_dense_monitors(
-                    bound_epochs=m.get("bound_epochs", 4),
-                    parity_every=m.get("parity_every", 2)),
-                telemetry=telemetry)
-            checkpoint = sim.checkpoint()
-        if bundle_dir is not None:
-            atomic_write_bytes(os.path.join(bundle_dir, "checkpoint.bin"),
-                               checkpoint)
-        doctor = cfg.get("doctor")
-        while sim.slot < n_slots:
-            sim.run_slot()
-            if doctor is not None and sim.slot == doctor["slot"]:
-                _doctor_dense(sim)
+        with use_config(cfg_obj):
+            if resume_from is not None:
+                sim = DenseSimulation.resume(
+                    resume_from, mesh=mesh, telemetry=telemetry,
+                    expect_variant=(cfg.get("variant") or {}).get("kind"),
+                    phase_profile=profile, flight_recorder=flight)
+                checkpoint = resume_from
+            else:
+                m = cfg.get("monitors", {})
+                wl = cfg.get("workload") or {}
+                sim = DenseSimulation(
+                    cfg["n_validators"], cfg=cfg_obj, mesh=mesh,
+                    seed=cfg["seed"] * 101 + cfg["episode"],
+                    shuffle_rounds=6, verify_aggregates=False,
+                    check_walk_every=0,
+                    n_groups=cfg.get("n_groups", 1),
+                    fault_plan=DenseFaultPlan.from_config(cfg.get("faults")),
+                    adversaries=[dense_adversary_from_config(a)
+                                 for a in cfg.get("adversaries", ())],
+                    monitors=default_dense_monitors(
+                        bound_epochs=m.get("bound_epochs", 4),
+                        parity_every=m.get("parity_every", 2)),
+                    variant=cfg.get("variant"),
+                    riders=[dense_rider_from_config(r)
+                            for r in wl.get("riders", ())],
+                    telemetry=telemetry, phase_profile=profile,
+                    flight_recorder=flight)
+                checkpoint = sim.checkpoint()
+            if bundle_dir is not None:
+                atomic_write_bytes(
+                    os.path.join(bundle_dir, "checkpoint.bin"), checkpoint)
+            doctor = cfg.get("doctor")
+            while sim.slot < n_slots:
+                sim.run_slot()
+                if doctor is not None and sim.slot == doctor["slot"]:
+                    _doctor_dense(sim)
     finally:
+        if flight is not None:
+            flight.detach()
         if telemetry is not None:
             telemetry.close()
     summary = sim.summary()
@@ -609,6 +702,15 @@ def run_dense_episode(cfg: dict, events_path: str | None = None,
         "checkpoint": checkpoint,
         "summary": summary,
     }
+    # ex-ante verdict: did the withheld proposal capture the head?
+    for adv in sim.adversaries:
+        if getattr(adv, "name", "") == "dense_exante_reorg" \
+                and getattr(adv, "priv", None):
+            result["reorged"] = bool(
+                sim._descends(sim._head(0), adv.priv[0]))
+    if flight is not None and bundle_dir is not None:
+        flight.write_artifact(
+            os.path.join(bundle_dir, "device_ledger.json"))
     result.update(_dense_expectations(cfg, result))
     return result
 
@@ -625,6 +727,11 @@ def _dense_expectations(cfg: dict, result: dict) -> dict:
         explained_kinds.add("liveness_violation")
     if expect.get("protocol_violation"):
         explained_kinds.add("protocol_violation")
+    if expect.get("ssf_double_finality"):
+        explained_kinds.add("accountable_double_finality")
+    if expect.get("confirmation_divergence") \
+            or expect.get("confirmation_divergence_ok"):
+        explained_kinds.add("confirmation_divergence")
     unexpected = [v for v in violations
                   if v.get("kind") not in explained_kinds]
     missed = []
@@ -644,6 +751,20 @@ def _dense_expectations(cfg: dict, result: dict) -> dict:
         if any(g["justified_epoch"] > 0
                for g in result["summary"].get("views", [])):
             missed.append("justification_not_stalled")
+    if expect.get("ssf_double_finality"):
+        ssf = [v for v in violations
+               if v.get("kind") == "accountable_double_finality"]
+        if not ssf:
+            missed.append("ssf_double_finality")
+        elif expect.get("exact_third") and not any(
+                3 * v["slashable_stake"] == v["total_stake"] for v in ssf):
+            missed.append("ssf_evidence_exactly_one_third")
+    if expect.get("confirmation_divergence") and not any(
+            v.get("kind") == "confirmation_divergence"
+            for v in violations):
+        missed.append("confirmation_divergence_not_observed")
+    if expect.get("exante_defended") and result.get("reorged"):
+        missed.append("exante_reorg_not_defended")
     if expect.get("protocol_violation") and not any(
             v.get("kind") == "protocol_violation" for v in violations):
         missed.append("protocol_violation_not_tripped")
@@ -655,10 +776,13 @@ def _dense_expectations(cfg: dict, result: dict) -> dict:
 def fuzz_dense(episodes: int, seed: int, n_validators: int, n_epochs: int,
                out_dir: str, mesh: str | None = None, doctor: bool = False,
                step_timeout: float | None = None,
-               history: str | None = None, scheme: str = "merkle") -> dict:
+               history: str | None = None, scheme: str = "merkle",
+               variant: str | None = None,
+               workload: str | None = None) -> dict:
     """The dense episode matrix: every episode is a sharded adversarial
-    run with the full dense monitor stack; bundles are replayable via
-    ``--replay`` exactly like spec bundles."""
+    run with the full dense monitor stack, drawn from the protocol x
+    attack x workload product (``variant``/``workload`` force one axis);
+    bundles are replayable via ``--replay`` exactly like spec bundles."""
     import time as _time
 
     from pos_evolution_tpu.utils.watchdog import Watchdog
@@ -667,18 +791,23 @@ def fuzz_dense(episodes: int, seed: int, n_validators: int, n_epochs: int,
                   tag="chaos_fuzz_dense", timeout_s=step_timeout)
     summary = {"mode": "dense", "episodes": 0, "violating": 0,
                "bundles": [], "incidents": 0, "accountable": 0,
-               "scenarios": {}}
+               "scenarios": {}, "variants": {}, "workloads": {}}
     t0 = _time.time()
     n_blocks = n_slots_total = n_violations = 0
     for ep in range(episodes):
         cfg = episode_config_dense(seed, ep, n_validators, n_epochs,
-                                   mesh=mesh, doctor=doctor, scheme=scheme)
+                                   mesh=mesh, doctor=doctor, scheme=scheme,
+                                   variant=variant, workload=workload)
         inflight = os.path.join(out_dir, f"inflight_ep{ep}")
         result = wd.step(f"dense_episode_{ep}", run_dense_episode, cfg,
                          bundle_dir=inflight)
         summary["episodes"] += 1
-        sc = cfg["scenario"]
+        vn = (cfg.get("variant") or {}).get("kind", "gasper")
+        wl = (cfg.get("workload") or {}).get("choice", "none")
+        sc = f"{cfg['scenario']} x {vn}"
         summary["scenarios"][sc] = summary["scenarios"].get(sc, 0) + 1
+        summary["variants"][vn] = summary["variants"].get(vn, 0) + 1
+        summary["workloads"][wl] = summary["workloads"].get(wl, 0) + 1
         if result is None:
             summary["incidents"] += 1
             summary.setdefault("inflight", []).append(inflight)
@@ -1025,6 +1154,15 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh", default=None, metavar="PxS",
                     help="run dense episodes sharded on a virtual mesh "
                          "(re-execs with forced host device count)")
+    ap.add_argument("--dense-variant", default=None,
+                    choices=("gasper", "goldfish", "rlmd", "ssf"),
+                    help="force the dense episodes' protocol variant "
+                         "(default: drawn per episode from the full "
+                         "protocol x attack x workload product)")
+    ap.add_argument("--dense-workload", default=None,
+                    choices=_DENSE_WORKLOADS,
+                    help="force the dense episodes' workload draw "
+                         "(DAS sidecars + light clients, or none)")
     ap.add_argument("--history", default=None,
                     help="append a kind=bench_dense_chaos emission to "
                          "this bench history (gate with perf_gate.py)")
@@ -1049,10 +1187,13 @@ def main(argv=None) -> int:
                              args.dense_epochs, args.out, mesh=args.mesh,
                              doctor=args.doctor,
                              step_timeout=args.step_timeout,
-                             history=args.history, scheme=args.scheme)
+                             history=args.history, scheme=args.scheme,
+                             variant=args.dense_variant,
+                             workload=args.dense_workload)
         print(json.dumps({k: summary[k] for k in
                           ("mode", "episodes", "violating", "accountable",
-                           "incidents", "scenarios", "run_s")}, indent=1))
+                           "incidents", "scenarios", "variants",
+                           "workloads", "run_s")}, indent=1))
         if args.doctor:
             # the forged double finality MUST trip protocol_violation —
             # which the doctor scenario records as an EXPECTED verdict,

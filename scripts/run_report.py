@@ -590,6 +590,37 @@ def build_report(events: list[dict], top_ops: dict | None = None,
                 if e.get("monitor") == "variant_safety"],
         }
 
+    # -- dense variants (ISSUE 20: the variant seam in the dense driver) ------
+    dv_attach = by_type.get("variant_attach", [])
+    dv_decisions = by_type.get("variant_decision", [])
+    dense_variants = None
+    if dv_attach or dv_decisions:
+        att = dv_attach[-1] if dv_attach else {}
+        by_rule: dict = {}
+        for e in dv_decisions:
+            row = by_rule.setdefault(
+                str(e.get("rule")),
+                {"count": 0, "last_slot": None, "views": set()})
+            row["count"] += 1
+            row["last_slot"] = e.get("slot")
+            row["views"].add(e.get("view"))
+        dense_variants = {
+            "variant": att.get("variant"),
+            "riders": att.get("riders") or [],
+            "decisions": len(dv_decisions),
+            "rules": {k: {"count": v["count"],
+                          "last_slot": v["last_slot"],
+                          "views": sorted(v["views"])}
+                      for k, v in sorted(by_rule.items())},
+            "violations": [
+                {k: e.get(k) for k in
+                 ("slot", "kind", "rule", "groups", "decision_slot",
+                  "roots", "evidence_size", "slashable_stake",
+                  "total_stake", "detail") if e.get(k) is not None}
+                for e in by_type.get("monitor", [])
+                if e.get("monitor") == "variant_safety"],
+        }
+
     # -- property audit (sim/monitors.py verdicts + invariant checker) --------
     attach = (by_type.get("monitor_attach") or [{}])[0]
     violations = [
@@ -659,6 +690,8 @@ def build_report(events: list[dict], top_ops: dict | None = None,
         report["das_serving"] = das_serving
     if variant_audit:
         report["variant_audit"] = variant_audit
+    if dense_variants:
+        report["dense_variants"] = dense_variants
     if top_ops:
         report["top_device_ops"] = top_ops
     if cost:
@@ -792,6 +825,37 @@ def to_markdown(report: dict) -> str:
                  for v in va["violations"]])]
         else:
             md.append("- no variant-safety violations")
+
+    if report.get("dense_variants"):
+        dv = report["dense_variants"]
+        md += ["", "## Dense variants", ""]
+        var = dv.get("variant") or {}
+        kind = var.get("kind", "gasper") if isinstance(var, dict) else var
+        params = ", ".join(f"{k}={v}" for k, v in sorted(var.items())
+                           if k != "kind") if isinstance(var, dict) else ""
+        md.append(f"- protocol variant: **{kind}**"
+                  + (f" ({params})" if params else ""))
+        for r in dv.get("riders", []):
+            desc = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
+                             if k != "kind")
+            md.append(f"- workload rider: **{r.get('kind')}** ({desc})")
+        md.append(f"- variant decisions: {dv.get('decisions', 0)}")
+        if dv.get("rules"):
+            md += ["", *_md_table(
+                ["rule", "decisions", "last slot", "views"],
+                [[rule, row["count"], row["last_slot"],
+                  ",".join(str(v) for v in row["views"])]
+                 for rule, row in sorted(dv["rules"].items())])]
+        if dv.get("violations"):
+            md += ["", *_md_table(
+                ["slot", "kind", "rule", "evidence", "slashable/total"],
+                [[v.get("slot"), v.get("kind"), v.get("rule", ""),
+                  v.get("evidence_size", ""),
+                  (f"{v['slashable_stake']}/{v['total_stake']}"
+                   if "slashable_stake" in v else "")]
+                 for v in dv["violations"]])]
+        else:
+            md.append("- no dense variant-safety violations")
 
     if report.get("resilience"):
         res = report["resilience"]

@@ -380,6 +380,387 @@ def replay_bundle(bundle: str) -> dict:
             "recorded": cfg["verdict"]}
 
 
+# -- dense tier (ISSUE 20: the matrix at mainnet scale) ------------------------
+#
+# The same paper claims, judged by the DENSE driver: vectorized
+# adversaries (committee-targeted ex-ante reorg, exactly-1/3 SplitVoter,
+# equivocation evidence), the variant seam's sharded tallies, DAS
+# sidecar + light-client workload riders on every cell, and the dense
+# monitor stack judging each variant by its own finality rule.
+
+DENSE_SCENARIOS = ("exante", "splitvoter", "equivocator")
+DENSE_CELLS = {
+    # gasper runs the ex-ante cell twice: pre-boost (the paper's attack
+    # succeeds) and with the W*40% proposer boost (the Gasper-side fix)
+    "exante": ("gasper", "gasper_boost", "goldfish", "rlmd", "ssf"),
+    "splitvoter": ("gasper", "goldfish", "rlmd", "ssf"),
+    "equivocator": ("gasper", "goldfish", "rlmd", "ssf"),
+}
+EXPECTED_DENSE = {
+    ("exante", "gasper"): True,         # banked committees win (:1503)
+    ("exante", "gasper_boost"): False,  # boost out-weighs the bank
+    ("exante", "goldfish"): False,      # full-participation collapse
+    ("exante", "rlmd"): False,
+    ("exante", "ssf"): False,
+    # splitvoter: safety under partition + 1/3 is impossible everywhere;
+    # the claim is HOW it dies — accountably (FFG, and SSF per-slot at
+    # exactly 1/3) vs unaccountable confirmation divergence
+    ("splitvoter", "gasper"): True,
+    ("splitvoter", "ssf"): True,
+    ("splitvoter", "goldfish"): True,
+    ("splitvoter", "rlmd"): True,
+    ("equivocator", "gasper"): False,
+    ("equivocator", "goldfish"): False,
+    ("equivocator", "rlmd"): False,
+    ("equivocator", "ssf"): False,
+}
+
+
+def dense_cell_config(scenario: str, cell: str, n: int) -> dict:
+    """One dense cell's full replayable composition (the chaos-bundle
+    shape): variant + boost, adversary, network faults, and the DAS +
+    light-client workload riders. Pure function of (scenario, cell, n).
+
+    The ex-ante margin is ``span*f - (span-1)*(1-f)`` committees; at
+    f=0.40/span=2 that is 0.2 committees — dozens of sigma past
+    committee-shuffle variance at mainnet scale (n=393216: ~2457 votes
+    vs sigma ~54), still >5 sigma at the smoke default."""
+    variant_kind = "gasper" if cell == "gasper_boost" else cell
+    boost = 40 if cell == "gasper_boost" else 0
+    # both cell-commitment schemes are exercised across the matrix: the
+    # device-resident Fr/NTT kzg engine on the ssf/rlmd cells, merkle
+    # elsewhere
+    scheme = "kzg" if variant_kind in ("ssf", "rlmd") else "merkle"
+    base = {
+        "schema": SCHEMA, "dense": True, "scenario": scenario,
+        "cell": cell, "n_validators": int(n), "slots_per_epoch": 8,
+        "seed": 20,
+        "variant": {"kind": variant_kind, "boost_percent": boost},
+        "workload": {"riders": [
+            {"kind": "das", "scheme": scheme, "n_blobs": 1,
+             "n_clients": 32, "samples_per_client": 2, "seed": 20,
+             "verify_every": 4},
+            {"kind": "lightclient", "n_clients": 32, "seed": 20},
+        ]},
+    }
+    if scenario == "exante":
+        base.update(n_epochs=2, n_groups=1, faults=None,
+                    adversaries=[{"kind": "DenseExAnteReorg",
+                                  "controlled": [[0, int(n * 0.40)]],
+                                  "fork_slot": 2, "span": 2}])
+    elif scenario == "splitvoter":
+        base.update(n_epochs=4, n_groups=2,
+                    faults={"seed": 20, "partition": "full"},
+                    adversaries=[{"kind": "DenseSplitVoter",
+                                  "controlled": [[0, n // 3]]}])
+    else:   # equivocator
+        base.update(n_epochs=2, n_groups=1, faults=None,
+                    adversaries=[{"kind": "DenseEquivocator",
+                                  "controlled": [[0, n // 4]],
+                                  "p_fork": 0.5, "seed": 20}])
+    return base
+
+
+def _dense_mesh(spec: str | None):
+    if not spec:
+        return None
+    import jax
+
+    from pos_evolution_tpu.parallel.sharded import make_mesh
+    pods, shard = (int(x) for x in spec.lower().split("x"))
+    if len(jax.devices()) < pods * shard:
+        print(f"variant_matrix: mesh {spec} needs {pods * shard} devices, "
+              f"only {len(jax.devices())} present — running single-device "
+              f"(bit-identical results, sharded path NOT exercised)",
+              file=sys.stderr)
+        return None
+    return make_mesh(pods * shard, pods)
+
+
+def _dense_verdict(cfgd: dict, sim) -> dict:
+    scenario = cfgd["scenario"]
+    v = sim.monitor_violations
+    out: dict = {}
+    if scenario == "exante":
+        adv = next(a for a in sim.adversaries
+                   if a.name == "dense_exante_reorg")
+        out["reorged"] = bool(adv.priv) and bool(
+            sim._descends(sim._head(0), adv.priv[0]))
+        out["withheld_root"] = (sim.roots[adv.priv[0]].hex()[:16]
+                                if adv.priv else None)
+        out["attack_succeeded"] = out["reorged"]
+    elif scenario == "splitvoter":
+        fin = [x for x in v if x.get("kind") == "accountable_fault"
+               and x.get("checkpoint") == "finalized"]
+        out["finalized_conflict"] = bool(fin)
+        out["ffg_exact_third"] = any(
+            3 * x["slashable_stake"] == x["total_stake"] for x in fin)
+        ssf = [x for x in v
+               if x.get("kind") == "accountable_double_finality"]
+        out["ssf_double_finality"] = bool(ssf)
+        out["ssf_exact_third"] = any(
+            3 * x["slashable_stake"] == x["total_stake"] for x in ssf)
+        out["confirmation_diverged"] = any(
+            x.get("kind") == "confirmation_divergence" for x in v)
+        out["accountable"] = (out["finalized_conflict"]
+                              and out["ffg_exact_third"])
+        out["attack_succeeded"] = (out["finalized_conflict"]
+                                   or out["ssf_double_finality"]
+                                   or out["confirmation_diverged"])
+    else:   # equivocator
+        safety = [x for x in v
+                  if x["kind"] in ("accountable_fault",
+                                   "protocol_violation",
+                                   "accountable_double_finality")]
+        out["safety_violations"] = len(safety)
+        implicated = 0
+        for m in sim.monitors:
+            arr = getattr(m, "implicated", None)
+            if arr is not None:
+                implicated = max(implicated, int(arr.sum()))
+        out["slasher_implicated"] = implicated
+        out["attack_succeeded"] = bool(safety)
+    out["violations"] = len(v)
+    out["violation_kinds"] = sorted({x["kind"] for x in v})
+    out["finalized_epochs"] = [view.finalized[0] for view in sim.views]
+    return out
+
+
+def run_dense_cell(cfgd: dict, events_path: str | None = None,
+                   resume_from: bytes | None = None, mesh=None,
+                   phase_profile: int | None = 8) -> dict:
+    """One dense cell through ``DenseSimulation`` under the full dense
+    monitor stack, with the FlightRecorder + phase profiler armed when
+    the cell records events (attack runs get the same phase/compile
+    attribution as benign ones — ``variant_tally``/``workload`` phases
+    included). ``resume_from`` replays from a bundle's checkpoint."""
+    from pos_evolution_tpu.config import mainnet_config
+    from pos_evolution_tpu.sim.dense_adversary import (
+        dense_adversary_from_config,
+    )
+    from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+    from pos_evolution_tpu.sim.dense_monitors import default_dense_monitors
+    from pos_evolution_tpu.sim.dense_variants import dense_rider_from_config
+    from pos_evolution_tpu.sim.faults import DenseFaultPlan
+    from pos_evolution_tpu.telemetry import FlightRecorder, Telemetry
+    cfg_obj = mainnet_config().replace(
+        slots_per_epoch=cfgd["slots_per_epoch"],
+        max_committees_per_slot=4)
+    telemetry = (Telemetry.to_file(events_path)
+                 if events_path is not None else None)
+    flight = (FlightRecorder(telemetry=telemetry, sample_every=8).install()
+              if telemetry is not None else None)
+    profile = phase_profile if telemetry is not None else None
+    n_slots = cfgd["n_epochs"] * cfgd["slots_per_epoch"]
+    t0 = time.perf_counter()
+    try:
+        # the DAS riders size their blob grids off the ACTIVE config:
+        # pinning it makes fresh runs, resumes and replays rebuild
+        # byte-identical sidecars
+        with use_config(cfg_obj):
+            if resume_from is not None:
+                sim = DenseSimulation.resume(
+                    resume_from, mesh=mesh, telemetry=telemetry,
+                    expect_variant=cfgd["variant"]["kind"],
+                    phase_profile=profile, flight_recorder=flight)
+                checkpoint = resume_from
+            else:
+                sim = DenseSimulation(
+                    cfgd["n_validators"], cfg=cfg_obj, mesh=mesh,
+                    seed=cfgd["seed"], verify_aggregates=False,
+                    check_walk_every=0,
+                    n_groups=cfgd.get("n_groups", 1),
+                    fault_plan=DenseFaultPlan.from_config(
+                        cfgd.get("faults")),
+                    adversaries=[dense_adversary_from_config(a)
+                                 for a in cfgd["adversaries"]],
+                    monitors=default_dense_monitors(),
+                    variant=cfgd["variant"],
+                    riders=[dense_rider_from_config(r)
+                            for r in cfgd["workload"]["riders"]],
+                    telemetry=telemetry, phase_profile=profile,
+                    flight_recorder=flight)
+                checkpoint = sim.checkpoint()
+            while sim.slot < n_slots:
+                sim.run_slot()
+    finally:
+        if flight is not None:
+            flight.detach()
+        if telemetry is not None:
+            telemetry.close()
+    wall = time.perf_counter() - t0
+    scenario, cell = cfgd["scenario"], cfgd["cell"]
+    verdict = _dense_verdict(cfgd, sim)
+    verdict.update({
+        "scenario": scenario, "cell": cell,
+        "variant": cfgd["variant"]["kind"],
+        "boost_percent": cfgd["variant"]["boost_percent"],
+        "n_validators": cfgd["n_validators"],
+        "wall_s": round(wall, 3), "slots_run": sim.slot,
+        "expected_attack_success": EXPECTED_DENSE.get((scenario, cell)),
+        "workload": {r.kind: r.stats() for r in sim.riders},
+    })
+    if sim.variant.name != "gasper":
+        verdict["variant_decisions"] = len(sim.variant.decisions)
+    phases = sim.phases.summary() if sim.phases.enabled else None
+    if phases:
+        verdict["phase_ms"] = {
+            name: row["total_ms"]
+            for name, row in phases.get("phases", {}).items()}
+    exp = verdict["expected_attack_success"]
+    ok = None if exp is None else verdict["attack_succeeded"] == exp
+    # the pins go beyond the binary verdict: SSF must double-finalize
+    # at EXACTLY 1/3 implicated stake, gasper's FFG break must be
+    # accountable
+    if ok and scenario == "splitvoter":
+        if cell == "ssf":
+            ok = verdict["ssf_double_finality"] and \
+                verdict["ssf_exact_third"]
+        elif cell == "gasper":
+            ok = verdict["accountable"]
+        else:
+            ok = verdict["confirmation_diverged"]
+    verdict["matches_expectation"] = ok
+    return {"verdict": verdict, "checkpoint": checkpoint,
+            "violations": sim.monitor_violations, "config": cfgd}
+
+
+def write_dense_bundle(out_dir: str, cfgd: dict, result: dict,
+                       events_src: str | None) -> str:
+    import shutil
+    bundle = os.path.join(
+        out_dir, f"bundle_dense_{cfgd['scenario']}_{cfgd['cell']}")
+    os.makedirs(bundle, exist_ok=True)
+    with open(os.path.join(bundle, "config.json"), "w") as fh:
+        json.dump({"schema": SCHEMA, "dense": True, "config": cfgd,
+                   "verdict": result["verdict"]},
+                  fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    with open(os.path.join(bundle, "checkpoint.bin"), "wb") as fh:
+        fh.write(result["checkpoint"])
+    with open(os.path.join(bundle, "violations.json"), "w") as fh:
+        json.dump(result["violations"], fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    if events_src and os.path.exists(events_src):
+        shutil.move(events_src, os.path.join(bundle, "events.jsonl"))
+    return bundle
+
+
+def replay_dense_bundle(bundle: str) -> dict:
+    """Re-run a dense cell from its bundle checkpoint (under the variant
+    + workload that produced it — the checkpoint's variant fingerprint
+    refuses anything else) and demand the byte-stable monitor verdict:
+    identical (slot, monitor, kind) triples and the same
+    attack_succeeded."""
+    with open(os.path.join(bundle, "config.json")) as fh:
+        doc = json.load(fh)
+    with open(os.path.join(bundle, "checkpoint.bin"), "rb") as fh:
+        checkpoint = fh.read()
+    with open(os.path.join(bundle, "violations.json")) as fh:
+        recorded = json.load(fh)
+    result = run_dense_cell(doc["config"], resume_from=checkpoint)
+    key = lambda v: (v.get("slot"), v["monitor"], v["kind"])  # noqa: E731
+    match = (sorted(map(key, result["violations"]))
+             == sorted(map(key, recorded))
+             and result["verdict"]["attack_succeeded"]
+             == doc["verdict"]["attack_succeeded"])
+    return {"match": match, "replayed": result["verdict"],
+            "recorded": doc["verdict"]}
+
+
+def dense_parity_leg(variant_name: str, n: int, slots: int = 12,
+                     mesh_spec: str = "4x2") -> dict:
+    """Spec<->dense parity through the variant seam (ISSUE 20 satellite):
+    twin honest runs — single-device (the host-oracle/spec-walk twin)
+    vs sharded mesh — must produce bit-identical per-slot heads and
+    variant decision streams, with the in-run spec-walk audits
+    (``check_walk_every``) green on both."""
+    from pos_evolution_tpu.config import mainnet_config
+    from pos_evolution_tpu.sim.dense_driver import DenseSimulation
+    cfg_obj = mainnet_config().replace(slots_per_epoch=8,
+                                       max_committees_per_slot=4)
+    mesh = _dense_mesh(mesh_spec)
+
+    def run(m):
+        with use_config(cfg_obj):
+            sim = DenseSimulation(n, cfg=cfg_obj, mesh=m, seed=20,
+                                  verify_aggregates=False,
+                                  check_walk_every=4,
+                                  variant={"kind": variant_name})
+            heads = []
+            for _ in range(slots):
+                sim.run_slot()
+                heads.append(sim.roots[sim._head(0)].hex())
+            return heads, list(sim.variant.decisions), sim.summary()
+
+    t0 = time.perf_counter()
+    h1, d1, s1 = run(None)
+    h2, d2, s2 = run(mesh)
+    return {
+        "variant": variant_name, "n": int(n), "slots": int(slots),
+        "mesh": mesh_spec if mesh is not None else None,
+        "sharded_path_exercised": mesh is not None,
+        "heads_identical": h1 == h2,
+        "decisions_identical": d1 == d2,
+        "decisions": len(d1),
+        "spec_walk_audits_clean": bool(
+            s1["resident_head_equals_spec_walk"]
+            and s2["resident_head_equals_spec_walk"]),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def run_dense_matrix(scenarios, variants, n: int, out_dir: str,
+                     events: bool = True, mesh=None) -> dict:
+    os.makedirs(out_dir, exist_ok=True)
+    rows, bundles = [], []
+    for scenario in scenarios:
+        for cell in DENSE_CELLS[scenario]:
+            base = "gasper" if cell == "gasper_boost" else cell
+            if base not in variants:
+                continue
+            cfgd = dense_cell_config(scenario, cell, n)
+            events_path = (os.path.join(
+                out_dir, f"dense_{scenario}_{cell}.events.jsonl")
+                if events else None)
+            result = run_dense_cell(cfgd, events_path=events_path,
+                                    mesh=mesh)
+            verdict = result["verdict"]
+            rows.append(verdict)
+            status = {True: "ATTACK SUCCEEDS", False: "defended"}[
+                verdict["attack_succeeded"]]
+            pin = verdict["matches_expectation"]
+            pin_str = {True: "as the paper says", False: "UNEXPECTED",
+                       None: "unpinned"}[pin]
+            print(f"dense {scenario:>11} x {cell:<13} {status:<15} "
+                  f"({pin_str}; {len(result['violations'])} violations, "
+                  f"n={n}, {verdict['wall_s']}s)")
+            if result["violations"]:
+                bundles.append(write_dense_bundle(out_dir, cfgd, result,
+                                                  events_path))
+            elif events_path and os.path.exists(events_path):
+                os.remove(events_path)
+    mismatches = [r for r in rows if r["matches_expectation"] is False]
+    return {"schema": SCHEMA, "dense": True, "n_validators": int(n),
+            "rows": rows, "bundles": bundles,
+            "mismatches": len(mismatches)}
+
+
+def bench_dense_emission(rows: list[dict]) -> dict:
+    """bench_dense_variants history emission: per-cell wall time off the
+    fixed-shape ex-ante cells (counts deterministic)."""
+    emission: dict = {"metric": "bench_dense_variants", "counts": {}}
+    for row in rows:
+        if row["scenario"] != "exante":
+            continue
+        cell = row["cell"]
+        emission[cell] = {"wall_s": row["wall_s"]}
+        emission["counts"][f"{cell}.slots_run"] = row["slots_run"]
+        emission["counts"][f"{cell}.attack_succeeded"] = int(
+            row["attack_succeeded"])
+    return emission
+
+
 # -- matrix driver -------------------------------------------------------------
 
 
@@ -449,18 +830,68 @@ def main(argv=None) -> int:
     ap.add_argument("--variants", default=",".join(VARIANT_NAMES))
     ap.add_argument("--no-events", action="store_true")
     ap.add_argument("--replay", metavar="BUNDLE",
-                    help="replay a repro bundle and verify the verdict")
+                    help="replay a repro bundle (spec or dense tier — "
+                         "dispatched on the bundle's config.json) and "
+                         "verify the verdict")
+    ap.add_argument("--dense", action="store_true",
+                    help="run the matrix through the DENSE driver: "
+                         "vectorized adversaries, sharded variant "
+                         "tallies, DAS + light-client riders on every "
+                         "cell (ISSUE 20)")
+    ap.add_argument("--dense-validators", type=int, default=2112,
+                    help="dense-cell validator count (mainnet pin: "
+                         "393216)")
+    ap.add_argument("--mesh", default=None, metavar="PxS",
+                    help="dense cells on a PxS device mesh (e.g. 4x2; "
+                         "re-execs with fake host devices if needed)")
+    ap.add_argument("--parity", action="store_true",
+                    help="also run the per-variant spec<->dense parity "
+                         "legs: twin single-device vs mesh runs must be "
+                         "bit-identical")
+    ap.add_argument("--parity-n", type=int, default=65536)
+    ap.add_argument("--parity-slots", type=int, default=12)
     args = ap.parse_args(argv)
 
     if args.replay:
-        out = replay_bundle(args.replay)
+        with open(os.path.join(args.replay, "config.json")) as fh:
+            dense = bool(json.load(fh).get("dense"))
+        out = (replay_dense_bundle if dense else replay_bundle)(args.replay)
         print(json.dumps(out, indent=1, default=str))
         return 0 if out["match"] else 1
 
+    if args.dense and (args.mesh or args.parity):
+        need = 8
+        if args.mesh:
+            p, s = (int(x) for x in args.mesh.lower().split("x"))
+            need = max(need, p * s)
+        from pos_evolution_tpu.utils.hostdev import reexec_with_host_devices
+        reexec_with_host_devices(need, "POS_VM_CHILD")
+
     scenarios = [s.strip() for s in args.scenarios.split(",") if s.strip()]
     variants = [v.strip() for v in args.variants.split(",") if v.strip()]
-    summary = run_matrix(scenarios, variants, args.out,
-                         events=not args.no_events)
+
+    if args.dense:
+        dense_scenarios = ([s for s in scenarios if s in DENSE_SCENARIOS]
+                           or list(DENSE_SCENARIOS))
+        summary = run_dense_matrix(dense_scenarios, variants,
+                                   args.dense_validators, args.out,
+                                   events=not args.no_events,
+                                   mesh=_dense_mesh(args.mesh))
+        if args.parity:
+            summary["parity"] = [
+                dense_parity_leg(v, args.parity_n, args.parity_slots)
+                for v in variants]
+            for leg in summary["parity"]:
+                ok = leg["heads_identical"] and leg["decisions_identical"]
+                print(f"parity {leg['variant']:<9} n={leg['n']} "
+                      f"{'bit-identical' if ok else 'DIVERGED'} "
+                      f"({leg['decisions']} decisions, "
+                      f"mesh={leg['mesh']}, {leg['wall_s']}s)")
+                if not (ok and leg["spec_walk_audits_clean"]):
+                    summary["mismatches"] += 1
+    else:
+        summary = run_matrix(scenarios, variants, args.out,
+                             events=not args.no_events)
     if args.json:
         with open(args.json, "w") as fh:
             json.dump(summary, fh, indent=1, sort_keys=True)
@@ -468,9 +899,17 @@ def main(argv=None) -> int:
         print(f"matrix   -> {args.json}")
     if args.history:
         from pos_evolution_tpu.profiling import history
-        history.append_entry(args.history, bench_emission(summary["rows"]),
-                             kind="bench_variants")
-        print(f"history  -> {args.history} (kind=bench_variants)")
+        if args.dense:
+            history.append_entry(args.history,
+                                 bench_dense_emission(summary["rows"]),
+                                 kind="bench_dense_variants")
+            print(f"history  -> {args.history} "
+                  f"(kind=bench_dense_variants)")
+        else:
+            history.append_entry(args.history,
+                                 bench_emission(summary["rows"]),
+                                 kind="bench_variants")
+            print(f"history  -> {args.history} (kind=bench_variants)")
     if summary["mismatches"]:
         print(f"{summary['mismatches']} cell(s) CONTRADICT the paper's "
               f"claims", file=sys.stderr)

@@ -22,6 +22,20 @@ sys.path.insert(0, os.path.join(os.path.dirname(
 GWEI = 10**9
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _release_compiled_kernels():
+    """Dense chaos episodes compile kernels for shapes (384-576
+    validators, per-variant tallies, 2x4 meshes) no later test file
+    reuses; leaving them cached measurably slows the rest of the
+    suite."""
+    yield
+    import gc
+
+    import jax
+    jax.clear_caches()
+    gc.collect()
+
+
 def _mesh(pods, shard):
     from pos_evolution_tpu.parallel.sharded import make_mesh
     return make_mesh(pods * shard, pods)
